@@ -1,0 +1,21 @@
+"""foremast-tpu: a TPU-native application-health and canary-analysis framework.
+
+A ground-up JAX/XLA re-design of the capabilities of Intuit Foremast
+(reference: pzou1974/foremast-1). Where the reference runs a shared-nothing
+CPU worker pool scoring one job at a time (reference
+`docs/guides/design.md:35-43`), this framework treats
+(service x metric x window) as array dimensions of one jit-compiled batched
+scoring program, sharded across TPU chips over ICI via `jax.sharding`.
+
+Layers (mirrors SURVEY.md section 7 build plan):
+  ops/       pure-JAX masked window math: forecasters, rank tests, bounds
+  models/    learned detectors (LSTM-autoencoder, bivariate normal, seasonal)
+  parallel/  mesh construction, shard_map scoring, sequence parallelism
+  engine/    HealthScorer + worker loop (the "brain" equivalent)
+  jobs/      idempotent job store + status state machine (the "service" data plane)
+  service/   REST facade (healthcheck create/status, query proxy)
+  metrics/   metric sources (Prometheus/replay), PromQL builder, gauge exporter
+  watcher/   deployment watch + remediation (the "barrelman" equivalent)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,52 @@
+"""Pure-JAX batched ops over masked metric windows.
+
+Everything here is jit-friendly: fixed shapes, validity masks instead of
+ragged windows, `lax.scan`/`lax.associative_scan` instead of Python loops.
+"""
+
+from foremast_tpu.ops.windows import MetricWindows, masked_mean, masked_std, masked_var
+from foremast_tpu.ops.forecasters import (
+    Forecast,
+    moving_average_all,
+    moving_average,
+    ewma,
+    double_exponential,
+    holt_winters,
+    fit_holt_winters,
+)
+from foremast_tpu.ops.ranks import (
+    masked_ranks,
+    mann_whitney_u,
+    wilcoxon_signed_rank,
+    kruskal_wallis,
+)
+from foremast_tpu.ops.anomaly import (
+    BOUND_UPPER,
+    BOUND_LOWER,
+    BOUND_BOTH,
+    compute_bounds,
+    detect_anomalies,
+)
+
+__all__ = [
+    "MetricWindows",
+    "masked_mean",
+    "masked_std",
+    "masked_var",
+    "Forecast",
+    "moving_average_all",
+    "moving_average",
+    "ewma",
+    "double_exponential",
+    "holt_winters",
+    "fit_holt_winters",
+    "masked_ranks",
+    "mann_whitney_u",
+    "wilcoxon_signed_rank",
+    "kruskal_wallis",
+    "BOUND_UPPER",
+    "BOUND_LOWER",
+    "BOUND_BOTH",
+    "compute_bounds",
+    "detect_anomalies",
+]

@@ -1,0 +1,72 @@
+"""Bound computation and anomaly flagging.
+
+Mirrors the reference brain's threshold semantics: a global
+`threshold=2.0` / `min_lower_bound=0` / `bound=1` plus a per-metric-type
+override matrix (error5xx t=2 b=1, error4xx t=3 b=1, latency t=10 b=3,
+cpu t=5 b=1, memory t=5 b=1) — reference
+`deploy/foremast/3_brain/foremast-brain.yaml:26-73`. The bound selector
+chooses which side(s) of the forecast band flag anomalies
+(`ML_BOUND` upper/lower/both, `foremast-brain/README.md:24`).
+
+All functions are batched: thresholds/bounds/min_lower_bounds may be
+scalars or per-window [B] arrays (the per-metric-type table turns into a
+gathered [B] vector — config table lookups happen host-side once, outside
+jit).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+BOUND_UPPER = 1
+BOUND_LOWER = 2
+BOUND_BOTH = 3
+
+
+def compute_bounds(
+    pred: jax.Array,
+    scale: jax.Array,
+    threshold: jax.Array | float,
+    min_lower_bound: jax.Array | float = 0.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Upper/lower anomaly bounds around predictions.
+
+    pred: [B, T] predicted values; scale: [B] residual std;
+    threshold: scalar or [B] multiplier. Lower bound is floored at
+    min_lower_bound (reference `min_lower_bound=0`,
+    `foremast-brain.yaml:28-29` — metric rates cannot go negative).
+    Returns (upper [B, T], lower [B, T]).
+    """
+    threshold = jnp.asarray(threshold, pred.dtype)
+    mlb = jnp.asarray(min_lower_bound, pred.dtype)
+    if threshold.ndim == 1:
+        threshold = threshold[:, None]
+    if mlb.ndim == 1:
+        mlb = mlb[:, None]
+    band = threshold * jnp.expand_dims(scale, -1)
+    upper = pred + band
+    lower = jnp.maximum(pred - band, mlb)
+    return upper, lower
+
+
+def detect_anomalies(
+    current: jax.Array,
+    cur_mask: jax.Array,
+    upper: jax.Array,
+    lower: jax.Array,
+    bound: jax.Array | int = BOUND_UPPER,
+) -> jax.Array:
+    """Flag current points outside the band per the bound selector.
+
+    current/cur_mask/upper/lower: [B, T]; bound: scalar or [B] int
+    (1=upper, 2=lower, 3=both). Returns bool [B, T].
+    """
+    bound = jnp.asarray(bound, jnp.int32)
+    if bound.ndim == 1:
+        bound = bound[:, None]
+    over = current > upper
+    under = current < lower
+    use_upper = (bound == BOUND_UPPER) | (bound == BOUND_BOTH)
+    use_lower = (bound == BOUND_LOWER) | (bound == BOUND_BOTH)
+    return cur_mask & ((over & use_upper) | (under & use_lower))

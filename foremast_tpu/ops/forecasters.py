@@ -1,0 +1,329 @@
+"""Batched masked time-series forecasters.
+
+The reference brain's model zoo (reference `docs/guides/design.md:57-93`):
+moving average, exponential smoothing (EWMA), double exponential smoothing,
+Holt-Winters (+ Prophet, approximated separately in models/seasonal.py).
+Deployed default algorithm is `moving_average_all`
+(`deploy/foremast/3_brain/foremast-brain.yaml:24-25`).
+
+TPU-first design notes:
+  * every forecaster is batched over a leading [B] axis and jit-friendly;
+  * ragged history is handled by validity masks, never by dynamic shapes;
+  * EWMA is a linear recurrence, so it runs as `lax.associative_scan`
+    (log-depth on the VPU, and shardable along time for sequence
+    parallelism — see parallel/seqparallel.py);
+  * Holt / Holt-Winters run as `lax.scan` with the whole batch inside the
+    carry, so XLA emits one fused loop over time for all series at once;
+  * smoothing parameters are *fit* by a vectorized grid search (vmap over
+    the grid), not per-series Python loops.
+
+All forecasters return a `Forecast` carrying in-sample one-step-ahead
+predictions (for residual scale), a residual scale, and terminal state
+(level/trend/season) from which `horizon` extrapolates future bounds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from foremast_tpu.ops.windows import masked_mean, masked_std
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Forecast:
+    """Fitted forecaster state for a batch of series.
+
+    pred:   [B, T] one-step-ahead in-sample predictions
+    scale:  [B]    residual standard deviation (deviation unit for bounds)
+    level:  [B]    terminal level
+    trend:  [B]    terminal per-step trend (0 for trendless models)
+    season: [B, m] terminal seasonal offsets (m=1 zeros when non-seasonal)
+    season_phase: [B] int32 — season index of the *next* (first forecast) step
+    """
+
+    pred: jax.Array
+    scale: jax.Array
+    level: jax.Array
+    trend: jax.Array
+    season: jax.Array
+    season_phase: jax.Array
+
+
+def _finalize(pred, values, mask, level, trend, season=None, season_phase=None):
+    resid = values - pred
+    scale = masked_std(resid, mask, ddof=0)
+    b = values.shape[0]
+    if season is None:
+        season = jnp.zeros((b, 1), dtype=values.dtype)
+        season_phase = jnp.zeros((b,), dtype=jnp.int32)
+    return Forecast(
+        pred=pred,
+        scale=scale,
+        level=level,
+        trend=trend,
+        season=season,
+        season_phase=season_phase,
+    )
+
+
+def horizon(fc: Forecast, h: int) -> jax.Array:
+    """Extrapolate h future points from terminal state -> [B, h]."""
+    steps = jnp.arange(1, h + 1, dtype=fc.level.dtype)  # [h]
+    base = fc.level[:, None] + fc.trend[:, None] * steps[None, :]
+    m = fc.season.shape[-1]
+    idx = (fc.season_phase[:, None] + jnp.arange(h)[None, :]) % m  # [B,h]
+    seas = jnp.take_along_axis(fc.season, idx, axis=-1)
+    return base + seas
+
+
+# ---------------------------------------------------------------------------
+# Moving averages
+# ---------------------------------------------------------------------------
+
+
+def moving_average_all(values: jax.Array, mask: jax.Array) -> Forecast:
+    """Global-mean model over the whole masked history.
+
+    This is the reference's deployed default `moving_average_all`
+    (`foremast-brain.yaml:24-25`): the "model" is the historical mean, the
+    deviation unit is the historical std, and bounds are
+    mean +/- threshold * std.
+    """
+    mu = masked_mean(values, mask)  # [B]
+    pred = jnp.broadcast_to(mu[:, None], values.shape)
+    zeros = jnp.zeros_like(mu)
+    return _finalize(pred, values, mask, level=mu, trend=zeros)
+
+
+def moving_average(values: jax.Array, mask: jax.Array, window: int = 10) -> Forecast:
+    """Causal rolling mean of the previous `window` time steps.
+
+    pred[t] = mean of valid points in [t-window, t); falls back to the
+    running global mean until enough history accumulates.
+    """
+    v = values * mask
+    m = mask.astype(values.dtype)
+    # prefix sums shifted so position t sums strictly-previous samples
+    csum_v = jnp.cumsum(v, axis=-1)
+    csum_m = jnp.cumsum(m, axis=-1)
+    pad = jnp.zeros_like(csum_v[..., :1])
+    prev_v = jnp.concatenate([pad, csum_v[..., :-1]], axis=-1)
+    prev_m = jnp.concatenate([pad, csum_m[..., :-1]], axis=-1)
+    lo_v = jnp.roll(prev_v, window, axis=-1).at[..., :window].set(0.0)
+    lo_m = jnp.roll(prev_m, window, axis=-1).at[..., :window].set(0.0)
+    win_v = prev_v - lo_v
+    win_m = prev_m - lo_m
+    run_mean = prev_v / jnp.maximum(prev_m, 1.0)
+    pred = jnp.where(win_m > 0, win_v / jnp.maximum(win_m, 1.0), run_mean)
+    # first point has no history at all: predict itself (zero residual)
+    pred = jnp.where((prev_m == 0), values, pred)
+    # terminal level: mean of the last `window` valid points
+    last_mask = mask & (csum_m > jnp.maximum(csum_m[..., -1:] - window, 0))
+    level = masked_mean(values, last_mask)
+    zeros = jnp.zeros_like(level)
+    return _finalize(pred, values, mask, level=level, trend=zeros)
+
+
+# ---------------------------------------------------------------------------
+# Exponential smoothing (associative-scan form)
+# ---------------------------------------------------------------------------
+
+
+def _linrec_assoc(elem_a, elem_b):
+    """Compose linear recurrence elements l_t = a*l_{t-1} + b."""
+    a1, b1 = elem_a
+    a2, b2 = elem_b
+    return a1 * a2, a2 * b1 + b2
+
+
+def ewma_levels(values: jax.Array, mask: jax.Array, alpha) -> jax.Array:
+    """Exponentially weighted level after each step, [B, T].
+
+    Implemented as `lax.associative_scan` over the linear recurrence
+    l_t = (1-a_t) l_{t-1} + a_t x_t — log-depth, and the same composition
+    law the sequence-parallel path uses across devices.
+    `alpha` may be scalar or [B] (per-series), broadcast over time.
+    """
+    alpha = jnp.asarray(alpha, dtype=values.dtype)
+    if alpha.ndim == 1:
+        alpha = alpha[:, None]
+    is_first = mask & (jnp.cumsum(mask, axis=-1) == 1)
+    a_eff = jnp.where(mask, alpha, 0.0)
+    a_eff = jnp.where(is_first, 1.0, a_eff)
+    a = 1.0 - a_eff
+    b = a_eff * values
+    comp_a, comp_b = jax.lax.associative_scan(_linrec_assoc, (a, b), axis=-1)
+    return comp_b  # composed-from-start b is the level (l_0 treated as 0)
+
+
+def ewma(values: jax.Array, mask: jax.Array, alpha: float = 0.3) -> Forecast:
+    """EWMA forecaster: pred[t] is the EW level of points before t."""
+    levels = ewma_levels(values, mask, alpha)
+    # one-step-ahead: prediction at t is the level after t-1; before any
+    # history exists, predict the point itself (zero residual)
+    shifted = jnp.concatenate([levels[..., :1] * 0, levels[..., :-1]], axis=-1)
+    inited_before = (jnp.cumsum(mask, axis=-1) - mask) > 0
+    pred = jnp.where(inited_before, shifted, values)
+    level = levels[..., -1]
+    zeros = jnp.zeros_like(level)
+    return _finalize(pred, values, mask, level=level, trend=zeros)
+
+
+# ---------------------------------------------------------------------------
+# Double exponential smoothing (Holt's linear trend)
+# ---------------------------------------------------------------------------
+
+
+def double_exponential(
+    values: jax.Array, mask: jax.Array, alpha: float = 0.3, beta: float = 0.1
+) -> Forecast:
+    """Holt's linear method, batched inside a single `lax.scan` over time.
+
+    Masked steps carry (level, trend) through unchanged. Initialization:
+    level <- first valid point, trend <- 0 (updated from data thereafter).
+    """
+    alpha = jnp.asarray(alpha, dtype=values.dtype)
+    beta = jnp.asarray(beta, dtype=values.dtype)
+    b = values.shape[0]
+
+    def step(carry, xs):
+        level, trend, inited = carry
+        x, m = xs
+        pred = level + trend
+        new_level = alpha * x + (1.0 - alpha) * (level + trend)
+        new_trend = beta * (new_level - level) + (1.0 - beta) * trend
+        # first valid point: initialize level=x, trend=0
+        first = m & ~inited
+        upd = m & inited
+        level_out = jnp.where(first, x, jnp.where(upd, new_level, level))
+        trend_out = jnp.where(first, 0.0, jnp.where(upd, new_trend, trend))
+        pred_out = jnp.where(inited, pred, x)  # zero residual pre-init
+        return (level_out, trend_out, inited | m), pred_out
+
+    init = (
+        jnp.zeros((b,), values.dtype),
+        jnp.zeros((b,), values.dtype),
+        jnp.zeros((b,), bool),
+    )
+    (level, trend, _), preds = jax.lax.scan(
+        step, init, (values.T, mask.T)
+    )  # scan over time with batch inside
+    pred = preds.T
+    return _finalize(pred, values, mask, level=level, trend=trend)
+
+
+# ---------------------------------------------------------------------------
+# Holt-Winters (additive seasonal)
+# ---------------------------------------------------------------------------
+
+
+def holt_winters(
+    values: jax.Array,
+    mask: jax.Array,
+    season_length: int = 24,
+    alpha: float = 0.3,
+    beta: float = 0.05,
+    gamma: float = 0.1,
+) -> Forecast:
+    """Additive Holt-Winters, batched in one `lax.scan` over time.
+
+    Season indexing uses the absolute time-step index modulo m (windows are
+    regularly sampled — 60 s PromQL step in the reference,
+    `metricsquery.go:43` — so gaps keep their phase). Seasonal state is a
+    dense [B, m] buffer updated with a one-hot mask (no scatter inside scan).
+
+    Initialization: level <- mean of the first season's valid points,
+    seasonal offsets <- first-season residuals vs that mean.
+    """
+    m_len = int(season_length)
+    b, t_len = values.shape
+    dtype = values.dtype
+    alpha = jnp.asarray(alpha, dtype)
+    beta = jnp.asarray(beta, dtype)
+    gamma = jnp.asarray(gamma, dtype)
+
+    first_season_mask = mask & (jnp.arange(t_len)[None, :] < m_len)
+    init_level = masked_mean(values, first_season_mask)  # [B]
+    # seasonal init: first-season residuals (0 where that slot was invalid)
+    pad = m_len - min(m_len, t_len)
+    fs_vals = values[:, :m_len]
+    fs_mask = first_season_mask[:, :m_len]
+    if pad:
+        fs_vals = jnp.pad(fs_vals, ((0, 0), (0, pad)))
+        fs_mask = jnp.pad(fs_mask, ((0, 0), (0, pad)))
+    init_season = jnp.where(fs_mask, fs_vals - init_level[:, None], 0.0)
+
+    def step(carry, xs):
+        level, trend, season, inited = carry
+        x, m, t = xs
+        phase = jnp.mod(t, m_len)
+        onehot = jax.nn.one_hot(phase, m_len, dtype=dtype)[None, :]  # [1,m]
+        s_t = season[:, phase]  # [B]
+        pred = level + trend + s_t
+        new_level = alpha * (x - s_t) + (1.0 - alpha) * (level + trend)
+        new_trend = beta * (new_level - level) + (1.0 - beta) * trend
+        new_s = gamma * (x - new_level) + (1.0 - gamma) * s_t
+        upd = (m & inited).astype(dtype)[:, None]  # [B,1]
+        season_out = season * (1.0 - upd * onehot) + (new_s[:, None] * onehot) * upd
+        level_out = jnp.where(m & inited, new_level, level)
+        trend_out = jnp.where(m & inited, new_trend, trend)
+        pred_out = jnp.where(inited, pred, x)
+        return (level_out, trend_out, season_out, inited | m), pred_out
+
+    init = (init_level, jnp.zeros((b,), dtype), init_season, jnp.zeros((b,), bool))
+    ts = jnp.arange(t_len, dtype=jnp.int32)
+    (level, trend, season, _), preds = jax.lax.scan(
+        step, init, (values.T, mask.T, ts)
+    )
+    pred = preds.T
+    phase_next = jnp.full((b,), t_len % m_len, dtype=jnp.int32)
+    return _finalize(
+        pred, values, mask, level=level, trend=trend, season=season, season_phase=phase_next
+    )
+
+
+_HW_GRID = (
+    (0.1, 0.01, 0.05),
+    (0.1, 0.05, 0.1),
+    (0.3, 0.05, 0.1),
+    (0.3, 0.1, 0.2),
+    (0.5, 0.1, 0.1),
+    (0.5, 0.05, 0.3),
+    (0.7, 0.1, 0.1),
+    (0.8, 0.2, 0.2),
+)
+
+
+@partial(jax.jit, static_argnames=("season_length",))
+def fit_holt_winters(
+    values: jax.Array, mask: jax.Array, season_length: int = 24
+) -> Forecast:
+    """Per-series fitted Holt-Winters: vectorized grid search over smoothing
+    parameters (SURVEY.md section 7 "hard parts" (c)) — the whole grid runs as
+    one vmapped program; each series independently picks its SSE-minimizing
+    (alpha, beta, gamma).
+    """
+    grid = jnp.asarray(_HW_GRID, dtype=values.dtype)  # [G,3]
+
+    def run(params):
+        a, bta, g = params[0], params[1], params[2]
+        fc = holt_winters(values, mask, season_length, a, bta, g)
+        resid = (values - fc.pred) * mask
+        sse = jnp.sum(resid * resid, axis=-1)  # [B]
+        return fc, sse
+
+    fcs, sses = jax.vmap(run)(grid)  # Forecast with leading [G], sse [G,B]
+    best = jnp.argmin(sses, axis=0)  # [B]
+
+    def pick(leaf):
+        # leaf: [G, B, ...] -> [B, ...] selecting per-series best grid point
+        moved = jnp.moveaxis(leaf, 0, 1)  # [B, G, ...]
+        idx = best.reshape((-1,) + (1,) * (moved.ndim - 1))
+        return jnp.take_along_axis(moved, idx, axis=1).squeeze(1)
+
+    return jax.tree_util.tree_map(pick, fcs)

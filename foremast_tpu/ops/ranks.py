@@ -1,0 +1,175 @@
+"""Masked, batched rank statistics on TPU.
+
+The reference brain's pairwise baseline-vs-current same-distribution tests:
+Mann-Whitney U, Wilcoxon signed-rank, Kruskal-Wallis (reference
+`docs/guides/design.md:90-93`), selectable/combinable via
+`ML_PAIRWISE_ALGORITHM` = ALL | ANY | MANN_WHITE | WILCOXON | KRUSKAL
+(`foremast-brain/README.md:34`), each gated on a minimum number of points
+(`deploy/foremast/3_brain/foremast-brain.yaml:74-79`).
+
+TPU-first design (SURVEY.md section 7 "hard parts" (a)): ranking under masks
+without host round-trips. Pairwise windows are short (the 10-minute analysis
+window at 60 s step is ~10-40 points), so tie-averaged ranks are computed
+from O(N^2) comparison matrices — pure VPU-friendly broadcasting, fully
+batched over [B], no sorting, no gather/scatter:
+
+    rank_i = (# valid j with x_j < x_i) + (1 + # valid j with x_j == x_i) / 2
+
+Tie corrections come for free: sum over elements of (t_i^2 - 1), where t_i is
+the size of element i's tie group, equals sum over groups of (t^3 - t).
+
+Each test returns (stat, p, ok): `ok` is False where the min-points gate
+fails; callers must treat gated-out tests as inconclusive (p forced to 1.0,
+i.e. "no evidence of distribution change"), matching the reference's
+behavior of skipping tests below their data-point minimums.
+
+p-values use the normal / chi-squared asymptotic approximations (golden-
+tested against scipy's `method="asymptotic"` paths).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import erfc, gammaincc
+
+_BIG = jnp.float32(3.0e38)
+
+
+def _normal_sf(z):
+    return 0.5 * erfc(z / jnp.sqrt(jnp.asarray(2.0, z.dtype)))
+
+
+def _chi2_sf(x, df):
+    """Survival function of chi^2 with `df` dof via the regularized upper
+    incomplete gamma function Q(df/2, x/2)."""
+    return gammaincc(df / 2.0, x / 2.0)
+
+
+def masked_ranks(values: jax.Array, mask: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Tie-averaged ranks among valid entries.
+
+    values: [B, N]; mask: [B, N].
+    Returns (ranks [B, N] — 0.0 at invalid positions, ranks 1..n at valid
+    ones; tie_term [B] — sum over tie groups of (t^3 - t), for variance
+    corrections).
+    """
+    x = jnp.where(mask, values, _BIG)  # park invalid entries far away
+    xi = x[..., :, None]  # [B, N, 1]
+    xj = x[..., None, :]  # [B, 1, N]
+    validj = mask[..., None, :]
+    less = ((xj < xi) & validj).astype(values.dtype)
+    equal = ((xj == xi) & validj).astype(values.dtype)
+    cnt_less = jnp.sum(less, axis=-1)  # [B, N]
+    cnt_eq = jnp.sum(equal, axis=-1)  # includes self
+    ranks = jnp.where(mask, cnt_less + (cnt_eq + 1.0) * 0.5, 0.0)
+    # sum_i (t_i^2 - 1) over valid i == sum_groups (t^3 - t)
+    tie_term = jnp.sum(jnp.where(mask, cnt_eq * cnt_eq - 1.0, 0.0), axis=-1)
+    return ranks, tie_term
+
+
+def mann_whitney_u(
+    x: jax.Array,
+    x_mask: jax.Array,
+    y: jax.Array,
+    y_mask: jax.Array,
+    min_points: int = 20,
+    use_continuity: bool = True,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Batched two-sided Mann-Whitney U (normal approximation, tie-corrected).
+
+    x: [B, Nx] current window, y: [B, Ny] baseline window, with masks.
+    Returns (U1 [B], p [B], ok [B]). Parity target:
+    scipy.stats.mannwhitneyu(method="asymptotic", use_continuity=True).
+    Gate: both samples need >= min_points valid points
+    (`MIN_MANN_WHITE_DATA_POINTS=20`, `foremast-brain.yaml:74-75`).
+    """
+    dtype = x.dtype
+    vals = jnp.concatenate([x, y], axis=-1)
+    mask = jnp.concatenate([x_mask, y_mask], axis=-1)
+    ranks, tie = masked_ranks(vals, mask)
+    nx = jnp.sum(x_mask, axis=-1).astype(dtype)
+    ny = jnp.sum(y_mask, axis=-1).astype(dtype)
+    n = nx + ny
+    r1 = jnp.sum(ranks[..., : x.shape[-1]] * x_mask, axis=-1)
+    u1 = r1 - nx * (nx + 1.0) / 2.0
+    mean = nx * ny / 2.0
+    tie_frac = tie / jnp.maximum(n * (n - 1.0), 1.0)
+    var = nx * ny / 12.0 * ((n + 1.0) - tie_frac)
+    sd = jnp.sqrt(jnp.maximum(var, 0.0))
+    cc = jnp.asarray(0.5 if use_continuity else 0.0, dtype)
+    z = (jnp.abs(u1 - mean) - cc) / jnp.maximum(sd, 1e-30)
+    z = jnp.maximum(z, 0.0)
+    p = jnp.clip(2.0 * _normal_sf(z), 0.0, 1.0)
+    ok = (nx >= min_points) & (ny >= min_points) & (sd > 0)
+    p = jnp.where(ok, p, 1.0)
+    return u1, p, ok
+
+
+def wilcoxon_signed_rank(
+    x: jax.Array,
+    x_mask: jax.Array,
+    y: jax.Array,
+    y_mask: jax.Array,
+    min_points: int = 20,
+    correction: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Batched two-sided Wilcoxon signed-rank test (paired; normal approx).
+
+    Pairs position-wise (both masks valid); zero differences are dropped
+    (scipy zero_method="wilcox"). Returns (W+ [B], p [B], ok [B]). Parity:
+    scipy.stats.wilcoxon(zero_method="wilcox", correction=False,
+    mode="approx"). Gate: `MIN_WILCOXON_DATA_POINTS=20`
+    (`foremast-brain.yaml:76-77`).
+    """
+    dtype = x.dtype
+    d = x - y
+    pair_mask = x_mask & y_mask
+    nz_mask = pair_mask & (d != 0.0)
+    ranks, tie = masked_ranks(jnp.abs(d), nz_mask)
+    n = jnp.sum(nz_mask, axis=-1).astype(dtype)
+    w_plus = jnp.sum(jnp.where(nz_mask & (d > 0), ranks, 0.0), axis=-1)
+    mean = n * (n + 1.0) / 4.0
+    var = n * (n + 1.0) * (2.0 * n + 1.0) / 24.0 - tie / 48.0
+    sd = jnp.sqrt(jnp.maximum(var, 0.0))
+    diff = w_plus - mean
+    cc = jnp.asarray(0.5 if correction else 0.0, dtype)
+    z = (jnp.abs(diff) - cc) / jnp.maximum(sd, 1e-30)
+    p = jnp.clip(2.0 * _normal_sf(z), 0.0, 1.0)
+    ok = (jnp.sum(pair_mask, axis=-1) >= min_points) & (n > 0) & (sd > 0)
+    p = jnp.where(ok, p, 1.0)
+    return w_plus, p, ok
+
+
+def kruskal_wallis(
+    x: jax.Array,
+    x_mask: jax.Array,
+    y: jax.Array,
+    y_mask: jax.Array,
+    min_points: int = 5,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Batched Kruskal-Wallis H test for two groups (chi^2 approximation).
+
+    Returns (H [B], p [B], ok [B]). Parity: scipy.stats.kruskal.
+    Gate: `MIN_KRUSKAL_DATA_POINTS=5` (`foremast-brain.yaml:78-79`).
+    """
+    dtype = x.dtype
+    vals = jnp.concatenate([x, y], axis=-1)
+    mask = jnp.concatenate([x_mask, y_mask], axis=-1)
+    ranks, tie = masked_ranks(vals, mask)
+    nx = jnp.sum(x_mask, axis=-1).astype(dtype)
+    ny = jnp.sum(y_mask, axis=-1).astype(dtype)
+    n = nx + ny
+    r1 = jnp.sum(ranks[..., : x.shape[-1]] * x_mask, axis=-1)
+    r2 = jnp.sum(ranks[..., x.shape[-1]:] * y_mask, axis=-1)
+    h = 12.0 / jnp.maximum(n * (n + 1.0), 1.0) * (
+        r1 * r1 / jnp.maximum(nx, 1.0) + r2 * r2 / jnp.maximum(ny, 1.0)
+    ) - 3.0 * (n + 1.0)
+    tie_corr = 1.0 - tie / jnp.maximum(n * n * n - n, 1.0)
+    # float32 rounding can leave H at a tiny negative for identical samples;
+    # gammaincc(df/2, h/2) NaNs on negative input, so clamp at 0 (p=1)
+    h = jnp.maximum(h / jnp.maximum(tie_corr, 1e-30), 0.0)
+    p = jnp.clip(_chi2_sf(h, jnp.asarray(1.0, dtype)), 0.0, 1.0)
+    ok = (nx >= min_points) & (ny >= min_points) & (tie_corr > 0)
+    p = jnp.where(ok, p, 1.0)
+    return h, p, ok

@@ -1,0 +1,96 @@
+"""Fixed-shape masked metric-window container.
+
+The reference brain processes one ragged time series per job (ES document ->
+N `query_range` URLs -> lists of points). On TPU, ragged data kills tiling,
+so the core container is a dense `[batch, T]` array plus a validity mask —
+ragged windows become masks (SURVEY.md section 7.1). All downstream ops
+(forecasters, rank tests, bounds) accept and respect the mask.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class MetricWindows:
+    """A batch of fixed-length metric windows.
+
+    values: [..., T] float32 — metric samples (padding arbitrary where invalid)
+    mask:   [..., T] bool    — True where the sample is real
+    times:  [..., T] int32   — unix seconds per sample (0 where invalid);
+            int32 because float32 ulp at current epochs is 128 s, which
+            would collapse adjacent 60 s samples. Carried for anomaly
+            reporting (the reference returns flat [t1,v1,t2,v2,...] pairs —
+            foremast-barrelman `pkg/controller/Barrelman.go:593-620`).
+    """
+
+    values: jax.Array
+    mask: jax.Array
+    times: jax.Array
+
+    @property
+    def batch_shape(self):
+        return self.values.shape[:-1]
+
+    @property
+    def length(self) -> int:
+        return self.values.shape[-1]
+
+    def count(self) -> jax.Array:
+        """Number of valid points per window, [...]."""
+        return jnp.sum(self.mask, axis=-1)
+
+    @staticmethod
+    def from_ragged(
+        series: Sequence[tuple[np.ndarray, np.ndarray]], length: int | None = None
+    ) -> "MetricWindows":
+        """Pack a list of (times, values) ragged series into one padded batch.
+
+        Host-side helper (numpy): used by the dispatcher when packing pending
+        jobs into fixed-shape batches (bucketing bounds recompiles).
+        """
+        if length is None:
+            length = max((len(v) for _, v in series), default=1)
+            length = max(length, 1)
+        b = len(series)
+        values = np.zeros((b, length), dtype=np.float32)
+        times = np.zeros((b, length), dtype=np.int32)
+        mask = np.zeros((b, length), dtype=bool)
+        for i, (t, v) in enumerate(series):
+            n = min(len(v), length)
+            values[i, :n] = np.asarray(v, dtype=np.float32)[:n]
+            times[i, :n] = np.asarray(t, dtype=np.int64)[:n].astype(np.int32)
+            mask[i, :n] = True
+        return MetricWindows(
+            values=jnp.asarray(values), mask=jnp.asarray(mask), times=jnp.asarray(times)
+        )
+
+
+def masked_mean(values: jax.Array, mask: jax.Array, axis: int = -1) -> jax.Array:
+    """Mean over valid points; 0.0 where a window has no valid points."""
+    m = mask.astype(values.dtype)
+    n = jnp.sum(m, axis=axis)
+    s = jnp.sum(values * m, axis=axis)
+    return jnp.where(n > 0, s / jnp.maximum(n, 1), 0.0)
+
+
+def masked_var(values: jax.Array, mask: jax.Array, axis: int = -1, ddof: int = 0) -> jax.Array:
+    """Variance over valid points (ddof degrees of freedom); 0.0 if too few."""
+    m = mask.astype(values.dtype)
+    n = jnp.sum(m, axis=axis)
+    mu = masked_mean(values, mask, axis=axis)
+    d = (values - jnp.expand_dims(mu, axis)) * m
+    ss = jnp.sum(d * d, axis=axis)
+    denom = n - ddof
+    return jnp.where(denom > 0, ss / jnp.maximum(denom, 1), 0.0)
+
+
+def masked_std(values: jax.Array, mask: jax.Array, axis: int = -1, ddof: int = 0) -> jax.Array:
+    return jnp.sqrt(masked_var(values, mask, axis=axis, ddof=ddof))

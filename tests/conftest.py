@@ -1,0 +1,60 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax import.
+
+Multi-chip hardware is unavailable in CI; sharding correctness is validated
+on `--xla_force_host_platform_device_count=8` CPU devices standing in for a
+v5e-8 (SURVEY.md section 4 implication). Benchmarks (bench.py) run on the
+real chip and do NOT import this file.
+"""
+
+import os
+
+# Hard override: the image's sitecustomize registers the `axon` TPU-tunnel
+# backend and exports JAX_PLATFORMS=axon; tests must never dial the tunnel
+# (single real chip, and CI has none), so force the CPU backend outright.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The sitecustomize already imported jax and called axon's register(), which
+# programmatically forces jax_platforms="axon,cpu" (overriding the env var).
+# Re-override the config BEFORE any backend initialization so tests never
+# dial the TPU tunnel.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def demo_traces():
+    """The reference demo's golden canary traces as (times, values) arrays.
+
+    data1: normal trace (~0.1-0.6); data2: same shape of traffic with
+    injected 40.134 / 40.466 spikes (reference
+    `examples/spring-boot-demo/src/main/resources/data{1,2}.txt`,
+    replayed by `FileErrorGenerator.java:27-37`).
+    """
+    here = os.path.dirname(__file__)
+    from datetime import datetime, timezone
+
+    def load(name):
+        ts, vs = [], []
+        with open(os.path.join(here, "data", name)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                t, v = line.split(",")
+                dt = datetime.strptime(t, "%Y-%m-%d %H:%M:%S").replace(
+                    tzinfo=timezone.utc
+                )
+                ts.append(int(dt.timestamp()))
+                vs.append(float(v))
+        return np.asarray(ts, dtype=np.int64), np.asarray(vs, dtype=np.float32)
+
+    return {"normal": load("demo_canary_normal.csv"), "spike": load("demo_canary_spike.csv")}

@@ -1,0 +1,189 @@
+"""Forecaster correctness: masked batched JAX vs plain-numpy references."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from foremast_tpu.ops import (
+    BOUND_BOTH,
+    BOUND_UPPER,
+    compute_bounds,
+    detect_anomalies,
+    double_exponential,
+    ewma,
+    fit_holt_winters,
+    holt_winters,
+    masked_mean,
+    masked_std,
+    moving_average,
+    moving_average_all,
+)
+from foremast_tpu.ops.forecasters import horizon
+
+RNG = np.random.default_rng(7)
+
+
+def _mk(values_list, n=64):
+    b = len(values_list)
+    v = np.zeros((b, n), dtype=np.float32)
+    m = np.zeros((b, n), dtype=bool)
+    for i, vals in enumerate(values_list):
+        v[i, : len(vals)] = vals
+        m[i, : len(vals)] = True
+    return jnp.asarray(v), jnp.asarray(m)
+
+
+def test_masked_moments():
+    x = RNG.normal(3, 2, 40).astype(np.float32)
+    v, m = _mk([x])
+    assert float(masked_mean(v, m)[0]) == pytest.approx(float(np.mean(x)), rel=1e-5)
+    assert float(masked_std(v, m)[0]) == pytest.approx(float(np.std(x)), rel=1e-4)
+
+
+def test_moving_average_all_is_global_mean_model():
+    x = RNG.normal(5, 1, 30).astype(np.float32)
+    y = RNG.normal(-2, 4, 50).astype(np.float32)
+    v, m = _mk([x, y])
+    fc = moving_average_all(v, m)
+    np.testing.assert_allclose(
+        np.asarray(fc.level), [np.mean(x), np.mean(y)], rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(fc.scale), [np.std(x), np.std(y)], rtol=1e-4
+    )
+    # prediction is flat at the mean, horizon too
+    h = horizon(fc, 5)
+    np.testing.assert_allclose(np.asarray(h[0]), np.full(5, np.mean(x)), rtol=1e-5)
+
+
+def test_ewma_matches_sequential_reference():
+    x = RNG.normal(0, 1, 45).astype(np.float32)
+    alpha = 0.3
+    v, m = _mk([x])
+    fc = ewma(v, m, alpha=alpha)
+    # sequential reference
+    level = x[0]
+    preds = [x[0]]
+    for t in range(1, len(x)):
+        preds.append(level)
+        level = alpha * x[t] + (1 - alpha) * level
+    np.testing.assert_allclose(
+        np.asarray(fc.pred)[0, : len(x)], np.asarray(preds), rtol=1e-4, atol=1e-5
+    )
+    assert float(fc.level[0]) == pytest.approx(float(level), rel=1e-4)
+
+
+def test_ewma_mask_skips_gaps():
+    """A masked-out gap must not perturb the level (carry-through)."""
+    x = RNG.normal(0, 1, 30).astype(np.float32)
+    v_full, m_full = _mk([x], n=40)
+    # same points with a hole punched mid-way: indices 10..14 invalid
+    v_gap = np.asarray(v_full).copy()
+    m_gap = np.asarray(m_full).copy()
+    v_gap[0, 10:15] = 1e6  # garbage where invalid
+    m_gap[0, 10:15] = False
+    fc_gap = ewma(jnp.asarray(v_gap), jnp.asarray(m_gap), alpha=0.3)
+    # reference: run sequentially on the surviving points
+    kept = [x[i] for i in range(30) if not (10 <= i < 15)]
+    level = kept[0]
+    for t in range(1, len(kept)):
+        level = 0.3 * kept[t] + 0.7 * level
+    assert float(fc_gap.level[0]) == pytest.approx(level, rel=1e-4)
+
+
+def test_double_exponential_tracks_linear_trend():
+    t = np.arange(60, dtype=np.float32)
+    x = 2.0 + 0.5 * t
+    v, m = _mk([x], n=60)
+    fc = double_exponential(v, m, alpha=0.5, beta=0.3)
+    # on a clean line, the trend estimate converges to the true slope
+    assert float(fc.trend[0]) == pytest.approx(0.5, abs=0.05)
+    h = horizon(fc, 4)
+    expected = x[-1] + 0.5 * np.arange(1, 5)
+    np.testing.assert_allclose(np.asarray(h)[0], expected, rtol=0.05)
+
+
+def test_holt_winters_learns_seasonality():
+    m_len = 12
+    t = np.arange(m_len * 20, dtype=np.float32)
+    season = np.sin(2 * np.pi * t / m_len).astype(np.float32)
+    x = 10.0 + season + RNG.normal(0, 0.05, len(t)).astype(np.float32)
+    v, m = _mk([x], n=len(t))
+    fc = holt_winters(v, m, season_length=m_len, alpha=0.3, beta=0.01, gamma=0.3)
+    # residual scale must be close to noise level, far below seasonal amplitude
+    assert float(fc.scale[0]) < 0.25
+    # horizon continues the seasonal pattern
+    h = np.asarray(horizon(fc, m_len))[0]
+    expected = 10.0 + np.sin(2 * np.pi * (t[-1] + 1 + np.arange(m_len)) / m_len)
+    np.testing.assert_allclose(h, expected, atol=0.5)
+
+
+def test_fit_holt_winters_beats_default_on_noisy_seasonal():
+    m_len = 8
+    t = np.arange(m_len * 16, dtype=np.float32)
+    x = (5 + 3 * np.cos(2 * np.pi * t / m_len) + RNG.normal(0, 0.1, len(t))).astype(
+        np.float32
+    )
+    v, m = _mk([x, x], n=len(t))
+    fit = fit_holt_winters(v, m, season_length=m_len)
+    assert float(fit.scale[0]) < 0.6
+    # batch consistency: identical series pick identical params/results
+    np.testing.assert_allclose(np.asarray(fit.pred)[0], np.asarray(fit.pred)[1])
+
+
+def test_moving_average_rolling_window():
+    x = np.arange(20, dtype=np.float32)
+    v, m = _mk([x], n=20)
+    fc = moving_average(v, m, window=4)
+    # at t=10: mean of x[6..9] = 7.5
+    assert float(fc.pred[0, 10]) == pytest.approx(7.5)
+    # terminal level: mean of last 4 points
+    assert float(fc.level[0]) == pytest.approx(np.mean(x[-4:]))
+
+
+def test_bounds_and_detection_golden_trace(demo_traces):
+    """moving_average_all on the normal trace must flag the 40.134/40.466
+    spikes in the spike trace; at the cpu/memory-class threshold (5.0,
+    reference `foremast-brain.yaml:56-73`) the normal trace stays clean.
+    At the global default threshold 2.0 the spikes must still be flagged."""
+    _, normal = demo_traces["normal"]
+    _, spike = demo_traces["spike"]
+    hist_v, hist_m = _mk([normal, normal], n=48)
+    cur_v, cur_m = _mk([normal, spike], n=48)
+    fc = moving_average_all(hist_v, hist_m)
+    pred = jnp.broadcast_to(fc.level[:, None], cur_v.shape)
+    upper, lower = compute_bounds(pred, fc.scale, threshold=5.0, min_lower_bound=0.0)
+    flags = detect_anomalies(cur_v, cur_m, upper, lower, bound=BOUND_UPPER)
+    n_anoms = np.asarray(jnp.sum(flags, axis=-1))
+    assert n_anoms[0] == 0, "normal trace must be clean at threshold 5"
+    assert n_anoms[1] == 2, "exactly the two 40.x spikes must be flagged"
+    flagged_vals = np.asarray(cur_v)[1][np.asarray(flags)[1]]
+    assert np.all(flagged_vals > 10)
+    # global default threshold also catches the spikes
+    upper2, lower2 = compute_bounds(pred, fc.scale, threshold=2.0)
+    flags2 = detect_anomalies(cur_v, cur_m, upper2, lower2, bound=BOUND_UPPER)
+    assert np.asarray(jnp.sum(flags2, axis=-1))[1] >= 2
+
+
+def test_bound_selector_lower_and_both():
+    hist = RNG.normal(10, 1, 40).astype(np.float32)
+    cur = np.array([10.0, 2.0, 18.0], dtype=np.float32)
+    hv, hm = _mk([hist], n=40)
+    cv, cm = _mk([cur], n=40)
+    fc = moving_average_all(hv, hm)
+    pred = jnp.broadcast_to(fc.level[:, None], cv.shape)
+    upper, lower = compute_bounds(pred, fc.scale, threshold=3.0)
+    both = detect_anomalies(cv, cm, upper, lower, bound=BOUND_BOTH)
+    up_only = detect_anomalies(cv, cm, upper, lower, bound=BOUND_UPPER)
+    assert np.asarray(both)[0, :3].tolist() == [False, True, True]
+    assert np.asarray(up_only)[0, :3].tolist() == [False, False, True]
+
+
+def test_min_lower_bound_floors_lower():
+    hist = RNG.normal(0.2, 0.5, 40).astype(np.float32)
+    hv, hm = _mk([hist], n=40)
+    fc = moving_average_all(hv, hm)
+    pred = jnp.broadcast_to(fc.level[:, None], hv.shape)
+    _, lower = compute_bounds(pred, fc.scale, threshold=5.0, min_lower_bound=0.0)
+    assert float(jnp.min(lower)) >= 0.0
