@@ -1,0 +1,37 @@
+"""Batched TPU health-judgment engine (the reference brain's core)."""
+
+from foremast_tpu.engine.scoring import (
+    AI_MODEL,
+    HEALTHY,
+    UNHEALTHY,
+    UNKNOWN,
+    ScoreBatch,
+    ScoreResult,
+    pairwise_decision,
+    register_model,
+    score,
+)
+from foremast_tpu.engine.judge import (
+    HealthJudge,
+    MetricTask,
+    MetricVerdict,
+    bucket_length,
+    combine_verdicts,
+)
+
+__all__ = [
+    "AI_MODEL",
+    "HEALTHY",
+    "UNHEALTHY",
+    "UNKNOWN",
+    "ScoreBatch",
+    "ScoreResult",
+    "pairwise_decision",
+    "register_model",
+    "score",
+    "HealthJudge",
+    "MetricTask",
+    "MetricVerdict",
+    "bucket_length",
+    "combine_verdicts",
+]

@@ -1,0 +1,186 @@
+"""Host-side wrapper: ragged jobs in, reference-wire verdicts out.
+
+Bridges the untyped job plane (ES documents with per-alias ragged series)
+and the fixed-shape jitted scorer (`engine.scoring.score`). Responsibilities
+(SURVEY.md section 7.4): pack pending metric windows into fixed-shape
+batches (bucketing by window length to bound recompiles), gather the
+per-metric-type config table into dense operand vectors, run the compiled
+program, and decode results into the reference's wire format — anomalies as
+flat `[t1, v1, t2, v2, ...]` pairs (decoded by the Go side at
+`foremast-barrelman/pkg/controller/Barrelman.go:593-620`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from foremast_tpu.config import BrainConfig
+from foremast_tpu.engine import scoring
+from foremast_tpu.ops.windows import MetricWindows
+
+# Bucket window lengths to powers of two >= 8 so XLA compiles a handful of
+# shapes total, not one per ragged job (SURVEY.md "hard parts" (b)).
+_MIN_BUCKET = 8
+
+
+def bucket_length(n: int) -> int:
+    b = _MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricTask:
+    """One metric of one job, host-side ragged form.
+
+    times/values arrays for historical, current and (optionally) baseline
+    windows; metric_type selects the threshold row (error5xx/latency/...).
+    """
+
+    job_id: str
+    alias: str
+    metric_type: str | None
+    hist_times: np.ndarray
+    hist_values: np.ndarray
+    cur_times: np.ndarray
+    cur_values: np.ndarray
+    base_times: np.ndarray | None = None
+    base_values: np.ndarray | None = None
+
+    def __post_init__(self):
+        if (self.base_times is None) != (self.base_values is None):
+            raise ValueError("base_times and base_values must be set together")
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricVerdict:
+    """Judgment for one metric, in wire-friendly form."""
+
+    job_id: str
+    alias: str
+    verdict: int  # scoring.HEALTHY / UNHEALTHY / UNKNOWN
+    anomaly_pairs: list[float]  # flat [t1, v1, t2, v2, ...]
+    upper: np.ndarray  # [Tc] model band (gauge export)
+    lower: np.ndarray
+    p_value: float
+    dist_differs: bool
+
+
+class HealthJudge:
+    """Batched scorer with reference-parity config semantics."""
+
+    def __init__(self, config: BrainConfig | None = None):
+        self.config = config or BrainConfig()
+
+    def judge(self, tasks: Sequence[MetricTask]) -> list[MetricVerdict]:
+        """Score a set of metric tasks, batching same-shaped buckets."""
+        if not tasks:
+            return []
+        # Bucket by (hist_len_bucket, cur_len_bucket) to bound recompiles.
+        buckets: dict[tuple[int, int], list[int]] = {}
+        for i, t in enumerate(tasks):
+            key = (
+                bucket_length(len(t.hist_values)),
+                bucket_length(
+                    max(
+                        len(t.cur_values),
+                        0 if t.base_values is None else len(t.base_values),
+                    )
+                ),
+            )
+            buckets.setdefault(key, []).append(i)
+
+        out: list[MetricVerdict | None] = [None] * len(tasks)
+        for (th, tc), idxs in buckets.items():
+            chunk = [tasks[i] for i in idxs]
+            for v, i in zip(self._judge_bucket(chunk, th, tc), idxs):
+                out[i] = v
+        return [v for v in out if v is not None]
+
+    def _judge_bucket(
+        self, tasks: list[MetricTask], th: int, tc: int
+    ) -> list[MetricVerdict]:
+        cfg = self.config
+        hist = MetricWindows.from_ragged(
+            [(t.hist_times, t.hist_values) for t in tasks], th
+        )
+        cur = MetricWindows.from_ragged(
+            [(t.cur_times, t.cur_values) for t in tasks], tc
+        )
+        empty = (np.zeros(0, np.int64), np.zeros(0, np.float32))
+        base = MetricWindows.from_ragged(
+            [
+                (t.base_times, t.base_values)
+                if t.base_values is not None
+                else empty
+                for t in tasks
+            ],
+            tc,
+        )
+        thr, bound, mlb = cfg.anomaly.gather([t.metric_type for t in tasks])
+        batch = scoring.ScoreBatch(
+            historical=hist,
+            current=cur,
+            baseline=base,
+            threshold=jnp.asarray(thr),
+            bound=jnp.asarray(bound),
+            min_lower_bound=jnp.asarray(mlb),
+            min_points=jnp.full((len(tasks),), cfg.min_historical_points, jnp.int32),
+        )
+        res = scoring.score(
+            batch,
+            algorithm=cfg.algorithm,
+            pairwise_algorithm=cfg.pairwise.algorithm,
+            p_threshold=cfg.pairwise.threshold,
+            min_mw=cfg.pairwise.min_mann_white_points,
+            min_wilcoxon=cfg.pairwise.min_wilcoxon_points,
+            min_kruskal=cfg.pairwise.min_kruskal_points,
+        )
+        verdicts = np.asarray(res.verdict)
+        anoms = np.asarray(res.anomalies)
+        uppers = np.asarray(res.upper)
+        lowers = np.asarray(res.lower)
+        ps = np.asarray(res.p_value)
+        differs = np.asarray(res.dist_differs)
+
+        out = []
+        for i, t in enumerate(tasks):
+            n = len(t.cur_values)
+            # flat [t, v, ...] pairs — barrelman's convertToAnomaly format
+            # (Barrelman.go:605-615)
+            idx = np.nonzero(anoms[i, :n])[0]
+            flat = np.empty(2 * len(idx), dtype=np.float64)
+            flat[0::2] = np.asarray(t.cur_times)[idx]
+            flat[1::2] = np.asarray(t.cur_values)[idx]
+            pairs = flat.tolist()
+            out.append(
+                MetricVerdict(
+                    job_id=t.job_id,
+                    alias=t.alias,
+                    verdict=int(verdicts[i]),
+                    anomaly_pairs=pairs,
+                    upper=uppers[i, :n].copy(),
+                    lower=lowers[i, :n].copy(),
+                    p_value=float(ps[i]),
+                    dist_differs=bool(differs[i]),
+                )
+            )
+        return out
+
+
+def combine_verdicts(verdicts: Sequence[MetricVerdict]) -> int:
+    """Job-level verdict: fail-fast — any unhealthy metric makes the job
+    unhealthy (`design.md:43`); all-unknown stays unknown."""
+    if not verdicts:
+        return scoring.UNKNOWN
+    vs = [v.verdict for v in verdicts]
+    if any(v == scoring.UNHEALTHY for v in vs):
+        return scoring.UNHEALTHY
+    if all(v == scoring.UNKNOWN for v in vs):
+        return scoring.UNKNOWN
+    return scoring.HEALTHY

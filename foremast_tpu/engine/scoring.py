@@ -1,0 +1,247 @@
+"""The batched health-judgment engine — the reference brain's hot loop,
+re-centered as one jitted TPU program.
+
+Reference semantics being reproduced (`foremast-brain/README.md:5-11`,
+`docs/guides/design.md:31-33`):
+  1. compute the historical model from the 7-day window;
+  2. for canary strategies, run pairwise same-distribution tests between
+     baseline and current (Mann-Whitney / Wilcoxon / Kruskal, combinable
+     via ML_PAIRWISE_ALGORITHM);
+  3. if the distributions differ, *lower the threshold*;
+  4. threshold-based anomaly detection of current points against the
+     historical model's bounds (per-metric-type threshold/bound matrix,
+     `foremast-brain.yaml:26-73`);
+  5. fail fast: any anomaly -> unhealthy (`design.md:43`).
+
+TPU-first re-design: instead of one job at a time on a CPU sliver, the
+whole (service x metric) population is one `[B, T]` batch; every step above
+is a masked array op, and the entire judgment is a single `jax.jit`
+program. Ragged windows are validity masks; per-metric-type config rows are
+gathered into dense `[B]` operand vectors host-side (config.AnomalyConfig
+.gather); strategy/bound/algorithm switches are `jnp.where` selects, not
+Python branches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from foremast_tpu.config import (
+    PAIRWISE_ALL,
+    PAIRWISE_ANY,
+    PAIRWISE_KRUSKAL,
+    PAIRWISE_MANN_WHITE,
+    PAIRWISE_WILCOXON,
+)
+from foremast_tpu.ops.anomaly import compute_bounds, detect_anomalies
+from foremast_tpu.ops.forecasters import (
+    Forecast,
+    double_exponential,
+    ewma,
+    fit_holt_winters,
+    horizon,
+    moving_average,
+    moving_average_all,
+)
+from foremast_tpu.ops.ranks import kruskal_wallis, mann_whitney_u, wilcoxon_signed_rank
+from foremast_tpu.ops.windows import MetricWindows
+
+# Verdict codes (map onto the ES status machine, converter.go:13-26:
+# HEALTHY -> completed_health, UNHEALTHY -> completed_unhealth,
+# UNKNOWN -> completed_unknown).
+HEALTHY = 0
+UNHEALTHY = 1
+UNKNOWN = 2
+
+# The model registry — the reference's "AI_MODEL" table lives in
+# `src/models/modelclass.py` of the external brain repo
+# (`foremast-brain/README.md:22`); deployed default is `moving_average_all`
+# (`foremast-brain.yaml:24-25`). Each entry: (values, mask) -> Forecast.
+AI_MODEL = {
+    "moving_average_all": moving_average_all,
+    "moving_average": moving_average,
+    "ewma": ewma,
+    "exponential_smoothing": ewma,
+    "double_exponential_smoothing": double_exponential,
+    "holtwinters": fit_holt_winters,
+    "holt_winters": fit_holt_winters,
+}
+
+
+def register_model(name: str, fit_fn) -> None:
+    """Extend the registry (used by models/ for seasonal + learned models)."""
+    AI_MODEL[name] = fit_fn
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ScoreBatch:
+    """One fixed-shape batch of scoring work.
+
+    historical: [B, Th] 7-day model window (60 s step, ~10,080 pts max)
+    current:    [B, Tc] the window under judgment
+    baseline:   [B, Tc] pre-deploy window (mask all-False when absent —
+                rollingUpdate strategy has no baseline, metricsquery.go:111-116)
+    threshold/bound/min_lower_bound: [B] per-window config vectors
+    min_points: [B] minimum historical points to measure at all
+    """
+
+    historical: MetricWindows
+    current: MetricWindows
+    baseline: MetricWindows
+    threshold: jax.Array
+    bound: jax.Array
+    min_lower_bound: jax.Array
+    min_points: jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ScoreResult:
+    """Batched judgment output.
+
+    verdict:  [B] int32 (0 healthy / 1 unhealthy / 2 unknown)
+    anomalies:[B, Tc] bool — which current points breached bounds
+    upper/lower: [B, Tc] the model band over the current window (published
+                 as foremastbrain:*_{upper,lower} gauges)
+    p_value:  [B] combined pairwise p (1.0 when no baseline)
+    dist_differs: [B] bool — pairwise tests rejected same-distribution
+    """
+
+    verdict: jax.Array
+    anomalies: jax.Array
+    upper: jax.Array
+    lower: jax.Array
+    p_value: jax.Array
+    dist_differs: jax.Array
+
+
+def pairwise_decision(
+    current: MetricWindows,
+    baseline: MetricWindows,
+    algorithm: str,
+    p_threshold: float,
+    min_mw: int,
+    min_wilcoxon: int,
+    min_kruskal: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Combined same-distribution decision, [B] (p_combined, differs).
+
+    ALL = every applicable test must reject to call it different;
+    ANY = one rejection suffices (`foremast-brain/README.md:34`). Tests
+    whose min-points gate fails are inconclusive (p=1, not counted).
+    """
+    x, xm = current.values, current.mask
+    y, ym = baseline.values, baseline.mask
+    _, p_mw, ok_mw = mann_whitney_u(x, xm, y, ym, min_points=min_mw)
+    _, p_wx, ok_wx = wilcoxon_signed_rank(x, xm, y, ym, min_points=min_wilcoxon)
+    _, p_kw, ok_kw = kruskal_wallis(x, xm, y, ym, min_points=min_kruskal)
+
+    rej_mw = ok_mw & (p_mw < p_threshold)
+    rej_wx = ok_wx & (p_wx < p_threshold)
+    rej_kw = ok_kw & (p_kw < p_threshold)
+
+    if algorithm == PAIRWISE_MANN_WHITE:
+        differs, p = rej_mw, p_mw
+    elif algorithm == PAIRWISE_WILCOXON:
+        differs, p = rej_wx, p_wx
+    elif algorithm == PAIRWISE_KRUSKAL:
+        differs, p = rej_kw, p_kw
+    elif algorithm == PAIRWISE_ANY:
+        differs = rej_mw | rej_wx | rej_kw
+        p = jnp.minimum(jnp.minimum(p_mw, p_wx), p_kw)
+    elif algorithm == PAIRWISE_ALL:
+        any_ok = ok_mw | ok_wx | ok_kw
+        all_rej = (
+            (rej_mw | ~ok_mw) & (rej_wx | ~ok_wx) & (rej_kw | ~ok_kw)
+        )
+        differs = any_ok & all_rej
+        # max over *applicable* tests only: gated-out tests have p forced to
+        # 1.0 and would otherwise mask a rejection in the published p
+        p = jnp.maximum(
+            jnp.maximum(
+                jnp.where(ok_mw, p_mw, 0.0), jnp.where(ok_wx, p_wx, 0.0)
+            ),
+            jnp.where(ok_kw, p_kw, 0.0),
+        )
+        p = jnp.where(any_ok, p, 1.0)
+    else:  # pragma: no cover - config validates
+        raise ValueError(f"unknown pairwise algorithm {algorithm!r}")
+    return p, differs
+
+
+# Threshold multiplier applied when baseline and current distributions
+# differ ("lower the threshold", design.md:33): tighter bounds => more
+# sensitive detection during a suspicious canary.
+DIFF_THRESHOLD_FACTOR = 0.5
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "algorithm",
+        "pairwise_algorithm",
+        "p_threshold",
+        "min_mw",
+        "min_wilcoxon",
+        "min_kruskal",
+    ),
+)
+def score(
+    batch: ScoreBatch,
+    algorithm: str = "moving_average_all",
+    pairwise_algorithm: str = PAIRWISE_ALL,
+    p_threshold: float = 0.05,
+    min_mw: int = 20,
+    min_wilcoxon: int = 20,
+    min_kruskal: int = 5,
+) -> ScoreResult:
+    """Judge a whole batch in one compiled program (call stack 3.2 of
+    SURVEY.md collapsed into array ops)."""
+    hist = batch.historical
+    cur = batch.current
+    base = batch.baseline
+
+    p, differs = pairwise_decision(
+        cur,
+        base,
+        pairwise_algorithm,
+        p_threshold,
+        min_mw,
+        min_wilcoxon,
+        min_kruskal,
+    )
+
+    fit = AI_MODEL[algorithm]
+    fc: Forecast = fit(hist.values, hist.mask)
+    pred = horizon(fc, cur.length)  # [B, Tc] forecast over current window
+
+    eff_threshold = jnp.where(
+        differs, batch.threshold * DIFF_THRESHOLD_FACTOR, batch.threshold
+    )
+    upper, lower = compute_bounds(pred, fc.scale, eff_threshold, batch.min_lower_bound)
+    anomalies = detect_anomalies(cur.values, cur.mask, upper, lower, batch.bound)
+
+    n_hist = hist.count()
+    n_cur = cur.count()
+    measurable = (n_hist >= batch.min_points) & (n_cur > 0)
+    any_anom = jnp.any(anomalies, axis=-1)
+    verdict = jnp.where(
+        measurable,
+        jnp.where(any_anom, UNHEALTHY, HEALTHY),
+        UNKNOWN,
+    ).astype(jnp.int32)
+    # anomalies only count when measurable (unknown windows report none)
+    anomalies = anomalies & measurable[:, None]
+    return ScoreResult(
+        verdict=verdict,
+        anomalies=anomalies,
+        upper=upper,
+        lower=lower,
+        p_value=p,
+        dist_differs=differs,
+    )

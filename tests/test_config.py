@@ -1,0 +1,73 @@
+"""Config env-parity tests (reference `foremast-brain.yaml:21-81`)."""
+
+import numpy as np
+
+from foremast_tpu.config import (
+    AnomalyConfig,
+    BrainConfig,
+    MetricTypeRule,
+    PAIRWISE_ANY,
+)
+from foremast_tpu.ops.anomaly import BOUND_BOTH, BOUND_UPPER
+
+
+def test_defaults_match_deployed_values():
+    cfg = BrainConfig()
+    assert cfg.algorithm == "moving_average_all"
+    assert cfg.anomaly.threshold == 2.0
+    assert cfg.anomaly.bound == BOUND_UPPER
+    assert cfg.max_stuck_seconds == 90.0
+    assert cfg.pairwise.min_mann_white_points == 20
+    assert cfg.pairwise.min_wilcoxon_points == 20
+    assert cfg.pairwise.min_kruskal_points == 5
+    # deployed per-type matrix rows (foremast-brain.yaml:32-73)
+    assert cfg.anomaly.rule_for("error5xx").threshold == 2.0
+    assert cfg.anomaly.rule_for("error4xx").threshold == 3.0
+    assert cfg.anomaly.rule_for("latency").threshold == 10.0
+    assert cfg.anomaly.rule_for("cpu").threshold == 5.0
+    assert cfg.anomaly.rule_for("memory").threshold == 5.0
+
+
+def test_from_env_indexed_metric_type_family():
+    env = {
+        "ML_ALGORITHM": "ewma",
+        "threshold": "2.5",
+        "bound": "1",
+        "min_lower_bound": "0",
+        "metric_type_threshold_count": "2",
+        "metric_type0": "error5xx",
+        "threshold0": "2",
+        "bound0": "upper",
+        "metric_type1": "latency",
+        "threshold1": "10",
+        "bound1": "both",
+        "min_lower_bound1": "0.5",
+        "ML_PAIRWISE_ALGORITHM": "any",
+        "MIN_MANN_WHITE_DATA_POINTS": "15",
+        "MAX_STUCK_IN_SECONDS": "120",
+        "ES_ENDPOINT": "http://es:9200",
+    }
+    cfg = BrainConfig.from_env(env)
+    assert cfg.algorithm == "ewma"
+    assert cfg.anomaly.threshold == 2.5
+    assert len(cfg.anomaly.rules) == 2
+    lat = cfg.anomaly.rule_for("latency")
+    assert lat.threshold == 10.0 and lat.bound == BOUND_BOTH
+    assert lat.min_lower_bound == 0.5
+    # unknown type falls back to globals
+    unk = cfg.anomaly.rule_for("tps")
+    assert unk.threshold == 2.5
+    assert cfg.pairwise.algorithm == PAIRWISE_ANY
+    assert cfg.pairwise.min_mann_white_points == 15
+    assert cfg.max_stuck_seconds == 120.0
+    assert cfg.es_endpoint == "http://es:9200"
+
+
+def test_gather_builds_dense_vectors():
+    ac = AnomalyConfig(
+        rules=(MetricTypeRule("latency", 10.0, BOUND_BOTH, 0.25),)
+    )
+    thr, bound, mlb = ac.gather(["latency", None, "cpu"])
+    np.testing.assert_allclose(thr, [10.0, 2.0, 2.0])
+    np.testing.assert_array_equal(bound, [BOUND_BOTH, BOUND_UPPER, BOUND_UPPER])
+    np.testing.assert_allclose(mlb, [0.25, 0.0, 0.0])
