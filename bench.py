@@ -1,0 +1,62 @@
+"""Headline benchmark: metric-windows scored per second, single chip.
+
+BASELINE.md north star: 100k concurrent metric-windows/sec on a v5e-8 →
+per-chip share 12,500 windows/sec (`vs_baseline` is measured/12,500). The
+workload is BASELINE.md config 5 shaped: full pipeline per window —
+pairwise rank tests (Mann-Whitney + Wilcoxon + Kruskal) on baseline vs
+current, historical model fit over the 7-day window (10,080 points at the
+60 s step, `metricsquery.go:75-77`), bounds, anomaly flags, verdict.
+
+Prints ONE JSON line. Runs on whatever backend jax selects (the driver
+provides the real TPU); BENCH_SMALL=1 shrinks shapes for CPU smoke runs.
+"""
+
+import json
+import os
+import time
+
+import jax
+
+from foremast_tpu.engine import scoring
+from foremast_tpu.parallel.batch import throughput_batch
+
+SMALL = os.environ.get("BENCH_SMALL") == "1"
+B = 512 if SMALL else 4096
+HIST = 512 if SMALL else 10080  # 7-day window at 60 s step
+CUR = 30  # 30-min current window
+ITERS = 3 if SMALL else 10
+PER_CHIP_BASELINE = 100_000 / 8  # north-star v5e-8 target, per chip
+
+
+def main():
+    batch = throughput_batch(B, HIST, CUR)
+    batch = jax.device_put(batch)
+
+    def run(b):
+        return scoring.score(b)
+
+    # compile + warm up
+    res = run(batch)
+    jax.block_until_ready(res.verdict)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        res = run(batch)
+    jax.block_until_ready(res.verdict)
+    dt = time.perf_counter() - t0
+
+    windows_per_sec = B * ITERS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "metric_windows_per_sec",
+                "value": round(windows_per_sec, 1),
+                "unit": "windows/s",
+                "vs_baseline": round(windows_per_sec / PER_CHIP_BASELINE, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

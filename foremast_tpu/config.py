@@ -186,10 +186,11 @@ class BrainConfig:
                     min_lower_bound=float(geti("min_lower_bound", i, 0.0)),
                 )
             )
+        raw_bound = e.get("ML_BOUND") or e.get("bound") or 1  # "" counts unset
         anomaly = AnomalyConfig(
-            threshold=get("threshold", 2.0),
+            threshold=get("ML_THRESHOLD", get("threshold", 2.0)),
             min_lower_bound=get("min_lower_bound", 0.0),
-            bound=_parse_bound(e.get("ML_BOUND", e.get("bound", 1))),
+            bound=_parse_bound(raw_bound),
             rules=tuple(rules) if rules else _DEFAULT_RULES,
         )
         pairwise = PairwiseConfig(

@@ -102,6 +102,11 @@ class HealthJudge:
                 out[i] = v
         return [v for v in out if v is not None]
 
+    def _place(self, batch: scoring.ScoreBatch) -> scoring.ScoreBatch:
+        """Device-placement hook — identity here (default device);
+        parallel.ShardedJudge overrides it to shard over the mesh."""
+        return batch
+
     def _judge_bucket(
         self, tasks: list[MetricTask], th: int, tc: int
     ) -> list[MetricVerdict]:
@@ -132,6 +137,7 @@ class HealthJudge:
             min_lower_bound=jnp.asarray(mlb),
             min_points=jnp.full((len(tasks),), cfg.min_historical_points, jnp.int32),
         )
+        batch = self._place(batch)
         res = scoring.score(
             batch,
             algorithm=cfg.algorithm,

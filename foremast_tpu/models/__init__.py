@@ -1,0 +1,64 @@
+"""Learned / structural detectors beyond the core forecasters.
+
+Registers the seasonal (Prophet-substitute) model into the engine's
+AI_MODEL registry; the LSTM-AE and bivariate detectors have train/fit
+interfaces of their own and are dispatched explicitly by the worker.
+"""
+
+from functools import partial
+
+from foremast_tpu.engine.scoring import register_model
+from foremast_tpu.models.bivariate import (
+    BivariateFit,
+    detect_bivariate,
+    fit_bivariate,
+    mahalanobis2,
+)
+from foremast_tpu.models.cache import ModelCache
+from foremast_tpu.models.lstm_ae import (
+    AEParams,
+    LSTMAEConfig,
+    LSTMParams,
+    fit_many,
+    init,
+    init_many,
+    recon_error,
+    reconstruct,
+    score_many,
+    train_step,
+    train_step_many,
+)
+from foremast_tpu.models.lstm_ae import shardings as _lstm_ae_shardings
+from foremast_tpu.models.seasonal import fit_seasonal
+
+
+def lstm_ae_shardings(mesh, params, opt_state):
+    """Shardings for stacked LSTM-AE params (hidden inferred from w_h)."""
+    hidden = params.enc.w_h.shape[1]
+    return _lstm_ae_shardings(mesh, params, opt_state, hidden)
+
+register_model("seasonal", fit_seasonal)
+register_model("prophet", fit_seasonal)  # documented substitution, see seasonal.py
+# hourly seasonality variant (60 steps at the 60 s PromQL step)
+register_model("seasonal_hourly", partial(fit_seasonal, period=60, order=2))
+
+__all__ = [
+    "BivariateFit",
+    "detect_bivariate",
+    "fit_bivariate",
+    "mahalanobis2",
+    "ModelCache",
+    "AEParams",
+    "LSTMAEConfig",
+    "LSTMParams",
+    "fit_many",
+    "init",
+    "init_many",
+    "recon_error",
+    "reconstruct",
+    "score_many",
+    "train_step",
+    "train_step_many",
+    "fit_seasonal",
+    "lstm_ae_shardings",
+]

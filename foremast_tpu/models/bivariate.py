@@ -1,0 +1,88 @@
+"""Bivariate normal detector for 2-metric jobs.
+
+Reference model zoo: "2 metrics: Bivariate Normal Distribution"
+(`docs/guides/design.md:78`). The historical joint distribution of two
+metrics (e.g. latency x tps) is fit as a 2-D Gaussian; current points are
+scored by Mahalanobis distance, anomalous where d^2 exceeds the chi^2(2)
+quantile implied by the configured threshold.
+
+Batched closed-form fit — means/covariances are masked moment sums over the
+[B, T] history, the 2x2 inverse is explicit (no linalg solve inside jit),
+so the whole detector is a handful of fused VPU ops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from foremast_tpu.ops.windows import masked_mean
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class BivariateFit:
+    """mean: [B, 2]; cov: [B, 2, 2]; valid: [B] (enough points, non-singular)."""
+
+    mean: jax.Array
+    cov: jax.Array
+    valid: jax.Array
+
+
+def fit_bivariate(
+    x: jax.Array, y: jax.Array, mask: jax.Array, min_points: int = 10
+) -> BivariateFit:
+    """Fit a 2-D Gaussian to paired histories. x/y/mask: [B, T]."""
+    mx = masked_mean(x, mask)
+    my = masked_mean(y, mask)
+    m = mask.astype(x.dtype)
+    n = jnp.sum(m, axis=-1)
+    dx = (x - mx[:, None]) * m
+    dy = (y - my[:, None]) * m
+    denom = jnp.maximum(n, 1.0)
+    sxx = jnp.sum(dx * dx, axis=-1) / denom
+    syy = jnp.sum(dy * dy, axis=-1) / denom
+    sxy = jnp.sum(dx * dy, axis=-1) / denom
+    mean = jnp.stack([mx, my], axis=-1)
+    cov = jnp.stack(
+        [jnp.stack([sxx, sxy], -1), jnp.stack([sxy, syy], -1)], axis=-2
+    )
+    det = sxx * syy - sxy * sxy
+    valid = (n >= min_points) & (det > 1e-12)
+    return BivariateFit(mean=mean, cov=cov, valid=valid)
+
+
+def mahalanobis2(fit: BivariateFit, x: jax.Array, y: jax.Array) -> jax.Array:
+    """Squared Mahalanobis distance of current points. x/y: [B, T] -> [B, T]."""
+    dx = x - fit.mean[:, 0:1]
+    dy = y - fit.mean[:, 1:2]
+    sxx = fit.cov[:, 0, 0][:, None]
+    syy = fit.cov[:, 1, 1][:, None]
+    sxy = fit.cov[:, 0, 1][:, None]
+    det = jnp.maximum(sxx * syy - sxy * sxy, 1e-30)
+    # explicit 2x2 inverse
+    return (syy * dx * dx - 2.0 * sxy * dx * dy + sxx * dy * dy) / det
+
+
+def detect_bivariate(
+    fit: BivariateFit,
+    x: jax.Array,
+    y: jax.Array,
+    mask: jax.Array,
+    threshold: jax.Array | float = 2.0,
+) -> jax.Array:
+    """Anomaly flags [B, T]: d^2 > threshold^2 per-axis-sigma equivalent.
+
+    `threshold` keeps the reference's "number of sigmas" semantics
+    (`foremast-brain.yaml:26-27`): a point is anomalous when it lies outside
+    the ellipsoid whose per-axis radius is threshold sigmas, i.e.
+    d^2 > threshold^2 (chi^2(2) generalization of |z| > threshold).
+    Windows with an invalid fit flag nothing (unknown, not unhealthy).
+    """
+    threshold = jnp.asarray(threshold, x.dtype)
+    if threshold.ndim == 1:
+        threshold = threshold[:, None]
+    d2 = mahalanobis2(fit, x, y)
+    return mask & (d2 > threshold * threshold) & fit.valid[:, None]

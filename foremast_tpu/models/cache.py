@@ -1,0 +1,70 @@
+"""Bounded per-(service, metric) model cache with optional checkpointing.
+
+The reference brain holds fitted models in a bounded in-memory cache
+(`MAX_CACHE_SIZE`, `foremast-brain/README.md:30`) and recomputes on miss —
+durable state lives in ES so any node can resume any job (SURVEY.md section 5,
+checkpoint/resume). This keeps those semantics and adds what the reference
+lacks: an optional orbax checkpoint of trained params (e.g. LSTM-AE
+weights) keyed by (service, metric), so warm-starting after restart skips
+retraining (SURVEY.md section 5 "new build" note).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Hashable
+
+import jax
+
+
+class ModelCache:
+    """Thread-safe LRU of fitted model state."""
+
+    def __init__(self, max_size: int = 1000):
+        self.max_size = max_size
+        self._d: OrderedDict[Hashable, Any] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key: Hashable):
+        with self._lock:
+            if key not in self._d:
+                return None
+            self._d.move_to_end(key)
+            return self._d[key]
+
+    def put(self, key: Hashable, value) -> None:
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.max_size:
+                self._d.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+    # -- optional durability (orbax) ------------------------------------
+
+    def save(self, path: str) -> None:
+        """Checkpoint the cache contents (pytree values only) via orbax."""
+        import orbax.checkpoint as ocp
+
+        with self._lock:
+            items = dict(self._d)
+        keys = sorted(items, key=str)
+        tree = {"keys": [str(k) for k in keys], "values": [items[k] for k in keys]}
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(path, tree, force=True)
+
+    def load(self, path: str, key_parser=None) -> int:
+        """Restore a checkpoint; keys round-trip as strings unless a
+        `key_parser` maps them back. Returns number of entries loaded."""
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        tree = ckptr.restore(path)
+        keys, values = tree["keys"], tree["values"]
+        for k, v in zip(keys, values):
+            self.put(key_parser(k) if key_parser else k, jax.tree.map(lambda a: a, v))
+        return len(keys)

@@ -1,0 +1,86 @@
+"""Seasonal trend model — the Prophet substitute.
+
+The reference model zoo lists Prophet for single-metric seasonal series
+(`docs/guides/design.md:73`). Prophet itself (Stan-based MAP fitting) is a
+poor fit for XLA; per SURVEY.md section 7.6 the substitution — documented here —
+is a *linear trend + Fourier seasonality* ridge regression, which is the
+core of Prophet's additive model (trend + seasonality, no holiday terms)
+and fits in closed form:
+
+    y(t) ~ w0 + w1 * t + sum_k [a_k sin(2 pi k t / P) + b_k cos(2 pi k t / P)]
+
+Batched masked normal equations: the design matrix X [T, K] is shared
+across the batch; per-series masked Gram matrices are one einsum, solved by
+`jnp.linalg.solve` on [B, K, K] — all MXU work, no per-series loops.
+
+Returns the standard `Forecast` contract: the fitted seasonal cycle is
+materialized into the `season` buffer (one full period), so `horizon()`
+extrapolates trend + repeating seasonality exactly like Holt-Winters.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from foremast_tpu.ops.forecasters import Forecast
+from foremast_tpu.ops.windows import masked_std
+
+
+def _design(t_idx: jax.Array, period: int, order: int, dtype) -> jax.Array:
+    """Feature matrix [len(t_idx), 2 + 2*order]: [1, t, sin/cos harmonics]."""
+    t = t_idx.astype(dtype)
+    cols = [jnp.ones_like(t), t]
+    for k in range(1, order + 1):
+        w = 2.0 * jnp.pi * k / period
+        cols.append(jnp.sin(w * t))
+        cols.append(jnp.cos(w * t))
+    return jnp.stack(cols, axis=-1)
+
+
+@partial(jax.jit, static_argnames=("period", "order"))
+def fit_seasonal(
+    values: jax.Array,
+    mask: jax.Array,
+    period: int = 1440,
+    order: int = 3,
+    ridge: float = 1e-3,
+) -> Forecast:
+    """Fit trend+Fourier model per series. values/mask: [B, T].
+
+    `period` in time steps (1440 = daily seasonality at the reference's
+    60 s PromQL step, `metricsquery.go:43`); `order` harmonics.
+    """
+    b, t_len = values.shape
+    dtype = values.dtype
+    x = _design(jnp.arange(t_len), period, order, dtype)  # [T, K]
+    k = x.shape[-1]
+    m = mask.astype(dtype)  # [B, T]
+    # per-series masked Gram: G[b] = X^T diag(m_b) X   -> [B, K, K]
+    xm = x[None, :, :] * m[:, :, None]  # [B, T, K]
+    gram = jnp.einsum("btk,tl->bkl", xm, x)
+    rhs = jnp.einsum("btk,bt->bk", xm, values)
+    eye = jnp.eye(k, dtype=dtype)
+    w = jnp.linalg.solve(gram + ridge * eye[None], rhs[..., None])[..., 0]  # [B, K]
+
+    pred = jnp.einsum("tk,bk->bt", x, w)
+    scale = masked_std((values - pred) * m, mask)
+
+    # Materialize one full future seasonal cycle so Forecast.horizon() can
+    # extrapolate: phase p corresponds to absolute step t_len + p.
+    future = jnp.arange(period) + t_len
+    xf = _design(future, period, order, dtype)  # [P, K]
+    # split trend (first two cols) from seasonality (harmonics)
+    level = w[:, 0] + w[:, 1] * (t_len - 1)  # value of trend line at last step
+    trend = w[:, 1]
+    seas_f = jnp.einsum("pk,bk->bp", xf[:, 2:], w[:, 2:])  # [B, P]
+    return Forecast(
+        pred=pred,
+        scale=scale,
+        level=level,
+        trend=trend,
+        season=seas_f,
+        season_phase=jnp.zeros((b,), jnp.int32),
+    )

@@ -1,0 +1,39 @@
+"""Mesh construction, sharded batch scoring, sequence parallelism."""
+
+from foremast_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_sharding,
+    make_mesh,
+    pad_to_multiple,
+    replicated,
+    shard_leading,
+)
+from foremast_tpu.parallel.batch import (
+    ShardedJudge,
+    pad_batch,
+    shard_batch,
+    throughput_batch,
+)
+from foremast_tpu.parallel.seqparallel import (
+    sharded_ewma,
+    sharded_linear_scan,
+    sharded_masked_moments,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "data_sharding",
+    "make_mesh",
+    "pad_to_multiple",
+    "replicated",
+    "shard_leading",
+    "ShardedJudge",
+    "pad_batch",
+    "shard_batch",
+    "throughput_batch",
+    "sharded_ewma",
+    "sharded_linear_scan",
+    "sharded_masked_moments",
+]

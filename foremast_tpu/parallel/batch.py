@@ -1,0 +1,125 @@
+"""Sharded batch scoring — the cluster-scale engine (BASELINE.md config 5:
+10k services x 4 metrics x 30-min windows over a v5e-8).
+
+Design (SURVEY.md section 7.4): the (service x metric) population is one
+`[B, T]` batch whose leading axis is sharded over the mesh's `data` axis.
+The scoring program contains no cross-window dependencies, so XLA
+partitions it with zero collectives — each chip judges its slice of the
+fleet; only the verdict gather crosses ICI.
+
+The host-side `ShardedJudge` rounds batches up to a multiple of the data
+axis (padding windows are fully masked -> verdict UNKNOWN, dropped on
+decode) and placement happens once per batch via `device_put` with a
+NamedSharding — double-buffered H2D comes from dispatching the next batch
+while the previous result is still in flight (jax's async dispatch).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from foremast_tpu.config import BrainConfig
+from foremast_tpu.engine import scoring
+from foremast_tpu.engine.judge import HealthJudge, MetricTask, MetricVerdict
+from foremast_tpu.ops.windows import MetricWindows
+from foremast_tpu.parallel import mesh as meshlib
+
+
+def pad_batch(batch: scoring.ScoreBatch, multiple: int) -> scoring.ScoreBatch:
+    """Pad the leading axis to a multiple; padded rows are all-masked."""
+    b = batch.current.values.shape[0]
+    target = meshlib.pad_to_multiple(b, multiple)
+    if target == b:
+        return batch
+    pad = target - b
+
+    def pad_leading(a):
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, widths)
+
+    return jax.tree.map(pad_leading, batch)
+
+
+def shard_batch(batch: scoring.ScoreBatch, mesh) -> scoring.ScoreBatch:
+    """Place a (padded) batch with its leading axis over the data axis."""
+    return meshlib.shard_leading(batch, mesh)
+
+
+class ShardedJudge(HealthJudge):
+    """HealthJudge whose compiled scorer runs partitioned over a mesh.
+
+    Drop-in: same `judge(tasks) -> [MetricVerdict]` surface; inherits the
+    bucketing logic and overrides only batch placement.
+    """
+
+    def __init__(self, config: BrainConfig | None = None, mesh=None):
+        super().__init__(config)
+        self.mesh = mesh if mesh is not None else meshlib.make_mesh()
+
+    def _place(self, batch):
+        # leading axis over `data`; the task list is already padded to a
+        # multiple of the data axis by _judge_bucket below
+        return shard_batch(batch, self.mesh)
+
+    def _judge_bucket(self, tasks, th, tc):
+        n_data = self.mesh.shape[meshlib.DATA_AXIS]
+        # Build host-side arrays via the parent packing, then pad + shard.
+        # Parent returns decoded verdicts, so replicate its packing here
+        # only for placement: intercept by padding the *task list* instead —
+        # padded tasks are empty windows, decoded then dropped.
+        b = len(tasks)
+        target = meshlib.pad_to_multiple(b, n_data)
+        if target != b:
+            empty = np.zeros(0, np.float32)
+            et = np.zeros(0, np.int64)
+            pad_task = MetricTask(
+                job_id="__pad__",
+                alias="__pad__",
+                metric_type=None,
+                hist_times=et,
+                hist_values=empty,
+                cur_times=et,
+                cur_values=empty,
+            )
+            tasks = list(tasks) + [pad_task] * (target - b)
+        out = super()._judge_bucket(tasks, th, tc)
+        return out[:b]
+
+
+def throughput_batch(
+    n_windows: int,
+    hist_len: int,
+    cur_len: int,
+    mesh=None,
+    seed: int = 0,
+) -> scoring.ScoreBatch:
+    """Synthetic fixed-shape batch for benchmarking (bench.py)."""
+    rng = np.random.default_rng(seed)
+    hv = (0.5 + 0.05 * rng.standard_normal((n_windows, hist_len))).astype(np.float32)
+    cv = (0.5 + 0.05 * rng.standard_normal((n_windows, cur_len))).astype(np.float32)
+    bv = (0.5 + 0.05 * rng.standard_normal((n_windows, cur_len))).astype(np.float32)
+    t0 = 1_700_000_000
+    ht = np.broadcast_to(t0 + 60 * np.arange(hist_len, dtype=np.int64), hv.shape)
+    ct = np.broadcast_to(t0 + 60 * np.arange(cur_len, dtype=np.int64), cv.shape)
+    ones_h = np.ones(hv.shape, bool)
+    ones_c = np.ones(cv.shape, bool)
+
+    def win(v, t, m):
+        return MetricWindows(
+            values=jnp.asarray(v), mask=jnp.asarray(m), times=jnp.asarray(t.astype(np.int32))
+        )
+
+    batch = scoring.ScoreBatch(
+        historical=win(hv, ht, ones_h),
+        current=win(cv, ct, ones_c),
+        baseline=win(bv, ct, ones_c),
+        threshold=jnp.full((n_windows,), 5.0, jnp.float32),
+        bound=jnp.full((n_windows,), 1, jnp.int32),
+        min_lower_bound=jnp.zeros((n_windows,), jnp.float32),
+        min_points=jnp.full((n_windows,), 10, jnp.int32),
+    )
+    if mesh is not None:
+        batch = shard_batch(pad_batch(batch, mesh.shape[meshlib.DATA_AXIS]), mesh)
+    return batch

@@ -1,0 +1,68 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference's only "parallelism" is N shared-nothing brain pods polling a
+queue (SURVEY.md section 2.8). The TPU-native replacement is a 2-D
+`jax.sharding.Mesh`:
+
+  * `data`  — the (service x metric) batch axis: pure DP over ICI; the
+    scoring program partitions with zero collectives (embarrassingly
+    parallel windows), matching "batched scoring: one jitted program
+    scoring 100k windows as array dims in HBM";
+  * `model` — tensor-parallel axis for the learned detectors (LSTM gate
+    dimension) and the sequence-parallel axis for long-window scans.
+
+Works identically on real TPU slices and on virtual CPU devices
+(`xla_force_host_platform_device_count`), which is how multi-chip tests and
+the driver's `dryrun_multichip` run without hardware.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    n_data: int | None = None,
+    n_model: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a (data, model) mesh over the available devices.
+
+    Defaults to all devices on the data axis (the scoring engine's natural
+    layout: DP over windows). `n_data=None` derives it from the device
+    count / n_model.
+    """
+    devs = devices if devices is not None else jax.devices()
+    if n_data is None:
+        n_data = len(devs) // n_model
+    need = n_data * n_model
+    if need > len(devs):
+        raise ValueError(f"mesh {n_data}x{n_model} needs {need} devices, have {len(devs)}")
+    grid = np.asarray(devs[:need]).reshape(n_data, n_model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Shard the leading (batch) axis over `data`, replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_leading(tree, mesh: Mesh):
+    """device_put every array in a pytree with its leading axis on `data`."""
+    return jax.tree.map(
+        lambda a: jax.device_put(a, data_sharding(mesh, np.ndim(a))), tree
+    )
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    """Smallest multiple of k >= n (batch padding for even sharding)."""
+    return ((n + k - 1) // k) * k

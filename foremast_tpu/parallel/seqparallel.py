@@ -1,0 +1,128 @@
+"""Sequence/context parallelism for long metric windows.
+
+The reference's longest series is the 7-day history (~10k points) — one
+chip's worth. But the framework treats long-context as first-class: when
+windows outgrow a single device's HBM (year-long histories, 1 s steps, or
+very wide batches), the *time* axis itself is sharded over the mesh and
+recurrences run as distributed scans.
+
+Two primitives, both built on `shard_map` + XLA collectives over ICI:
+
+  * `sharded_linear_scan` — the EWMA/exponential-smoothing family is the
+    linear recurrence l_t = a_t l_{t-1} + b_t, whose composition law
+    (a1,b1)o(a2,b2) = (a1 a2, a2 b1 + b2) is associative. Each device
+    scans its local time block, `all_gather`s the per-block composed
+    elements (2 scalars per series per device — tiny on ICI), computes its
+    exclusive prefix, and applies it locally. One collective total.
+  * `sharded_masked_moments` — global masked mean/var across a time-sharded
+    window via `psum` (the partial-sum trick), for bounds computed against
+    statistics of a sequence no single chip holds.
+
+This is the all-to-all/ring-style sequence-parallel design of the scaling
+playbook applied to scans rather than attention: the sequence axis maps to
+mesh axis `model`, batch stays on `data`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from foremast_tpu.ops.forecasters import _linrec_assoc as _compose
+from foremast_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def sharded_linear_scan(a: jax.Array, b: jax.Array, mesh: Mesh) -> jax.Array:
+    """Distributed l_t = a_t * l_{t-1} + b_t (l_0 = 0) with time sharded.
+
+    a, b: [B, T] with B sharded over `data` and T sharded over `model`.
+    Returns l: [B, T] with the same sharding. The cross-device step moves
+    2 scalars per (series, device) over ICI.
+    """
+
+    def local(a_blk, b_blk):
+        # local inclusive scan of composed elements
+        ca, cb = jax.lax.associative_scan(_compose, (a_blk, b_blk), axis=-1)
+        # per-block total = last composed element
+        tot_a = ca[..., -1:]
+        tot_b = cb[..., -1:]
+        # gather all block totals along the sequence axis group
+        gat_a = jax.lax.all_gather(tot_a, MODEL_AXIS, axis=-1, tiled=True)  # [B, D]
+        gat_b = jax.lax.all_gather(tot_b, MODEL_AXIS, axis=-1, tiled=True)
+        idx = jax.lax.axis_index(MODEL_AXIS)
+        # exclusive prefix over preceding blocks: compose blocks < idx
+        d = gat_a.shape[-1]
+        mask = jnp.arange(d) < idx  # [D]
+        # composing with identity (1, 0) where masked out
+        pa = jnp.where(mask, gat_a, 1.0)
+        pb = jnp.where(mask, gat_b, 0.0)
+
+        def fold(carry, i):
+            ca_, cb_ = carry
+            return _compose((ca_, cb_), (pa[..., i], pb[..., i])), None
+
+        (pre_a, pre_b), _ = jax.lax.scan(
+            fold,
+            (jnp.ones_like(tot_a[..., 0]), jnp.zeros_like(tot_b[..., 0])),
+            jnp.arange(d),
+        )
+        # apply prefix state l_prev = pre_b (l_0 = 0): l = ca * l_prev + cb
+        return ca * pre_b[..., None] + cb
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS, MODEL_AXIS)),
+        out_specs=P(DATA_AXIS, MODEL_AXIS),
+        check_vma=False,
+    )
+    return fn(a, b)
+
+
+def sharded_ewma(
+    values: jax.Array, mask: jax.Array, alpha: float, mesh: Mesh
+) -> jax.Array:
+    """EWMA levels over a time-sharded window (mirrors ops.ewma_levels).
+
+    values/mask: [B, T] sharded (data, model). First-valid-point
+    initialization needs the global running count of valid points, computed
+    as a second distributed linear scan (a=1, b=mask).
+    """
+    # global prefix count of valid points, inclusive
+    cnt = sharded_linear_scan(
+        jnp.ones_like(values), mask.astype(values.dtype), mesh
+    )
+    is_first = mask & (cnt == 1.0)
+    a_eff = jnp.where(mask, jnp.asarray(alpha, values.dtype), 0.0)
+    a_eff = jnp.where(is_first, 1.0, a_eff)
+    return sharded_linear_scan(1.0 - a_eff, a_eff * values, mesh)
+
+
+def sharded_masked_moments(
+    values: jax.Array, mask: jax.Array, mesh: Mesh
+) -> tuple[jax.Array, jax.Array]:
+    """Global masked (mean, var) over a time-sharded window -> two [B] arrays
+    replicated along `model`. One psum over ICI."""
+
+    def local(v, m):
+        mf = m.astype(v.dtype)
+        s1 = jax.lax.psum(jnp.sum(v * mf, axis=-1), MODEL_AXIS)
+        s2 = jax.lax.psum(jnp.sum(v * v * mf, axis=-1), MODEL_AXIS)
+        n = jax.lax.psum(jnp.sum(mf, axis=-1), MODEL_AXIS)
+        mean = jnp.where(n > 0, s1 / jnp.maximum(n, 1.0), 0.0)
+        var = jnp.where(n > 0, s2 / jnp.maximum(n, 1.0) - mean * mean, 0.0)
+        return jnp.maximum(var, 0.0), mean
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS, MODEL_AXIS)),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=False,
+    )
+    var, mean = fn(values, mask)
+    return mean, var

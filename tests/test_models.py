@@ -1,0 +1,178 @@
+"""Model-zoo tests: LSTM-AE, bivariate normal, seasonal, cache."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from foremast_tpu.models import (
+    LSTMAEConfig,
+    ModelCache,
+    detect_bivariate,
+    fit_bivariate,
+    fit_many,
+    fit_seasonal,
+    mahalanobis2,
+    score_many,
+)
+from foremast_tpu.ops.forecasters import horizon
+
+
+# ---------------------------------------------------------------------------
+# seasonal (Prophet substitute)
+# ---------------------------------------------------------------------------
+
+
+def _seasonal_series(b, t, period, noise=0.02, seed=0):
+    rng = np.random.default_rng(seed)
+    tt = np.arange(t)
+    base = 1.0 + 0.001 * tt
+    seas = 0.5 * np.sin(2 * np.pi * tt / period)
+    y = base[None] + seas[None] + noise * rng.standard_normal((b, t))
+    return jnp.asarray(y, jnp.float32)
+
+
+def test_seasonal_recovers_cycle():
+    period = 48
+    y = _seasonal_series(3, 6 * period, period)
+    mask = jnp.ones_like(y, bool)
+    fc = fit_seasonal(y, mask, period=period, order=3)
+    resid = np.asarray(y - fc.pred)
+    assert np.abs(resid).mean() < 0.05
+    assert float(fc.scale.mean()) < 0.05
+    # extrapolation continues the cycle
+    future = np.asarray(horizon(fc, period))
+    tt = np.arange(6 * period, 7 * period)
+    expected = 1.0 + 0.001 * tt + 0.5 * np.sin(2 * np.pi * tt / period)
+    assert np.abs(future[0] - expected).mean() < 0.08
+
+
+def test_seasonal_masked_fit():
+    period = 24
+    y = _seasonal_series(2, 4 * period, period)
+    mask = np.ones(y.shape, bool)
+    mask[:, 10:20] = False  # gap
+    y = y.at[:, 10:20].set(999.0)  # garbage under the mask
+    fc = fit_seasonal(y, jnp.asarray(mask), period=period, order=2)
+    resid = np.asarray(y - fc.pred)[np.asarray(mask)]
+    assert np.abs(resid).mean() < 0.1
+
+
+def test_seasonal_registered_in_registry():
+    from foremast_tpu.engine import AI_MODEL
+
+    assert "seasonal" in AI_MODEL and "prophet" in AI_MODEL
+
+
+# ---------------------------------------------------------------------------
+# bivariate normal
+# ---------------------------------------------------------------------------
+
+
+def test_bivariate_flags_joint_outlier():
+    rng = np.random.default_rng(1)
+    n = 500
+    # correlated history: y ~ 2x + noise
+    x = 1.0 + 0.1 * rng.standard_normal((1, n))
+    y = 2.0 * x + 0.02 * rng.standard_normal((1, n))
+    mask = jnp.ones((1, n), bool)
+    fit = fit_bivariate(jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32), mask)
+    assert bool(fit.valid[0])
+    # current: marginally normal in each axis but violating the correlation
+    cx = jnp.asarray([[1.0, 1.1, 0.9]], jnp.float32)
+    cy = jnp.asarray([[2.0, 1.8, 2.2]], jnp.float32)  # 1.8 vs expected 2.2
+    cm = jnp.ones((1, 3), bool)
+    d2 = np.asarray(mahalanobis2(fit, cx, cy))
+    assert d2[0, 0] < 4.0  # on-manifold point is fine
+    flags = np.asarray(detect_bivariate(fit, cx, cy, cm, threshold=3.0))
+    assert not flags[0, 0]
+    assert flags[0, 1] and flags[0, 2]  # correlation violations caught
+
+
+def test_bivariate_insufficient_history_is_invalid():
+    x = jnp.ones((1, 4), jnp.float32)
+    y = jnp.ones((1, 4), jnp.float32)
+    mask = jnp.ones((1, 4), bool)
+    fit = fit_bivariate(x, y, mask, min_points=10)
+    assert not bool(fit.valid[0])
+    flags = detect_bivariate(fit, x, y, mask)
+    assert not bool(jnp.any(flags))
+
+
+# ---------------------------------------------------------------------------
+# LSTM autoencoder
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def trained_ae():
+    rng = np.random.default_rng(2)
+    s, b, t, f = 2, 8, 24, 3
+    tt = np.arange(t)
+    pattern = np.stack(
+        [np.sin(2 * np.pi * tt / 12), np.cos(2 * np.pi * tt / 12), 0.1 * tt / t],
+        axis=-1,
+    )  # [T, F]
+    x = pattern[None, None] + 0.02 * rng.standard_normal((s, b, t, f))
+    x = jnp.asarray(x, jnp.float32)
+    mask = jnp.ones((s, b, t), bool)
+    cfg = LSTMAEConfig(features=f, hidden=16, learning_rate=5e-3)
+    params, err_mean, err_std, losses = fit_many(
+        jax.random.key(0), x, mask, cfg, steps=200
+    )
+    return params, (err_mean, err_std), losses, x, mask, pattern, cfg
+
+
+def test_lstm_ae_training_reduces_loss(trained_ae):
+    _, _, losses, *_ = trained_ae
+    losses = np.asarray(losses).mean(axis=-1)  # [steps, S] -> [steps]
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_lstm_ae_scores_anomalies(trained_ae):
+    params, scale, _, x, mask, pattern, cfg = trained_ae
+    rng = np.random.default_rng(3)
+    t, f = pattern.shape
+    clean = pattern[None, None] + 0.02 * rng.standard_normal((2, 1, t, f))
+    broken = clean.copy()
+    broken[:, :, 10:14, :] += 3.0  # injected fault
+    em, es = scale
+    flags_c, _ = score_many(params, jnp.asarray(clean, jnp.float32), mask[:, :1], em, es, 5.0)
+    flags_b, _ = score_many(params, jnp.asarray(broken, jnp.float32), mask[:, :1], em, es, 5.0)
+    assert not bool(jnp.any(flags_c))
+    assert bool(jnp.all(flags_b[:, :, 10:14]))
+
+
+def test_lstm_ae_masked_steps_ignored(trained_ae):
+    params, (em, es), _, x, mask, _, cfg = trained_ae
+    x_mod = x.at[:, :, 5, :].set(1e6)  # garbage at a masked slot
+    m = mask.at[:, :, 5].set(False)
+    flags, err = score_many(params, x_mod, m, em, es, 3.0)
+    assert not bool(jnp.any(flags[:, :, 5]))
+    assert float(err[0, 0, 5]) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# cache
+# ---------------------------------------------------------------------------
+
+
+def test_model_cache_lru_eviction():
+    c = ModelCache(max_size=2)
+    c.put(("svc1", "latency"), {"w": jnp.ones(2)})
+    c.put(("svc2", "latency"), {"w": jnp.ones(2)})
+    c.get(("svc1", "latency"))  # refresh svc1
+    c.put(("svc3", "latency"), {"w": jnp.ones(2)})
+    assert c.get(("svc2", "latency")) is None  # LRU evicted
+    assert c.get(("svc1", "latency")) is not None
+    assert len(c) == 2
+
+
+def test_model_cache_checkpoint_roundtrip(tmp_path):
+    c = ModelCache()
+    c.put("svc1/latency", {"w": jnp.arange(3, dtype=jnp.float32)})
+    c.save(str(tmp_path / "ckpt"))
+    c2 = ModelCache()
+    n = c2.load(str(tmp_path / "ckpt"))
+    assert n == 1
+    np.testing.assert_allclose(c2.get("svc1/latency")["w"], [0.0, 1.0, 2.0])

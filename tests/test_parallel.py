@@ -1,0 +1,157 @@
+"""Parallel-plane tests on the 8-device virtual CPU mesh (conftest forces
+`xla_force_host_platform_device_count=8` standing in for a v5e-8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from foremast_tpu.config import BrainConfig
+from foremast_tpu.engine import HEALTHY, UNHEALTHY, MetricTask, scoring
+from foremast_tpu.ops.forecasters import ewma_levels
+from foremast_tpu.parallel import (
+    ShardedJudge,
+    make_mesh,
+    pad_batch,
+    shard_batch,
+    sharded_ewma,
+    sharded_linear_scan,
+    sharded_masked_moments,
+    throughput_batch,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert jax.device_count() >= 8, "conftest must provide 8 virtual devices"
+    return make_mesh(n_data=8)
+
+
+@pytest.fixture(scope="module")
+def mesh_2d():
+    return make_mesh(n_data=4, n_model=2)
+
+
+def test_mesh_shapes(mesh8, mesh_2d):
+    assert mesh8.shape == {"data": 8, "model": 1}
+    assert mesh_2d.shape == {"data": 4, "model": 2}
+
+
+def test_sharded_scoring_matches_single_device(mesh8):
+    batch = throughput_batch(64, 128, 16)
+    res_single = scoring.score(batch)
+    sharded = shard_batch(pad_batch(batch, 8), mesh8)
+    res_shard = scoring.score(sharded)
+    np.testing.assert_array_equal(
+        np.asarray(res_single.verdict), np.asarray(res_shard.verdict)[:64]
+    )
+    np.testing.assert_allclose(
+        np.asarray(res_single.upper), np.asarray(res_shard.upper)[:64], rtol=1e-5
+    )
+
+
+def test_sharded_judge_end_to_end(mesh8):
+    rng = np.random.default_rng(0)
+    judge = ShardedJudge(BrainConfig(), mesh=mesh8)
+    tasks = []
+    for i in range(13):  # deliberately not a multiple of 8
+        hist = 0.5 + 0.05 * rng.standard_normal(200)
+        cur = 0.5 + 0.05 * rng.standard_normal(10)
+        if i == 7:
+            cur[3] = 50.0
+        t = 1700000000 + 60 * np.arange(max(len(hist), len(cur)), dtype=np.int64)
+        tasks.append(
+            MetricTask(
+                job_id=f"j{i}",
+                alias="m",
+                metric_type="latency",
+                hist_times=t[: len(hist)],
+                hist_values=hist.astype(np.float32),
+                cur_times=t[: len(cur)],
+                cur_values=cur.astype(np.float32),
+            )
+        )
+    vs = judge.judge(tasks)
+    assert len(vs) == 13
+    assert vs[7].verdict == UNHEALTHY
+    assert all(v.verdict == HEALTHY for i, v in enumerate(vs) if i != 7)
+
+
+def test_sharded_judge_actually_shards(mesh8):
+    """Regression: _place must spread the batch over the data axis."""
+    batch = pad_batch(throughput_batch(16, 64, 8), 8)
+    judge = ShardedJudge(BrainConfig(), mesh=mesh8)
+    placed = judge._place(batch)
+    sh = placed.current.values.sharding
+    assert sh.spec[0] == "data"
+    assert len(placed.current.values.devices()) == 8
+
+
+def test_sharded_linear_scan_matches_local(mesh_2d):
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.uniform(0.5, 1.0, (8, 64)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((8, 64)), jnp.float32)
+    got = sharded_linear_scan(a, b, mesh_2d)
+
+    def ref(a, b):
+        out = np.zeros_like(np.asarray(b))
+        l = np.zeros(a.shape[0], np.float32)
+        for t in range(a.shape[1]):
+            l = np.asarray(a)[:, t] * l + np.asarray(b)[:, t]
+            out[:, t] = l
+        return out
+
+    np.testing.assert_allclose(np.asarray(got), ref(a, b), rtol=2e-4, atol=2e-4)
+
+
+def test_sharded_ewma_matches_reference_op(mesh_2d):
+    rng = np.random.default_rng(2)
+    v = jnp.asarray(rng.standard_normal((8, 64)), jnp.float32)
+    mask = jnp.asarray(rng.uniform(size=(8, 64)) > 0.2)
+    got = sharded_ewma(v, mask, 0.3, mesh_2d)
+    want = ewma_levels(v, mask, 0.3)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_sharded_moments(mesh_2d):
+    rng = np.random.default_rng(3)
+    v = jnp.asarray(rng.standard_normal((8, 64)) * 2 + 1, jnp.float32)
+    mask = jnp.asarray(rng.uniform(size=(8, 64)) > 0.3)
+    mean, var = sharded_masked_moments(v, mask, mesh_2d)
+    mnp = np.asarray(mask)
+    vnp = np.asarray(v)
+    for i in range(8):
+        sel = vnp[i][mnp[i]]
+        np.testing.assert_allclose(np.asarray(mean)[i], sel.mean(), rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(var)[i], sel.var(), rtol=1e-3, atol=1e-4
+        )
+
+
+def test_lstm_ae_train_step_sharded(mesh_2d):
+    """The dryrun_multichip path: stacked per-service params + windows
+    sharded over data; gate axis over model."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from foremast_tpu.models import LSTMAEConfig, lstm_ae_shardings
+    from foremast_tpu.models.lstm_ae import init_many, make_optimizer, train_step_many
+
+    cfg = LSTMAEConfig(features=3, hidden=8)
+    s, b, t = 8, 4, 12
+    params = init_many(jax.random.key(0), s, cfg)
+    opt_state = jax.vmap(make_optimizer(cfg).init)(params)
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((s, b, t, 3)), jnp.float32)
+    mask = jnp.ones((s, b, t), bool)
+
+    pspec, ospec = lstm_ae_shardings(mesh_2d, params, opt_state)
+    params = jax.tree.map(jax.device_put, params, pspec)
+    opt_state = jax.tree.map(jax.device_put, opt_state, ospec)
+    x = jax.device_put(x, NamedSharding(mesh_2d, P("data", None, None, None)))
+    mask = jax.device_put(mask, NamedSharding(mesh_2d, P("data", None, None)))
+
+    p2, o2, loss = train_step_many(params, opt_state, x, mask, cfg)
+    assert np.isfinite(np.asarray(loss)).all()
+    # params actually updated
+    diff = jax.tree.map(lambda a, b_: float(jnp.abs(a - b_).max()), params, p2)
+    assert max(jax.tree.leaves(diff)) > 0
