@@ -216,7 +216,13 @@ def score(
         min_kruskal,
     )
 
-    fit = AI_MODEL[algorithm]
+    fit = AI_MODEL.get(algorithm)
+    if fit is None:
+        # models/ registers its detectors (seasonal/prophet/...) on import;
+        # resolve lazily so the registry works without callers importing it
+        import foremast_tpu.models  # noqa: F401
+
+        fit = AI_MODEL[algorithm]
     fc: Forecast = fit(hist.values, hist.mask)
     pred = horizon(fc, cur.length)  # [B, Tc] forecast over current window
 

@@ -1,0 +1,252 @@
+"""Job stores: the durable queue + state store.
+
+The reference uses Elasticsearch as both durable queue and state store
+(index `documents`, type `document`,
+`foremast-service/pkg/search/elasticsearchstore.go:16-19`), with
+search-first idempotent creation (`CreateNewDoc`, `:22-62`) and a
+`ByStatus` fuzzy search used by the brain to claim work (`:124-149`).
+Semantics preserved here:
+
+  * idempotent create — same id (HMAC of request) never duplicates;
+  * claimable = status in {initial, *_inprogress stuck > MAX_STUCK_IN_SECONDS,
+    preprocess_completed} — the lease-style work-stealing of
+    `design.md:39` / `foremast-brain.yaml:80-81`;
+  * claiming is a compare-and-set on (status, modified_at) so two workers
+    cannot double-claim (the reference gets this from ES versioned
+    updates).
+
+`InMemoryStore` is the test/standalone backend; `ElasticsearchStore`
+speaks the ES REST API directly (no client lib in the image).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from datetime import datetime, timezone
+from typing import Iterable
+
+from foremast_tpu.jobs.models import (
+    CLAIMABLE_STATUSES,
+    STATUS_INITIAL,
+    STATUS_PREPROCESS_COMPLETED,
+    TERMINAL_STATUSES,
+    Document,
+)
+
+
+def now_rfc3339() -> str:
+    return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _parse_ts(s: str) -> float:
+    if not s:
+        return 0.0
+    try:
+        return datetime.strptime(s, "%Y-%m-%dT%H:%M:%SZ").replace(
+            tzinfo=timezone.utc
+        ).timestamp()
+    except ValueError:
+        return 0.0
+
+
+class JobStore:
+    """Interface: idempotent create, lookup, claim, update."""
+
+    def create(self, doc: Document) -> tuple[Document, bool]:
+        """Insert if no document with doc.id exists. Returns
+        (stored_document, created) — on conflict the existing doc wins
+        (CreateNewDoc search-first, elasticsearchstore.go:22-62)."""
+        raise NotImplementedError
+
+    def get(self, doc_id: str) -> Document | None:
+        raise NotImplementedError
+
+    def claim(
+        self, worker_id: str, max_stuck_seconds: float, limit: int = 64
+    ) -> list[Document]:
+        """Atomically take up to `limit` claimable docs: status==initial or
+        preprocess_completed (re-check loop), or in-progress but stuck
+        longer than max_stuck_seconds (work stealing)."""
+        raise NotImplementedError
+
+    def update(self, doc: Document) -> Document:
+        raise NotImplementedError
+
+    def list_open(self) -> list[Document]:
+        raise NotImplementedError
+
+
+def _is_claimable(doc: Document, now: float, max_stuck: float) -> bool:
+    if doc.status in (STATUS_INITIAL, STATUS_PREPROCESS_COMPLETED):
+        return True
+    if doc.status in TERMINAL_STATUSES:
+        return False
+    if doc.status in CLAIMABLE_STATUSES:  # *_inprogress
+        return now - _parse_ts(doc.modified_at) > max_stuck
+    return False
+
+
+class InMemoryStore(JobStore):
+    def __init__(self):
+        self._docs: dict[str, Document] = {}
+        self._lock = threading.Lock()
+
+    def create(self, doc: Document) -> tuple[Document, bool]:
+        with self._lock:
+            existing = self._docs.get(doc.id)
+            if existing is not None:
+                return existing, False
+            doc.created_at = doc.created_at or now_rfc3339()
+            doc.modified_at = now_rfc3339()
+            self._docs[doc.id] = doc
+            return doc, True
+
+    def get(self, doc_id: str) -> Document | None:
+        with self._lock:
+            return self._docs.get(doc_id)
+
+    def claim(self, worker_id: str, max_stuck_seconds: float, limit: int = 64):
+        now = time.time()
+        out = []
+        with self._lock:
+            for doc in self._docs.values():
+                if len(out) >= limit:
+                    break
+                if _is_claimable(doc, now, max_stuck_seconds):
+                    doc.modified_at = now_rfc3339()
+                    doc.processing_content = worker_id
+                    out.append(doc)
+        return out
+
+    def update(self, doc: Document) -> Document:
+        with self._lock:
+            doc.modified_at = now_rfc3339()
+            self._docs[doc.id] = doc
+            return doc
+
+    def list_open(self):
+        with self._lock:
+            return [d for d in self._docs.values() if d.status not in TERMINAL_STATUSES]
+
+
+class ElasticsearchStore(JobStore):
+    """ES REST backend — index/type parity with elasticsearchstore.go:16-19.
+
+    Connection-retry semantics mirror the service's forever-retry loop
+    (`service main.go:248-260`) via `wait_ready`.
+    """
+
+    INDEX = "documents"
+    TYPE = "document"
+
+    def __init__(self, endpoint: str, session=None, timeout: float = 10.0):
+        import requests
+
+        self.endpoint = endpoint.rstrip("/")
+        self._s = session or requests.Session()
+        self.timeout = timeout
+
+    # -- helpers --------------------------------------------------------
+
+    def _url(self, *parts: str) -> str:
+        return "/".join((self.endpoint, self.INDEX, *parts))
+
+    def wait_ready(self, retry_seconds: float = 3.0, max_wait: float | None = None):
+        start = time.time()
+        while True:
+            try:
+                r = self._s.get(self.endpoint, timeout=self.timeout)
+                if r.ok:
+                    return True
+            except Exception:
+                pass
+            if max_wait is not None and time.time() - start > max_wait:
+                return False
+            time.sleep(retry_seconds)
+
+    # -- JobStore -------------------------------------------------------
+
+    def create(self, doc: Document) -> tuple[Document, bool]:
+        existing = self.get(doc.id)
+        if existing is not None:
+            return existing, False
+        doc.created_at = doc.created_at or now_rfc3339()
+        doc.modified_at = now_rfc3339()
+        r = self._s.put(
+            self._url("_doc", doc.id) + "?op_type=create",
+            json=doc.to_json(),
+            timeout=self.timeout,
+        )
+        if r.status_code == 409:  # lost the race — fetch winner
+            return self.get(doc.id) or doc, False
+        r.raise_for_status()
+        return doc, True
+
+    def get(self, doc_id: str) -> Document | None:
+        r = self._s.get(self._url("_doc", doc_id), timeout=self.timeout)
+        if r.status_code == 404:
+            return None
+        r.raise_for_status()
+        body = r.json()
+        if not body.get("found"):
+            return None
+        return Document.from_json(body["_source"])
+
+    def claim(self, worker_id: str, max_stuck_seconds: float, limit: int = 64):
+        query = {
+            "size": limit,
+            "query": {
+                "terms": {"status": list(CLAIMABLE_STATUSES)}
+            },
+        }
+        r = self._s.post(
+            self._url("_search"), json=query, timeout=self.timeout
+        )
+        r.raise_for_status()
+        hits = r.json().get("hits", {}).get("hits", [])
+        now = time.time()
+        out = []
+        for h in hits:
+            doc = Document.from_json(h["_source"])
+            if not _is_claimable(doc, now, max_stuck_seconds):
+                continue
+            doc.modified_at = now_rfc3339()
+            doc.processing_content = worker_id
+            # optimistic concurrency: seq_no/primary_term CAS
+            params = ""
+            if "_seq_no" in h:
+                params = (
+                    f"?if_seq_no={h['_seq_no']}"
+                    f"&if_primary_term={h['_primary_term']}"
+                )
+            rr = self._s.put(
+                self._url("_doc", doc.id) + params,
+                json=doc.to_json(),
+                timeout=self.timeout,
+            )
+            if rr.status_code == 409:
+                continue  # another worker won this doc
+            rr.raise_for_status()
+            out.append(doc)
+        return out
+
+    def update(self, doc: Document) -> Document:
+        doc.modified_at = now_rfc3339()
+        r = self._s.put(
+            self._url("_doc", doc.id), json=doc.to_json(), timeout=self.timeout
+        )
+        r.raise_for_status()
+        return doc
+
+    def list_open(self):
+        query = {
+            "size": 1000,
+            "query": {"bool": {"must_not": {"terms": {"status": list(TERMINAL_STATUSES)}}}},
+        }
+        r = self._s.post(self._url("_search"), json=query, timeout=self.timeout)
+        r.raise_for_status()
+        return [
+            Document.from_json(h["_source"])
+            for h in r.json().get("hits", {}).get("hits", [])
+        ]

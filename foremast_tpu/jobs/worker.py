@@ -1,0 +1,222 @@
+"""The brain worker — claim, fetch, judge (batched), write back.
+
+Reference loop (SURVEY.md section 3.2): poll ES for claimable docs (stuck-job
+takeover after MAX_STUCK_IN_SECONDS), mark preprocess_inprogress, HTTP-GET
+each query_range URL, run pairwise + historical-model scoring, fail fast to
+`completed_unhealth` on any anomaly, else keep re-checking until endTime
+then `completed_health`.
+
+TPU re-design: one worker claims MANY jobs per tick and judges every
+(job x alias) window in a single batched `HealthJudge.judge` call — jobs
+are array rows, not units of work. Horizontal scaling still works exactly
+like the reference (shared-nothing workers against the same store, CAS
+claims), but each worker saturates a chip instead of a 100m-CPU sliver.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from datetime import datetime, timezone
+from typing import Callable
+
+from foremast_tpu.config import BrainConfig
+from foremast_tpu.engine import (
+    HEALTHY,
+    UNHEALTHY,
+    UNKNOWN,
+    HealthJudge,
+    MetricTask,
+    MetricVerdict,
+    combine_verdicts,
+)
+from foremast_tpu.jobs.models import (
+    STATUS_COMPLETED_HEALTH,
+    STATUS_COMPLETED_UNHEALTH,
+    STATUS_COMPLETED_UNKNOWN,
+    STATUS_PREPROCESS_COMPLETED,
+    STATUS_PREPROCESS_FAILED,
+    STATUS_PREPROCESS_INPROGRESS,
+    AnomalyInfo,
+    Document,
+)
+from foremast_tpu.jobs.store import JobStore
+from foremast_tpu.metrics.promql import decode_config
+from foremast_tpu.metrics.source import MetricSource
+
+log = logging.getLogger("foremast_tpu.worker")
+
+
+def _parse_time(s: str) -> float:
+    """RFC3339 or unix-seconds string -> epoch seconds (0 if unparseable)."""
+    if not s:
+        return 0.0
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    try:
+        return (
+            datetime.strptime(s, "%Y-%m-%dT%H:%M:%SZ")
+            .replace(tzinfo=timezone.utc)
+            .timestamp()
+        )
+    except ValueError:
+        return 0.0
+
+
+def infer_metric_type(alias: str, config: BrainConfig) -> str | None:
+    """Map a metric alias onto a per-type threshold row by substring match
+    (the reference keys its override matrix by metric *type* names like
+    error5xx/latency which appear in the aliases, foremast-brain.yaml:32-73)."""
+    low = alias.lower()
+    for rule in config.anomaly.rules:
+        if rule.metric_type.lower() in low:
+            return rule.metric_type
+    return None
+
+
+class BrainWorker:
+    """One scoring node. `tick()` processes one claim-fetch-judge-write
+    cycle; `run()` loops forever."""
+
+    def __init__(
+        self,
+        store: JobStore,
+        source: MetricSource,
+        config: BrainConfig | None = None,
+        judge: HealthJudge | None = None,
+        worker_id: str | None = None,
+        claim_limit: int = 256,
+        on_verdict: Callable[[Document, list[MetricVerdict]], None] | None = None,
+    ):
+        self.store = store
+        self.source = source
+        self.config = config or BrainConfig()
+        self.judge = judge or HealthJudge(self.config)
+        self.worker_id = worker_id or f"brain-{uuid.uuid4().hex[:8]}"
+        self.claim_limit = claim_limit
+        self.on_verdict = on_verdict  # gauge-export hook (observe/)
+
+    # -- preprocess: document -> MetricTasks ----------------------------
+
+    def _fetch_tasks(self, doc: Document) -> list[MetricTask] | None:
+        """Fetch every window of every alias; None => preprocess failure."""
+        cur = decode_config(doc.current_config)
+        base = decode_config(doc.baseline_config)
+        hist = decode_config(doc.historical_config)
+        if not cur:
+            return None
+        tasks = []
+        try:
+            for alias, cur_url in cur.items():
+                ct, cv = self.source.fetch(cur_url)
+                ht, hv = (
+                    self.source.fetch(hist[alias])
+                    if alias in hist
+                    else (ct[:0], cv[:0])
+                )
+                kw = {}
+                if alias in base:
+                    bt, bv = self.source.fetch(base[alias])
+                    kw = dict(base_times=bt, base_values=bv)
+                tasks.append(
+                    MetricTask(
+                        job_id=doc.id,
+                        alias=alias,
+                        metric_type=infer_metric_type(alias, self.config),
+                        hist_times=ht,
+                        hist_values=hv,
+                        cur_times=ct,
+                        cur_values=cv,
+                        **kw,
+                    )
+                )
+        except Exception as e:  # fetch failures fail the preprocess stage
+            log.warning("preprocess failed for %s: %s", doc.id, e)
+            return None
+        return tasks
+
+    # -- postprocess: verdicts -> document status -----------------------
+
+    def _write_back(
+        self, doc: Document, verdicts: list[MetricVerdict], now: float
+    ) -> Document:
+        job_verdict = combine_verdicts(verdicts)
+        past_end = now >= _parse_time(doc.end_time) > 0
+        if job_verdict == UNHEALTHY:
+            # fail fast (design.md:43)
+            doc.status = STATUS_COMPLETED_UNHEALTH
+            doc.status_code = "200"
+            doc.reason = "anomaly detected"
+            doc.anomaly_info = AnomalyInfo(
+                tags="",
+                values={
+                    v.alias: v.anomaly_pairs for v in verdicts if v.anomaly_pairs
+                },
+            ).to_json()
+        elif past_end:
+            # window closed with no anomaly: healthy unless nothing measured
+            if job_verdict == UNKNOWN:
+                doc.status = STATUS_COMPLETED_UNKNOWN
+                doc.reason = "insufficient data"
+            else:
+                doc.status = STATUS_COMPLETED_HEALTH
+                doc.reason = ""
+            doc.status_code = "200"
+        else:
+            # keep re-checking until endTime (incremental re-check loop)
+            doc.status = STATUS_PREPROCESS_COMPLETED
+        return self.store.update(doc)
+
+    # -- main cycle ------------------------------------------------------
+
+    def tick(self, now: float | None = None) -> int:
+        """One claim-fetch-judge-write cycle. Returns #docs processed."""
+        now = time.time() if now is None else now
+        docs = self.store.claim(
+            self.worker_id, self.config.max_stuck_seconds, self.claim_limit
+        )
+        if not docs:
+            return 0
+
+        all_tasks: list[MetricTask] = []
+        failed: list[Document] = []
+        ok_docs: list[Document] = []
+        for doc in docs:
+            doc.status = STATUS_PREPROCESS_INPROGRESS
+            self.store.update(doc)
+            tasks = self._fetch_tasks(doc)
+            if tasks is None:
+                doc.status = STATUS_PREPROCESS_FAILED
+                doc.status_code = "500"
+                doc.reason = "metric fetch failed"
+                self.store.update(doc)
+                failed.append(doc)
+            else:
+                ok_docs.append(doc)
+                all_tasks.extend(tasks)
+
+        # ONE batched judgment for every window of every claimed job
+        verdicts = self.judge.judge(all_tasks)
+        by_job: dict[str, list[MetricVerdict]] = {}
+        for v in verdicts:
+            by_job.setdefault(v.job_id, []).append(v)
+
+        for doc in ok_docs:
+            vs = by_job.get(doc.id, [])
+            self._write_back(doc, vs, now)
+            if self.on_verdict:
+                try:
+                    self.on_verdict(doc, vs)
+                except Exception:
+                    log.exception("on_verdict hook failed for %s", doc.id)
+        return len(docs)
+
+    def run(self, poll_seconds: float = 5.0, stop: Callable[[], bool] | None = None):
+        """Poll forever (the shared-nothing worker loop, design.md:35-43)."""
+        while not (stop and stop()):
+            n = self.tick()
+            if n == 0:
+                time.sleep(poll_seconds)

@@ -1,0 +1,137 @@
+"""Metric sources: where the brain fetches its windows from.
+
+The reference brain HTTP-GETs each `query_range` URL stored in the ES
+document's config strings (SURVEY.md section 3.2). Sources here:
+
+  * `PrometheusSource` — real HTTP fetch (requests), parsing the
+    query_range JSON matrix response;
+  * `ReplaySource` — serves deterministic CSV traces keyed by substring
+    match on the URL/query, the TPU-build analog of the reference demo's
+    `FileErrorGenerator` replay (`error/FileErrorGenerator.java:27-37`) —
+    drives golden end-to-end tests without a live Prometheus;
+  * `StaticSource` — direct alias->series map for unit tests.
+
+All return (times: int64[N], values: float32[N]) numpy arrays.
+"""
+
+from __future__ import annotations
+
+import csv
+from datetime import datetime, timezone
+from typing import Callable, Mapping
+
+import numpy as np
+
+Series = tuple[np.ndarray, np.ndarray]
+
+
+def _empty() -> Series:
+    return np.zeros(0, np.int64), np.zeros(0, np.float32)
+
+
+class MetricSource:
+    def fetch(self, url: str) -> Series:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class PrometheusSource(MetricSource):
+    """Fetches query_range URLs; merges a multi-series result by summing
+    values per timestamp (recording rules normally return one series)."""
+
+    def __init__(self, session=None, timeout: float = 10.0):
+        import requests
+
+        self._session = session or requests.Session()
+        self.timeout = timeout
+
+    def fetch(self, url: str) -> Series:
+        resp = self._session.get(url, timeout=self.timeout)
+        resp.raise_for_status()
+        body = resp.json()
+        if body.get("status") != "success":
+            raise RuntimeError(f"prometheus error response: {body.get('error')}")
+        result = body.get("data", {}).get("result", [])
+        acc: dict[int, float] = {}
+        for series in result:
+            for t, v in series.get("values", []):
+                try:
+                    acc[int(float(t))] = acc.get(int(float(t)), 0.0) + float(v)
+                except (TypeError, ValueError):
+                    continue  # NaN/"+Inf" samples are dropped, not fatal
+        if not acc:
+            return _empty()
+        ts = np.asarray(sorted(acc), np.int64)
+        vs = np.asarray([acc[t] for t in ts], np.float32)
+        return ts, vs
+
+
+def load_csv_trace(path: str, t0: int | None = None, step: int = 60) -> Series:
+    """Load a `timestamp,value` or `value`-per-line CSV trace (the demo's
+    data1/data2 format: `YYYY-MM-DD HH:MM:SS,value`)."""
+    ts: list[int] = []
+    vs: list[float] = []
+    with open(path) as f:
+        for row in csv.reader(f):
+            if not row:
+                continue
+            if len(row) == 1:
+                vs.append(float(row[0]))
+                ts.append(0)
+            else:
+                raw = row[0].strip()
+                try:
+                    t = int(float(raw))
+                except ValueError:
+                    t = int(
+                        datetime.strptime(raw, "%Y-%m-%d %H:%M:%S")
+                        .replace(tzinfo=timezone.utc)
+                        .timestamp()
+                    )
+                ts.append(t)
+                vs.append(float(row[1]))
+    times = np.asarray(ts, np.int64)
+    if t0 is not None or (times == 0).all():
+        base = 0 if t0 is None else t0
+        times = base + step * np.arange(len(vs), dtype=np.int64)
+    return times, np.asarray(vs, np.float32)
+
+
+class ReplaySource(MetricSource):
+    """Serves canned traces by substring match against the fetched URL.
+
+    Register patterns most-specific first; an unmatched URL returns an
+    empty series (the brain then yields UNKNOWN, not a crash).
+    """
+
+    def __init__(self):
+        self._routes: list[tuple[str, Callable[[], Series]]] = []
+
+    def register(self, pattern: str, series: Series | Callable[[], Series]):
+        fn = series if callable(series) else (lambda s=series: s)
+        self._routes.append((pattern, fn))
+        return self
+
+    def register_csv(self, pattern: str, path: str, t0: int | None = None):
+        return self.register(pattern, lambda: load_csv_trace(path, t0=t0))
+
+    def fetch(self, url: str) -> Series:
+        from urllib.parse import unquote
+
+        target = unquote(url)
+        for pattern, fn in self._routes:
+            if pattern in target:
+                return fn()
+        return _empty()
+
+
+class StaticSource(MetricSource):
+    """alias-keyed direct map (unit tests)."""
+
+    def __init__(self, data: Mapping[str, Series]):
+        self.data = dict(data)
+
+    def fetch(self, url: str) -> Series:
+        for key, series in self.data.items():
+            if key in url:
+                return series
+        return _empty()

@@ -50,7 +50,10 @@ def fit_bivariate(
         [jnp.stack([sxx, sxy], -1), jnp.stack([sxy, syy], -1)], axis=-2
     )
     det = sxx * syy - sxy * sxy
-    valid = (n >= min_points) & (det > 1e-12)
+    # relative conditioning test: scale-free, so low-magnitude metric pairs
+    # (e.g. error rates ~1e-4) stay valid while truly degenerate
+    # (perfectly-correlated or zero-variance) fits are rejected
+    valid = (n >= min_points) & (det > 1e-6 * sxx * syy) & (sxx * syy > 0)
     return BivariateFit(mean=mean, cov=cov, valid=valid)
 
 

@@ -66,5 +66,5 @@ class ModelCache:
         tree = ckptr.restore(path)
         keys, values = tree["keys"], tree["values"]
         for k, v in zip(keys, values):
-            self.put(key_parser(k) if key_parser else k, jax.tree.map(lambda a: a, v))
+            self.put(key_parser(k) if key_parser else k, v)
         return len(keys)

@@ -13,9 +13,7 @@ TPU-first design:
     steps carry state through unchanged so ragged windows batch cleanly;
   * all matmuls are [B, F]x[F, 4H] / [B, H]x[H, 4H] — MXU-shaped, and the
     4H gate axis is the natural tensor-parallel shard axis (see
-    `parallel/mesh.py` and `__graft_entry__.dryrun_multichip`);
-  * training in float32 master params with optional bfloat16 compute
-    (TPU MXU native dtype).
+    `parallel/mesh.py` and `__graft_entry__.dryrun_multichip`).
 
 Scoring: per-step reconstruction error; a window is anomalous where the
 error exceeds `threshold x` the model's training-time error scale — the
@@ -53,7 +51,6 @@ class LSTMAEConfig:
     features: int = 4  # metrics per service (latency/err4xx/err5xx/tps)
     hidden: int = 32
     learning_rate: float = 1e-2
-    compute_dtype: jnp.dtype = jnp.float32
 
 
 def init(key: jax.Array, cfg: LSTMAEConfig) -> AEParams:
@@ -211,9 +208,12 @@ def shardings(mesh, params, opt_state, hidden: int):
     gate = 4 * hidden
 
     def spec(leaf):
-        dims = ["data"] + [
-            "model" if d == gate else None for d in leaf.shape[1:]
-        ]
+        # the gate axis is always the LAST axis of w_x/w_h/b; only the last
+        # dim is considered so a coincidental inner dim == 4H (e.g.
+        # features == 4*hidden) can't produce a duplicated mesh axis
+        dims = ["data"] + [None] * (leaf.ndim - 2)
+        if leaf.ndim >= 2:
+            dims.append("model" if leaf.shape[-1] == gate else None)
         return NamedSharding(mesh, P(*dims))
 
     return jax.tree.map(spec, params), jax.tree.map(spec, opt_state)

@@ -1,0 +1,20 @@
+"""Observability: gauge export, structured logs, profiler hooks."""
+
+from foremast_tpu.observe.gauges import (
+    BrainGauges,
+    make_verdict_hook,
+    start_metrics_server,
+)
+from foremast_tpu.observe.logs import JsonFormatter, ctx_log, setup_logging
+from foremast_tpu.observe.profile import annotate, trace_scoring
+
+__all__ = [
+    "BrainGauges",
+    "make_verdict_hook",
+    "start_metrics_server",
+    "JsonFormatter",
+    "ctx_log",
+    "setup_logging",
+    "annotate",
+    "trace_scoring",
+]

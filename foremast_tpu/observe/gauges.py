@@ -1,0 +1,102 @@
+"""Prometheus gauge export — the brain's signature observability feature.
+
+The reference brain re-publishes its model outputs as first-class
+Prometheus series scraped from :8000/metrics
+(`deploy/foremast/3_brain/foremast-brain.yaml:87-122`):
+`foremastbrain:<metric>_upper`, `_lower`, `_anomaly` with
+`exported_namespace`/`app` labels (`foremast-browser/src/config/metrics.js:15-23`)
+— model internals become dashboards and alert-rule inputs
+(`types.go:190-191`). Same here, via prometheus_client.
+
+Note: prometheus_client forbids ':' in metric names (it is the PromQL
+recording-rule separator); the reference's names come from recording-style
+gauge registration. We export `foremastbrain_<metric>_upper` and rely on
+relabeling (or the provided recording rules in deploy/) for the exact
+`foremastbrain:` spelling — documented divergence.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+
+_SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _san(name: str) -> str:
+    return _SANITIZE.sub("_", name)
+
+
+class BrainGauges:
+    """Lazily-created per-metric gauge triplets with a bounded family set."""
+
+    def __init__(self, registry=None, namespace: str = "foremastbrain"):
+        from prometheus_client import REGISTRY, Gauge
+
+        self._Gauge = Gauge
+        self.registry = registry if registry is not None else REGISTRY
+        self.ns = namespace
+        self._fams: dict[str, tuple] = {}
+        self._lock = threading.Lock()
+
+    def _family(self, metric: str):
+        key = _san(metric)
+        with self._lock:
+            if key not in self._fams:
+                mk = lambda suffix, doc: self._Gauge(
+                    f"{self.ns}_{key}_{suffix}",
+                    doc,
+                    ["exported_namespace", "app"],
+                    registry=self.registry,
+                )
+                self._fams[key] = (
+                    mk("upper", f"model upper bound for {metric}"),
+                    mk("lower", f"model lower bound for {metric}"),
+                    mk("anomaly", f"last anomalous value for {metric}"),
+                )
+            return self._fams[key]
+
+    def publish(
+        self,
+        metric: str,
+        namespace: str,
+        app: str,
+        upper: float,
+        lower: float,
+        anomaly_value: float | None = None,
+    ) -> None:
+        up, lo, an = self._family(metric)
+        labels = dict(exported_namespace=namespace, app=app)
+        up.labels(**labels).set(upper)
+        lo.labels(**labels).set(lower)
+        if anomaly_value is not None:
+            an.labels(**labels).set(anomaly_value)
+
+
+def make_verdict_hook(gauges: BrainGauges, namespace: str = "default"):
+    """BrainWorker.on_verdict adapter: publish the latest band edge and
+    anomalous value per metric after each judgment."""
+
+    def hook(doc, verdicts):
+        for v in verdicts:
+            if len(v.upper) == 0:
+                continue
+            gauges.publish(
+                metric=v.alias,
+                namespace=namespace,
+                app=doc.app_name,
+                upper=float(v.upper[-1]),
+                lower=float(v.lower[-1]),
+                anomaly_value=(
+                    float(v.anomaly_pairs[-1]) if v.anomaly_pairs else None
+                ),
+            )
+
+    return hook
+
+
+def start_metrics_server(port: int = 8000, registry=None):
+    """Serve /metrics on :8000 (the reference brain's scrape port)."""
+    from prometheus_client import REGISTRY, start_http_server
+
+    return start_http_server(port, registry=registry or REGISTRY)

@@ -1,0 +1,42 @@
+"""Structured leveled logging for all components.
+
+The reference scatters glog V-levels (barrelman), gin logs (service), and
+an unused leveled-logger scaffold (`foremast-service/pkg/common/logger.go`);
+here one JSON-lines logger serves every component.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(time.time(), 3),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        extra = getattr(record, "ctx", None)
+        if extra:
+            out.update(extra)
+        return json.dumps(out)
+
+
+def setup_logging(level: int = logging.INFO, stream=None) -> None:
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(JsonFormatter())
+    root = logging.getLogger("foremast_tpu")
+    root.handlers[:] = [handler]
+    root.setLevel(level)
+    root.propagate = False
+
+
+def ctx_log(logger: logging.Logger, level: int, msg: str, **ctx) -> None:
+    logger.log(level, msg, extra={"ctx": ctx})

@@ -1,0 +1,33 @@
+"""Profiling hooks around the scoring loop (SURVEY.md section 5: the reference
+has none; the new build adds jax.profiler traces + optional Perfetto dumps)."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+
+@contextlib.contextmanager
+def trace_scoring(out_dir: str | None = None, create_perfetto_link: bool = False):
+    """Wrap a scoring region in a jax.profiler trace when FOREMAST_PROFILE
+    (or an explicit out_dir) is set; no-op otherwise."""
+    import jax
+
+    target = out_dir or os.environ.get("FOREMAST_PROFILE")
+    if not target:
+        yield
+        return
+    jax.profiler.start_trace(target, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named sub-region (shows up in the trace timeline)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

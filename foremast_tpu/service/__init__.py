@@ -1,0 +1,5 @@
+"""REST job gateway (foremast-service equivalent)."""
+
+from foremast_tpu.service.app import make_app, serve
+
+__all__ = ["make_app", "serve"]

@@ -1,0 +1,120 @@
+"""REST job gateway — the foremast-service equivalent (aiohttp).
+
+Route parity with `foremast-service/cmd/manager/main.go:262-276`:
+
+    POST /v1/healthcheck/create      -> RegisterEntry
+    GET  /v1/healthcheck/id/{id}     -> SearchByID
+    GET  /api/v1/{queryproxy}        -> CORS Prometheus proxy (UI)
+
+plus GET /healthz. The gateway validates + converts requests
+(`request_to_document`), creates jobs idempotently in the store, and
+serves external-status views; scoring happens in the BrainWorker against
+the same store.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from aiohttp import web
+
+from foremast_tpu.jobs.convert import InvalidRequest, request_to_document
+from foremast_tpu.jobs.models import AnalyzeRequest, document_response, status_to_external
+from foremast_tpu.jobs.store import InMemoryStore, JobStore
+
+log = logging.getLogger("foremast_tpu.service")
+
+STORE_KEY = web.AppKey("store", JobStore)
+
+CORS_HEADERS = {
+    "Access-Control-Allow-Origin": "*",
+    "Access-Control-Allow-Methods": "GET, POST, OPTIONS",
+    "Access-Control-Allow-Headers": "Content-Type",
+}
+
+
+def make_app(
+    store: JobStore | None = None,
+    query_endpoint: str | None = None,
+) -> web.Application:
+    """query_endpoint: upstream Prometheus base (QUERY_SERVICE_ENDPOINT env
+    in the reference, `main.go:236-243`)."""
+    store = store if store is not None else InMemoryStore()
+    query_endpoint = query_endpoint or os.environ.get("QUERY_SERVICE_ENDPOINT", "")
+
+    async def create(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response(
+                {"status": "error", "reason": "invalid JSON"}, status=400
+            )
+        try:
+            req = AnalyzeRequest.from_json(body)
+            doc = request_to_document(req)
+        except InvalidRequest as e:
+            return web.json_response(
+                {"status": "error", "reason": str(e)}, status=400
+            )
+        stored, created = store.create(doc)
+        # ApplicationHealthAnalyzeResponse shape (models.go:63-80)
+        return web.json_response(
+            {
+                "jobId": stored.id,
+                "statusCode": 201 if created else 208,
+                "status": status_to_external(stored.status),
+                "reason": "",
+            },
+            status=200,
+        )
+
+    async def by_id(request: web.Request) -> web.Response:
+        doc = store.get(request.match_info["id"])
+        if doc is None:
+            return web.json_response(
+                {"status": "error", "reason": "not found"}, status=404
+            )
+        return web.json_response(document_response(doc))
+
+    async def query_proxy(request: web.Request) -> web.Response:
+        """GET /api/v1/{queryproxy} — forwards to the query service with
+        CORS for the browser UI (`main.go:214-233`)."""
+        if not query_endpoint:
+            return web.json_response(
+                {"status": "error", "reason": "no QUERY_SERVICE_ENDPOINT"},
+                status=502,
+                headers=CORS_HEADERS,
+            )
+        import aiohttp
+
+        target = (
+            query_endpoint.rstrip("/")
+            + "/api/v1/"
+            + request.match_info["queryproxy"]
+        )
+        async with aiohttp.ClientSession() as session:
+            async with session.get(target, params=request.rel_url.query) as r:
+                body = await r.read()
+                return web.Response(
+                    body=body,
+                    status=r.status,
+                    content_type=r.content_type,
+                    headers=CORS_HEADERS,
+                )
+
+    async def healthz(request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    app = web.Application()
+    app.router.add_post("/v1/healthcheck/create", create)
+    app.router.add_get("/v1/healthcheck/id/{id}", by_id)
+    app.router.add_get("/api/v1/{queryproxy}", query_proxy)
+    app.router.add_get("/healthz", healthz)
+    app[STORE_KEY] = store
+    return app
+
+
+def serve(host: str = "0.0.0.0", port: int = 8099, **kwargs) -> None:
+    """Blocking server on :8099 (the reference service's port)."""
+    web.run_app(make_app(**kwargs), host=host, port=port)

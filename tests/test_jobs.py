@@ -1,0 +1,275 @@
+"""Job-plane tests: wire parity, idempotent ids, store semantics, and the
+batched worker end-to-end on the golden traces."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from foremast_tpu.config import BrainConfig
+from foremast_tpu.jobs import (
+    AnalyzeRequest,
+    BrainWorker,
+    Document,
+    InMemoryStore,
+    MetricQuery,
+    MetricsInfo,
+    STATUS_COMPLETED_HEALTH,
+    STATUS_COMPLETED_UNHEALTH,
+    STATUS_COMPLETED_UNKNOWN,
+    STATUS_INITIAL,
+    STATUS_PREPROCESS_COMPLETED,
+    STATUS_PREPROCESS_FAILED,
+    STATUS_PREPROCESS_INPROGRESS,
+    document_response,
+    infer_metric_type,
+    job_id,
+    status_to_external,
+)
+from foremast_tpu.jobs.convert import InvalidRequest, request_to_document
+from foremast_tpu.metrics import (
+    ReplaySource,
+    StaticSource,
+    decode_config,
+    encode_config,
+    prometheus_url,
+    wavefront_url,
+)
+
+
+# ---------------------------------------------------------------------------
+# status machine / wire parity
+# ---------------------------------------------------------------------------
+
+
+def test_status_translation_matches_converter_go():
+    # converter.go:13-26
+    assert status_to_external("initial") == "new"
+    assert status_to_external("preprocess_inprogress") == "inprogress"
+    assert status_to_external("postprocess_inprogress") == "inprogress"
+    assert status_to_external("preprocess_completed") == "inprogress"
+    assert status_to_external("completed_health") == "success"
+    assert status_to_external("completed_unhealth") == "anomaly"
+    assert status_to_external("completed_unknown") == "abort"
+    assert status_to_external("preprocess_failed") == "abort"
+    assert status_to_external("weird") == "weird"  # default branch passthrough
+
+
+def test_job_id_idempotent_and_distinct():
+    a = job_id("app", "1", "2", ("c", "b", "h"), ("p", "p", "p"), "canary")
+    b = job_id("app", "1", "2", ("c", "b", "h"), ("p", "p", "p"), "canary")
+    c = job_id("app", "1", "2", ("c2", "b", "h"), ("p", "p", "p"), "canary")
+    assert a == b != c
+    assert len(a) == 64  # hex sha256
+
+
+def test_config_string_codec_roundtrip():
+    # main.go:28-31 separators: " ||" and "== "
+    queries = {
+        "latency": MetricQuery(
+            "prometheus",
+            {"endpoint": "http://p/api/v1/", "query": "up{a=\"b\"}", "start": 1, "end": 2, "step": 60},
+        ),
+        "error5xx": MetricQuery(
+            "prometheus",
+            {"endpoint": "http://p/api/v1/", "query": "err", "start": 1, "end": 2, "step": 60},
+        ),
+    }
+    cfg, src = encode_config(queries)
+    assert " ||" in cfg and "== " in cfg
+    decoded = decode_config(cfg)
+    assert set(decoded) == {"latency", "error5xx"}
+    assert decoded["latency"].startswith("http://p/api/v1/query_range?query=up")
+    assert src == "error5xx== prometheus ||latency== prometheus"
+
+
+def test_prometheus_url_builder():
+    # prometheushelper.go:12-27
+    url = prometheus_url(
+        {"endpoint": "http://prom/api/v1/", "query": 'up{pod=~"a|b"}', "start": 10, "end": 20, "step": 60}
+    )
+    assert url == (
+        "http://prom/api/v1/query_range?query=up%7Bpod%3D~%22a%7Cb%22%7D"
+        "&start=10&end=20&step=60"
+    )
+
+
+def test_wavefront_url_builder():
+    # wavefronthelper.go:20-29
+    assert wavefront_url({"query": "ts(x)", "start": 1, "end": 2, "step": 60}) == "ts(x)&&1&&m&&2"
+    assert wavefront_url({"query": "q", "start": 1, "end": 2, "step": 3600}) == "q&&1&&h&&2"
+
+
+def test_request_to_document_validation_and_id():
+    req = AnalyzeRequest(
+        app_name="demo",
+        start_time="2026-07-29T00:00:00Z",
+        end_time="2026-07-29T00:10:00Z",
+        metrics=MetricsInfo(
+            current={
+                "error5xx": MetricQuery(
+                    "prometheus",
+                    {"endpoint": "http://p/", "query": "e", "start": 1, "end": 2, "step": 60},
+                )
+            }
+        ),
+        strategy="rollingUpdate",
+    )
+    doc = request_to_document(req)
+    assert doc.status == STATUS_INITIAL
+    assert doc.id == request_to_document(req).id  # idempotent
+    assert "error5xx== " in doc.current_config
+    assert doc.current_metric_store == "error5xx== prometheus"
+
+    with pytest.raises(InvalidRequest):
+        request_to_document(AnalyzeRequest("", "", "", MetricsInfo(), "x"))
+    with pytest.raises(InvalidRequest):
+        request_to_document(AnalyzeRequest("a", "", "", MetricsInfo(), "x"))
+
+
+def test_document_response_shape():
+    doc = Document(id="j1", app_name="demo", status="completed_unhealth")
+    doc.anomaly_info = {"tags": "", "values": {"m": [1.0, 2.0]}}
+    resp = document_response(doc)
+    assert resp["jobId"] == "j1"
+    assert resp["status"] == "anomaly"
+    assert resp["anomalyInfo"]["values"]["m"] == [1.0, 2.0]
+
+
+# ---------------------------------------------------------------------------
+# store semantics
+# ---------------------------------------------------------------------------
+
+
+def test_inmemory_store_idempotent_create():
+    s = InMemoryStore()
+    d1, created1 = s.create(Document(id="a", app_name="x"))
+    d2, created2 = s.create(Document(id="a", app_name="x"))
+    assert created1 and not created2
+    assert d1 is d2
+
+
+def test_inmemory_claim_and_stuck_takeover():
+    s = InMemoryStore()
+    s.create(Document(id="a", app_name="x"))
+    docs = s.claim("w1", max_stuck_seconds=90)
+    assert [d.id for d in docs] == ["a"]
+    # mark in-progress recently: not claimable again
+    docs[0].status = STATUS_PREPROCESS_INPROGRESS
+    s.update(docs[0])
+    assert s.claim("w2", max_stuck_seconds=90) == []
+    # simulate staleness: claimable again (work stealing, design.md:39)
+    stale = s.get("a")
+    stale.modified_at = "2020-01-01T00:00:00Z"
+    s._docs["a"] = stale
+    stolen = s.claim("w2", max_stuck_seconds=90)
+    assert [d.id for d in stolen] == ["a"]
+    # terminal docs never claimable
+    stale.status = STATUS_COMPLETED_HEALTH
+    s.update(stale)
+    assert s.claim("w3", max_stuck_seconds=0) == []
+
+
+# ---------------------------------------------------------------------------
+# worker end-to-end on golden traces
+# ---------------------------------------------------------------------------
+
+
+def _mk_doc(app, alias, cur_key, end_time="0"):
+    return Document(
+        id=f"job-{app}-{alias}-{cur_key}",
+        app_name=app,
+        end_time=end_time,
+        current_config=f"{alias}== http://replay/{cur_key}",
+        baseline_config="",
+        historical_config=f"{alias}== http://replay/hist",
+        strategy="rollingUpdate",
+    )
+
+
+@pytest.fixture
+def replay(demo_traces):
+    nt, nv = demo_traces["normal"]
+    st, sv = demo_traces["spike"]
+    hist = np.tile(nv, 6)
+    ht = 1700000000 + 60 * np.arange(len(hist), dtype=np.int64)
+    src = ReplaySource()
+    src.register("replay/hist", (ht, hist.astype(np.float32)))
+    src.register("replay/normal", (nt, nv))
+    src.register("replay/spike", (st, sv))
+    return src
+
+
+def test_worker_flags_spike_trace(replay):
+    store = InMemoryStore()
+    store.create(_mk_doc("demo", "error4xx", "spike"))
+    worker = BrainWorker(store, replay, BrainConfig())
+    n = worker.tick()
+    assert n == 1
+    doc = store.get("job-demo-error4xx-spike")
+    assert doc.status == STATUS_COMPLETED_UNHEALTH
+    vals = doc.anomaly_info["values"]["error4xx"]
+    assert any(v > 30 for v in vals[1::2])  # the 40.134 spike in wire pairs
+
+
+def test_worker_healthy_past_endtime(replay):
+    store = InMemoryStore()
+    store.create(_mk_doc("demo", "error4xx", "normal", end_time="100"))
+    worker = BrainWorker(store, replay, BrainConfig())
+    worker.tick(now=1e12)  # far past end_time
+    doc = store.get("job-demo-error4xx-normal")
+    assert doc.status == STATUS_COMPLETED_HEALTH
+
+
+def test_worker_rechecks_until_endtime(replay):
+    store = InMemoryStore()
+    future = str(int(time.time()) + 3600)
+    store.create(_mk_doc("demo", "error4xx", "normal", end_time=future))
+    worker = BrainWorker(store, replay, BrainConfig())
+    worker.tick()
+    doc = store.get("job-demo-error4xx-normal")
+    # healthy-so-far but window still open -> keep re-checking
+    assert doc.status == STATUS_PREPROCESS_COMPLETED
+
+
+def test_worker_preprocess_failure():
+    class Boom:
+        def fetch(self, url):
+            raise RuntimeError("prometheus down")
+
+    store = InMemoryStore()
+    store.create(_mk_doc("demo", "m", "x"))
+    worker = BrainWorker(store, Boom(), BrainConfig())
+    worker.tick()
+    assert store.get("job-demo-m-x").status == STATUS_PREPROCESS_FAILED
+
+
+def test_worker_unknown_on_empty_data(replay):
+    store = InMemoryStore()
+    doc = _mk_doc("demo", "m", "missing", end_time="100")
+    store.create(doc)
+    worker = BrainWorker(store, replay, BrainConfig())
+    worker.tick(now=1e12)
+    assert store.get(doc.id).status == STATUS_COMPLETED_UNKNOWN
+
+
+def test_worker_batches_multiple_jobs(replay):
+    store = InMemoryStore()
+    for i in range(5):
+        store.create(_mk_doc(f"app{i}", "error4xx", "normal", end_time="100"))
+    store.create(_mk_doc("bad", "error4xx", "spike"))
+    worker = BrainWorker(store, replay, BrainConfig())
+    n = worker.tick(now=1e12)
+    assert n == 6
+    statuses = {d.id: d.status for d in store._docs.values()}
+    assert statuses["job-bad-error4xx-spike"] == STATUS_COMPLETED_UNHEALTH
+    healthy = [s for s in statuses.values() if s == STATUS_COMPLETED_HEALTH]
+    assert len(healthy) == 5
+
+
+def test_infer_metric_type():
+    cfg = BrainConfig()
+    assert infer_metric_type("http_error5xx_rate", cfg) == "error5xx"
+    assert infer_metric_type("p99Latency", cfg) == "latency"
+    assert infer_metric_type("tps", cfg) is None

@@ -1,0 +1,131 @@
+"""Service REST facade tests: wire parity + full create->score->poll loop."""
+
+import asyncio
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from foremast_tpu.config import BrainConfig
+from foremast_tpu.jobs import BrainWorker, InMemoryStore
+from foremast_tpu.metrics import ReplaySource
+from foremast_tpu.service import make_app
+
+
+def _run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+CREATE_BODY = {
+    "appName": "demo",
+    "startTime": "2026-07-29T00:00:00Z",
+    "endTime": "2026-07-29T00:10:00Z",
+    "strategy": "rollingUpdate",
+    "metrics": {
+        "current": {
+            "error4xx": {
+                "dataSourceType": "prometheus",
+                "parameters": {
+                    "endpoint": "http://replay/cur/",
+                    "query": "spiketrace",
+                    "start": 1,
+                    "end": 600,
+                    "step": 60,
+                },
+            }
+        },
+        "historical": {
+            "error4xx": {
+                "dataSourceType": "prometheus",
+                "parameters": {
+                    "endpoint": "http://replay/hist/",
+                    "query": "histtrace",
+                    "start": 1,
+                    "end": 600,
+                    "step": 60,
+                },
+            }
+        },
+    },
+}
+
+
+def test_create_and_poll_lifecycle(demo_traces):
+    async def main():
+        store = InMemoryStore()
+        app = make_app(store=store)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            # create
+            r = await client.post("/v1/healthcheck/create", json=CREATE_BODY)
+            assert r.status == 200
+            body = await r.json()
+            job_id = body["jobId"]
+            assert body["status"] == "new" and body["statusCode"] == 201
+
+            # idempotent re-create returns the same job
+            r2 = await client.post("/v1/healthcheck/create", json=CREATE_BODY)
+            body2 = await r2.json()
+            assert body2["jobId"] == job_id and body2["statusCode"] == 208
+
+            # poll: new
+            r3 = await client.get(f"/v1/healthcheck/id/{job_id}")
+            assert (await r3.json())["status"] == "new"
+
+            # score out-of-band (the worker loop)
+            nt, nv = demo_traces["normal"]
+            st, sv = demo_traces["spike"]
+            hist = np.tile(nv, 6).astype(np.float32)
+            ht = 1700000000 + 60 * np.arange(len(hist), dtype=np.int64)
+            src = ReplaySource()
+            src.register("histtrace", (ht, hist))
+            src.register("spiketrace", (st, sv))
+            BrainWorker(store, src, BrainConfig()).tick(now=1e12)
+
+            # poll: anomaly with flat wire pairs
+            r4 = await client.get(f"/v1/healthcheck/id/{job_id}")
+            out = await r4.json()
+            assert out["status"] == "anomaly"
+            vals = out["anomalyInfo"]["values"]["error4xx"]
+            assert any(v > 30 for v in vals[1::2])
+        finally:
+            await client.close()
+
+    _run(main())
+
+
+def test_create_validation_errors():
+    async def main():
+        client = TestClient(TestServer(make_app(store=InMemoryStore())))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/healthcheck/create", json={"appName": ""})
+            assert r.status == 400
+            r = await client.post(
+                "/v1/healthcheck/create", data=b"not json",
+                headers={"Content-Type": "application/json"},
+            )
+            assert r.status == 400
+            r = await client.get("/v1/healthcheck/id/nope")
+            assert r.status == 404
+            r = await client.get("/healthz")
+            assert r.status == 200
+        finally:
+            await client.close()
+
+    _run(main())
+
+
+def test_query_proxy_cors_and_gating():
+    async def main():
+        client = TestClient(TestServer(make_app(store=InMemoryStore(), query_endpoint="")))
+        await client.start_server()
+        try:
+            r = await client.get("/api/v1/query_range", params={"query": "up"})
+            assert r.status == 502  # no upstream configured
+            assert r.headers["Access-Control-Allow-Origin"] == "*"
+        finally:
+            await client.close()
+
+    _run(main())
