@@ -30,6 +30,7 @@ from foremast_tpu.jobs.models import (
     CLAIMABLE_STATUSES,
     STATUS_INITIAL,
     STATUS_PREPROCESS_COMPLETED,
+    STATUS_PREPROCESS_INPROGRESS,
     TERMINAL_STATUSES,
     Document,
 )
@@ -114,6 +115,10 @@ class InMemoryStore(JobStore):
                 if len(out) >= limit:
                     break
                 if _is_claimable(doc, now, max_stuck_seconds):
+                    # flip to in-progress inside the lock so a concurrent
+                    # claimer sees the doc as taken (not claimable again
+                    # until the stuck timeout)
+                    doc.status = STATUS_PREPROCESS_INPROGRESS
                     doc.modified_at = now_rfc3339()
                     doc.processing_content = worker_id
                     out.append(doc)
@@ -196,6 +201,7 @@ class ElasticsearchStore(JobStore):
     def claim(self, worker_id: str, max_stuck_seconds: float, limit: int = 64):
         query = {
             "size": limit,
+            "seq_no_primary_term": True,  # required for the CAS params below
             "query": {
                 "terms": {"status": list(CLAIMABLE_STATUSES)}
             },
@@ -211,6 +217,7 @@ class ElasticsearchStore(JobStore):
             doc = Document.from_json(h["_source"])
             if not _is_claimable(doc, now, max_stuck_seconds):
                 continue
+            doc.status = STATUS_PREPROCESS_INPROGRESS
             doc.modified_at = now_rfc3339()
             doc.processing_content = worker_id
             # optimistic concurrency: seq_no/primary_term CAS

@@ -49,7 +49,8 @@ log = logging.getLogger("foremast_tpu.worker")
 
 
 def _parse_time(s: str) -> float:
-    """RFC3339 or unix-seconds string -> epoch seconds (0 if unparseable)."""
+    """RFC3339 (any ISO-8601 offset form) or unix-seconds string -> epoch
+    seconds (0 if unparseable)."""
     if not s:
         return 0.0
     try:
@@ -57,11 +58,10 @@ def _parse_time(s: str) -> float:
     except ValueError:
         pass
     try:
-        return (
-            datetime.strptime(s, "%Y-%m-%dT%H:%M:%SZ")
-            .replace(tzinfo=timezone.utc)
-            .timestamp()
-        )
+        dt = datetime.fromisoformat(s.replace("Z", "+00:00"))
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=timezone.utc)
+        return dt.timestamp()
     except ValueError:
         return 0.0
 
@@ -144,7 +144,10 @@ class BrainWorker:
         self, doc: Document, verdicts: list[MetricVerdict], now: float
     ) -> Document:
         job_verdict = combine_verdicts(verdicts)
-        past_end = now >= _parse_time(doc.end_time) > 0
+        end = _parse_time(doc.end_time)
+        # a missing/unparseable endTime must not make the job immortal:
+        # finalize on the first judgment instead of re-checking forever
+        past_end = end <= 0 or now >= end
         if job_verdict == UNHEALTHY:
             # fail fast (design.md:43)
             doc.status = STATUS_COMPLETED_UNHEALTH

@@ -14,9 +14,11 @@ the same store.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
 
+import aiohttp
 from aiohttp import web
 
 from foremast_tpu.jobs.convert import InvalidRequest, request_to_document
@@ -26,6 +28,7 @@ from foremast_tpu.jobs.store import InMemoryStore, JobStore
 log = logging.getLogger("foremast_tpu.service")
 
 STORE_KEY = web.AppKey("store", JobStore)
+SESSION_KEY = web.AppKey("session", aiohttp.ClientSession)
 
 CORS_HEADERS = {
     "Access-Control-Allow-Origin": "*",
@@ -53,11 +56,15 @@ def make_app(
         try:
             req = AnalyzeRequest.from_json(body)
             doc = request_to_document(req)
-        except InvalidRequest as e:
+        except (InvalidRequest, ValueError) as e:
+            # ValueError covers e.g. an unsupported dataSourceType from
+            # build_url — client input, not a server fault
             return web.json_response(
                 {"status": "error", "reason": str(e)}, status=400
             )
-        stored, created = store.create(doc)
+        # the store may be backed by blocking HTTP (Elasticsearch); keep
+        # it off the event loop
+        stored, created = await asyncio.to_thread(store.create, doc)
         # ApplicationHealthAnalyzeResponse shape (models.go:63-80)
         return web.json_response(
             {
@@ -70,7 +77,7 @@ def make_app(
         )
 
     async def by_id(request: web.Request) -> web.Response:
-        doc = store.get(request.match_info["id"])
+        doc = await asyncio.to_thread(store.get, request.match_info["id"])
         if doc is None:
             return web.json_response(
                 {"status": "error", "reason": "not found"}, status=404
@@ -86,27 +93,31 @@ def make_app(
                 status=502,
                 headers=CORS_HEADERS,
             )
-        import aiohttp
-
         target = (
             query_endpoint.rstrip("/")
             + "/api/v1/"
             + request.match_info["queryproxy"]
         )
-        async with aiohttp.ClientSession() as session:
-            async with session.get(target, params=request.rel_url.query) as r:
-                body = await r.read()
-                return web.Response(
-                    body=body,
-                    status=r.status,
-                    content_type=r.content_type,
-                    headers=CORS_HEADERS,
-                )
+        session = request.app[SESSION_KEY]
+        async with session.get(target, params=request.rel_url.query) as r:
+            body = await r.read()
+            return web.Response(
+                body=body,
+                status=r.status,
+                content_type=r.content_type,
+                headers=CORS_HEADERS,
+            )
 
     async def healthz(request: web.Request) -> web.Response:
         return web.json_response({"ok": True})
 
+    async def _client_session(app: web.Application):
+        app[SESSION_KEY] = aiohttp.ClientSession()
+        yield
+        await app[SESSION_KEY].close()
+
     app = web.Application()
+    app.cleanup_ctx.append(_client_session)
     app.router.add_post("/v1/healthcheck/create", create)
     app.router.add_get("/v1/healthcheck/id/{id}", by_id)
     app.router.add_get("/api/v1/{queryproxy}", query_proxy)

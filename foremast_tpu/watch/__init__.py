@@ -1,0 +1,34 @@
+"""Watcher / remediation control plane — the foremast-barrelman equivalent.
+
+The reference implements this layer as a Go Kubernetes controller
+(`foremast-barrelman/`, SURVEY.md section 2.1). Here it is an asyncio
+control plane over a pluggable kube API so the same logic runs against a
+real cluster (HttpKube) or an in-memory fake (InMemoryKube) in tests. The
+TPU scoring engine is reached only through the analyst REST contract, so
+this plane stays a thin pure-control layer.
+"""
+
+from foremast_tpu.watch.crds import (
+    DeploymentMetadata,
+    DeploymentMonitor,
+    MonitorPhase,
+    MonitorStatus,
+    RemediationOption,
+)
+from foremast_tpu.watch.kubeapi import InMemoryKube, KubeClient
+from foremast_tpu.watch.analyst import AnalystClient
+from foremast_tpu.watch.barrelman import Barrelman
+from foremast_tpu.watch.controller import MonitorController
+
+__all__ = [
+    "AnalystClient",
+    "Barrelman",
+    "DeploymentMetadata",
+    "DeploymentMonitor",
+    "InMemoryKube",
+    "KubeClient",
+    "MonitorController",
+    "MonitorPhase",
+    "MonitorStatus",
+    "RemediationOption",
+]

@@ -1,0 +1,238 @@
+"""Status poller + remediation — the MonitorController equivalent.
+
+Parity with `foremast-barrelman/pkg/controller/`:
+
+* 10-second poll tick (Barrelman.go:467-472): for every Running monitor,
+  GET job status from the analyst, map to a phase, decode the anomaly
+  payload, expire jobs past waitUntil as Healthy+expired
+  (checkRunningStatus, Barrelman.go:496-591).
+* anomaly decoding: flat [t1,v1,t2,v2,...] pairs -> typed
+  [{"time": t, "value": v}] lists (convertToAnomaly, Barrelman.go:593-620).
+* remediation on transition to Unhealthy with remediationTaken==false,
+  dispatched by spec.remediation.option (MonitorController.go:85-148):
+  AutoRollback -> roll the Deployment's pod template back to the
+  rollbackRevision ReplicaSet (the reference used the long-removed
+  extensions/v1beta1 DeploymentRollback, MonitorController.go:214-229;
+  the template-patch below is the apps/v1 equivalent); AutoPause -> set
+  spec.paused (MonitorController.go:254-281); Auto -> no-op
+  (MonitorController.go:283-286).
+* continuous re-arm each tick while phase != Running, with a 60 s backoff
+  after Unhealthy (Barrelman.go:576-586, MonitorController.go:138-147).
+"""
+
+from __future__ import annotations
+
+import logging
+import time as _time
+from datetime import datetime, timezone
+from typing import Callable
+
+from foremast_tpu.jobs.store import now_rfc3339
+from foremast_tpu.watch.analyst import AnalystClient, HttpAnalyst
+from foremast_tpu.watch.barrelman import Barrelman
+from foremast_tpu.watch.crds import (
+    ROLLBACK_ANNOTATION,
+    DeploymentMonitor,
+    MonitorPhase,
+    RemediationOption,
+)
+from foremast_tpu.watch.kubeapi import (
+    KubeClient,
+    NotFound,
+    deployment_revision,
+    owner_uids,
+)
+
+log = logging.getLogger("foremast_tpu.watch")
+
+POLL_PERIOD_SECONDS = 10.0  # Barrelman.go:467
+UNHEALTHY_REARM_BACKOFF = 60.0  # MonitorController.go:138-147
+
+
+def convert_to_anomaly(payload: dict) -> dict:
+    """Flat-pair wire form -> typed form (Barrelman.go:593-620).
+
+    In:  {"tags": t, "values": {alias: [t1, v1, t2, v2, ...]}}
+         (AnomalyInfo, models.go:60-80)
+    Out: {alias: {"tags": t, "values": [{"time": t1, "value": v1}, ...]}}
+    """
+    out: dict = {}
+    tags = (payload or {}).get("tags", "")
+    for alias, flat in ((payload or {}).get("values") or {}).items():
+        flat = flat or []
+        pairs = [
+            {"time": flat[i], "value": flat[i + 1]}
+            for i in range(0, len(flat) - 1, 2)
+        ]
+        out[alias] = {"tags": tags, "values": pairs}
+    return out
+
+
+def _parse_rfc3339(s: str) -> float:
+    try:
+        return (
+            datetime.strptime(s, "%Y-%m-%dT%H:%M:%SZ")
+            .replace(tzinfo=timezone.utc)
+            .timestamp()
+        )
+    except (ValueError, TypeError):
+        return 0.0
+
+
+class MonitorController:
+    def __init__(
+        self,
+        kube: KubeClient,
+        barrelman: Barrelman | None = None,
+        analyst_factory: Callable[[str], AnalystClient] | None = None,
+        clock: Callable[[], float] = _time.time,
+    ) -> None:
+        self.kube = kube
+        self.barrelman = barrelman
+        self.analyst_factory = analyst_factory or (
+            barrelman.analyst_factory if barrelman else HttpAnalyst
+        )
+        self.clock = clock
+        self._unhealthy_since: dict[tuple[str, str], float] = {}
+
+    # ------------------------------------------------------------------
+    # poll tick (checkRunningStatus)
+    # ------------------------------------------------------------------
+
+    def tick(self) -> None:
+        for monitor in self.kube.list_monitors():
+            try:
+                self._check_monitor(monitor)
+            except Exception:  # noqa: BLE001 - one bad monitor must not stop the tick
+                log.exception(
+                    "poll failed for %s/%s", monitor.namespace, monitor.name
+                )
+
+    def _check_monitor(self, monitor: DeploymentMonitor) -> None:
+        phase = monitor.status.phase
+        if phase == MonitorPhase.RUNNING and monitor.status.job_id:
+            self._poll_running(monitor)
+        elif monitor.continuous:
+            self._rearm_continuous(monitor)
+
+    def _poll_running(self, monitor: DeploymentMonitor) -> None:
+        now = self.clock()
+        wait_until = _parse_rfc3339(monitor.wait_until)
+        status = self.analyst_factory(monitor.analyst_endpoint).get_status(
+            monitor.status.job_id
+        )
+        new_phase = status.phase
+        if new_phase == MonitorPhase.RUNNING:
+            if wait_until and now > wait_until:
+                # expiry defaults the job to Healthy (Barrelman.go:556-565)
+                monitor.status.phase = MonitorPhase.HEALTHY
+                monitor.status.expired = True
+                monitor.status.timestamp = now_rfc3339()
+                self.kube.upsert_monitor(monitor)
+            return
+        monitor.status.phase = new_phase
+        monitor.status.timestamp = now_rfc3339()
+        if status.anomaly:
+            monitor.status.anomaly = convert_to_anomaly(status.anomaly)
+        self.kube.upsert_monitor(monitor)
+        self.handle_transition(monitor)
+
+    # ------------------------------------------------------------------
+    # remediation (MonitorController informer UpdateFunc)
+    # ------------------------------------------------------------------
+
+    def handle_transition(self, monitor: DeploymentMonitor) -> None:
+        if monitor.status.phase != MonitorPhase.UNHEALTHY:
+            return
+        self._unhealthy_since[(monitor.namespace, monitor.name)] = self.clock()
+        if monitor.status.remediation_taken:
+            return
+        option = monitor.remediation.option
+        if option == RemediationOption.AUTO_ROLLBACK:
+            self.rollback(monitor)
+        elif option == RemediationOption.AUTO_PAUSE:
+            self.pause(monitor)
+        elif option == RemediationOption.AUTO:
+            pass  # reference leaves Auto unimplemented (MonitorController.go:283-286)
+        else:
+            return
+        if option in (RemediationOption.AUTO_ROLLBACK, RemediationOption.AUTO_PAUSE):
+            monitor.status.remediation_taken = True
+            self.kube.upsert_monitor(monitor)
+
+    def rollback(self, monitor: DeploymentMonitor) -> None:
+        """Roll the Deployment back to spec.rollbackRevision by patching
+        its pod template from that revision's ReplicaSet
+        (MonitorController.go:172-238, apps/v1 form)."""
+        try:
+            dep = self.kube.get_deployment(monitor.namespace, monitor.name)
+        except NotFound:
+            log.warning("rollback target %s/%s gone", monitor.namespace, monitor.name)
+            return
+        target = monitor.rollback_revision
+        dep_uid = dep.get("metadata", {}).get("uid", "")
+        candidates = [
+            rs
+            for rs in self.kube.list_replicasets(monitor.namespace)
+            if dep_uid in owner_uids(rs)
+            and (target == 0 or deployment_revision(rs) == target)
+            and deployment_revision(rs) != deployment_revision(dep)
+        ]
+        if not candidates:
+            log.warning(
+                "no ReplicaSet at revision %s for %s/%s; rollback skipped",
+                target, monitor.namespace, monitor.name,
+            )
+            return
+        candidates.sort(key=deployment_revision)
+        rs = candidates[-1]
+        template = rs.get("spec", {}).get("template", {})
+        # drop the RS-only pod-template-hash label before reuse
+        labels = dict(template.get("metadata", {}).get("labels", {}) or {})
+        labels.pop("pod-template-hash", None)
+        patch = {
+            "metadata": {"annotations": {ROLLBACK_ANNOTATION: str(target or deployment_revision(rs))}},
+            "spec": {
+                "template": {
+                    "metadata": {**template.get("metadata", {}), "labels": labels},
+                    "spec": template.get("spec", {}),
+                }
+            },
+        }
+        self.kube.patch_deployment(monitor.namespace, monitor.name, patch)
+        log.info(
+            "rolled back %s/%s to revision %s",
+            monitor.namespace, monitor.name, deployment_revision(rs),
+        )
+
+    def pause(self, monitor: DeploymentMonitor) -> None:
+        """Set spec.paused=true (MonitorController.go:254-281)."""
+        try:
+            self.kube.patch_deployment(
+                monitor.namespace, monitor.name, {"spec": {"paused": True}}
+            )
+        except NotFound:
+            log.warning("pause target %s/%s gone", monitor.namespace, monitor.name)
+
+    # ------------------------------------------------------------------
+    # continuous re-arm
+    # ------------------------------------------------------------------
+
+    def _rearm_continuous(self, monitor: DeploymentMonitor) -> None:
+        if self.barrelman is None:
+            return
+        key = (monitor.namespace, monitor.name)
+        since = self._unhealthy_since.get(key)
+        if since is not None and self.clock() - since < UNHEALTHY_REARM_BACKOFF:
+            return
+        self._unhealthy_since.pop(key, None)
+        self.barrelman.monitor_continuously(monitor)
+
+    # ------------------------------------------------------------------
+    # run loop
+    # ------------------------------------------------------------------
+
+    def run_forever(self, stop: Callable[[], bool] = lambda: False) -> None:
+        while not stop():
+            self.tick()
+            _time.sleep(POLL_PERIOD_SECONDS)

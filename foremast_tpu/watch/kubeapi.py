@@ -1,0 +1,378 @@
+"""Pluggable Kubernetes API for the watch plane.
+
+The reference talks to K8s through client-go clientsets + generated CRD
+clients (`foremast-barrelman/pkg/client/`, ~2,200 LoC of codegen). Here the
+same surface is a small protocol with two implementations:
+
+* ``InMemoryKube`` — the test substrate, replacing the reference's
+  generated fake clientsets
+  (`pkg/client/clientset/versioned/fake/clientset_generated.go`).
+* ``HttpKube`` — a direct REST client against the API server using the
+  in-cluster service-account credentials (no kubernetes python package in
+  the image; the API surface needed is tiny).
+
+Builtin objects (Deployment / ReplicaSet / Pod / Namespace) are handled in
+their K8s wire form (plain dicts); the two foremast CRDs are typed
+(`crds.py`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Iterable, Protocol
+
+from foremast_tpu.watch.crds import (
+    API_VERSION,
+    GROUP,
+    VERSION,
+    DeploymentMetadata,
+    DeploymentMonitor,
+)
+
+
+class NotFound(KeyError):
+    """Object absent — the analogue of a k8s 404 / IsNotFound."""
+
+
+class KubeClient(Protocol):
+    # builtin workloads ---------------------------------------------------
+    def list_namespaces(self) -> list[dict]: ...
+    def get_namespace(self, name: str) -> dict: ...
+    def list_deployments(self, namespace: str | None = None) -> list[dict]: ...
+    def get_deployment(self, namespace: str, name: str) -> dict: ...
+    def patch_deployment(self, namespace: str, name: str, patch: dict) -> dict: ...
+    def list_replicasets(self, namespace: str) -> list[dict]: ...
+    def list_pods(self, namespace: str) -> list[dict]: ...
+
+    # foremast CRDs -------------------------------------------------------
+    def get_metadata(self, namespace: str, name: str) -> DeploymentMetadata: ...
+    def list_monitors(self, namespace: str | None = None) -> list[DeploymentMonitor]: ...
+    def get_monitor(self, namespace: str, name: str) -> DeploymentMonitor: ...
+    def upsert_monitor(self, monitor: DeploymentMonitor) -> DeploymentMonitor: ...
+    def delete_monitor(self, namespace: str, name: str) -> None: ...
+
+
+# ---------------------------------------------------------------------------
+# helpers shared by both implementations and by Barrelman
+# ---------------------------------------------------------------------------
+
+
+def owner_uids(obj: dict) -> set[str]:
+    return {
+        ref.get("uid", "")
+        for ref in obj.get("metadata", {}).get("ownerReferences", []) or []
+    }
+
+
+def deployment_containers(dep: dict) -> list[dict]:
+    return (
+        dep.get("spec", {})
+        .get("template", {})
+        .get("spec", {})
+        .get("containers", [])
+        or []
+    )
+
+
+def deployment_revision(dep: dict) -> int:
+    """`deployment.kubernetes.io/revision` annotation as int (0 if unset)."""
+    ann = dep.get("metadata", {}).get("annotations", {}) or {}
+    try:
+        return int(ann.get("deployment.kubernetes.io/revision", 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# In-memory fake
+# ---------------------------------------------------------------------------
+
+
+class InMemoryKube:
+    """Dict-backed kube API with synchronous event delivery.
+
+    Tests seed namespaces/deployments/replicasets/pods, register handlers
+    (the informer-equivalent), and mutate objects through
+    ``apply_deployment`` to fire add/update events — covering what the
+    reference exercised through client-go informers + fake clientsets.
+    """
+
+    def __init__(self) -> None:
+        self.namespaces: dict[str, dict] = {}
+        self.deployments: dict[tuple[str, str], dict] = {}
+        self.replicasets: dict[tuple[str, str], dict] = {}
+        self.pods: dict[tuple[str, str], dict] = {}
+        self.metadatas: dict[tuple[str, str], DeploymentMetadata] = {}
+        self.monitors: dict[tuple[str, str], DeploymentMonitor] = {}
+        self.deployment_handlers: list[Callable[[str, dict, dict | None], None]] = []
+        self.monitor_handlers: list[
+            Callable[[str, DeploymentMonitor, DeploymentMonitor | None], None]
+        ] = []
+        # audit trail of (verb, kind, namespace, name, detail) for asserts
+        self.actions: list[tuple[str, str, str, str, Any]] = []
+
+    # --- seeding / events ------------------------------------------------
+
+    def add_namespace(self, name: str, annotations: dict | None = None) -> None:
+        self.namespaces[name] = {
+            "metadata": {"name": name, "annotations": annotations or {}}
+        }
+
+    def on_deployment(self, fn: Callable[[str, dict, dict | None], None]) -> None:
+        self.deployment_handlers.append(fn)
+
+    def on_monitor(
+        self, fn: Callable[[str, DeploymentMonitor, DeploymentMonitor | None], None]
+    ) -> None:
+        self.monitor_handlers.append(fn)
+
+    def apply_deployment(self, dep: dict) -> None:
+        """Create or update a Deployment and fire the informer event."""
+        meta = dep["metadata"]
+        key = (meta["namespace"], meta["name"])
+        old = self.deployments.get(key)
+        self.deployments[key] = dep
+        event = "update" if old is not None else "add"
+        for fn in list(self.deployment_handlers):
+            fn(event, dep, old)
+
+    def remove_deployment(self, namespace: str, name: str) -> None:
+        dep = self.deployments.pop((namespace, name), None)
+        if dep is not None:
+            for fn in list(self.deployment_handlers):
+                fn("delete", dep, None)
+
+    def add_replicaset(self, rs: dict) -> None:
+        meta = rs["metadata"]
+        self.replicasets[(meta["namespace"], meta["name"])] = rs
+
+    def add_pod(self, pod: dict) -> None:
+        meta = pod["metadata"]
+        self.pods[(meta["namespace"], meta["name"])] = pod
+
+    def add_metadata(self, md: DeploymentMetadata) -> None:
+        self.metadatas[(md.namespace, md.name)] = md
+
+    # --- KubeClient ------------------------------------------------------
+
+    def list_namespaces(self) -> list[dict]:
+        return list(self.namespaces.values())
+
+    def get_namespace(self, name: str) -> dict:
+        try:
+            return self.namespaces[name]
+        except KeyError:
+            raise NotFound(name)
+
+    def list_deployments(self, namespace: str | None = None) -> list[dict]:
+        return [
+            d
+            for (ns, _), d in self.deployments.items()
+            if namespace is None or ns == namespace
+        ]
+
+    def get_deployment(self, namespace: str, name: str) -> dict:
+        try:
+            return self.deployments[(namespace, name)]
+        except KeyError:
+            raise NotFound(f"{namespace}/{name}")
+
+    def patch_deployment(self, namespace: str, name: str, patch: dict) -> dict:
+        dep = self.get_deployment(namespace, name)
+        _deep_merge(dep, patch)
+        self.actions.append(("patch", "Deployment", namespace, name, patch))
+        for fn in list(self.deployment_handlers):
+            fn("update", dep, dep)
+        return dep
+
+    def list_replicasets(self, namespace: str) -> list[dict]:
+        return [r for (ns, _), r in self.replicasets.items() if ns == namespace]
+
+    def list_pods(self, namespace: str) -> list[dict]:
+        return [p for (ns, _), p in self.pods.items() if ns == namespace]
+
+    def get_metadata(self, namespace: str, name: str) -> DeploymentMetadata:
+        try:
+            return self.metadatas[(namespace, name)]
+        except KeyError:
+            raise NotFound(f"{namespace}/{name}")
+
+    def list_monitors(self, namespace: str | None = None) -> list[DeploymentMonitor]:
+        return [
+            m
+            for (ns, _), m in self.monitors.items()
+            if namespace is None or ns == namespace
+        ]
+
+    def get_monitor(self, namespace: str, name: str) -> DeploymentMonitor:
+        try:
+            return self.monitors[(namespace, name)]
+        except KeyError:
+            raise NotFound(f"{namespace}/{name}")
+
+    def upsert_monitor(self, monitor: DeploymentMonitor) -> DeploymentMonitor:
+        key = (monitor.namespace, monitor.name)
+        old = self.monitors.get(key)
+        self.monitors[key] = monitor
+        self.actions.append(
+            ("update" if old else "create", "DeploymentMonitor", *key, None)
+        )
+        for fn in list(self.monitor_handlers):
+            fn("update" if old else "add", monitor, old)
+        return monitor
+
+    def delete_monitor(self, namespace: str, name: str) -> None:
+        m = self.monitors.pop((namespace, name), None)
+        if m is not None:
+            self.actions.append(("delete", "DeploymentMonitor", namespace, name, None))
+            for fn in list(self.monitor_handlers):
+                fn("delete", m, None)
+
+
+def _deep_merge(dst: dict, patch: dict) -> None:
+    for k, v in patch.items():
+        if v is None:
+            dst.pop(k, None)  # strategic-merge null deletes the key
+        elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = v
+
+
+# ---------------------------------------------------------------------------
+# In-cluster REST client
+# ---------------------------------------------------------------------------
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class HttpKube:
+    """Direct REST client for the API server (in-cluster credentials).
+
+    Covers the verbs the control plane needs: list/get/patch on apps/v1
+    Deployments, list on ReplicaSets/Pods/Namespaces, CRUD on the two
+    foremast CRDs. Uses blocking urllib (call sites run it via
+    ``asyncio.to_thread`` when inside the event loop).
+    """
+
+    def __init__(
+        self,
+        base_url: str | None = None,
+        token: str | None = None,
+        ca_file: str | None = None,
+    ) -> None:
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        self.base_url = (base_url or f"https://{host}:{port}").rstrip("/")
+        if token is None and os.path.exists(f"{_SA_DIR}/token"):
+            with open(f"{_SA_DIR}/token") as f:
+                token = f.read().strip()
+        self.token = token
+        ca = ca_file or (f"{_SA_DIR}/ca.crt" if os.path.exists(f"{_SA_DIR}/ca.crt") else None)
+        self._ctx = ssl.create_default_context(cafile=ca) if ca else None
+
+    def _req(self, method: str, path: str, body: dict | None = None,
+             content_type: str = "application/json") -> dict:
+        url = f"{self.base_url}{path}"
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, context=self._ctx, timeout=30) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:  # pragma: no cover - live cluster only
+            if e.code == 404:
+                raise NotFound(path)
+            raise
+
+    # --- builtin workloads ----------------------------------------------
+
+    def list_namespaces(self) -> list[dict]:
+        return self._req("GET", "/api/v1/namespaces").get("items", [])
+
+    def get_namespace(self, name: str) -> dict:
+        return self._req("GET", f"/api/v1/namespaces/{name}")
+
+    def list_deployments(self, namespace: str | None = None) -> list[dict]:
+        path = (
+            f"/apis/apps/v1/namespaces/{namespace}/deployments"
+            if namespace
+            else "/apis/apps/v1/deployments"
+        )
+        return self._req("GET", path).get("items", [])
+
+    def get_deployment(self, namespace: str, name: str) -> dict:
+        return self._req("GET", f"/apis/apps/v1/namespaces/{namespace}/deployments/{name}")
+
+    def patch_deployment(self, namespace: str, name: str, patch: dict) -> dict:
+        return self._req(
+            "PATCH",
+            f"/apis/apps/v1/namespaces/{namespace}/deployments/{name}",
+            patch,
+            content_type="application/strategic-merge-patch+json",
+        )
+
+    def list_replicasets(self, namespace: str) -> list[dict]:
+        return self._req(
+            "GET", f"/apis/apps/v1/namespaces/{namespace}/replicasets"
+        ).get("items", [])
+
+    def list_pods(self, namespace: str) -> list[dict]:
+        return self._req("GET", f"/api/v1/namespaces/{namespace}/pods").get("items", [])
+
+    # --- foremast CRDs ---------------------------------------------------
+
+    def _crd_path(self, plural: str, namespace: str | None, name: str | None = None) -> str:
+        p = f"/apis/{GROUP}/{VERSION}"
+        if namespace:
+            p += f"/namespaces/{namespace}"
+        p += f"/{plural}"
+        if name:
+            p += f"/{urllib.parse.quote(name)}"
+        return p
+
+    def get_metadata(self, namespace: str, name: str) -> DeploymentMetadata:
+        obj = self._req("GET", self._crd_path("deploymentmetadatas", namespace, name))
+        return DeploymentMetadata.from_json(obj)
+
+    def list_monitors(self, namespace: str | None = None) -> list[DeploymentMonitor]:
+        items = self._req("GET", self._crd_path("deploymentmonitors", namespace)).get(
+            "items", []
+        )
+        return [DeploymentMonitor.from_json(o) for o in items]
+
+    def get_monitor(self, namespace: str, name: str) -> DeploymentMonitor:
+        obj = self._req("GET", self._crd_path("deploymentmonitors", namespace, name))
+        return DeploymentMonitor.from_json(obj)
+
+    def upsert_monitor(self, monitor: DeploymentMonitor) -> DeploymentMonitor:
+        path = self._crd_path("deploymentmonitors", monitor.namespace, monitor.name)
+        try:
+            existing = self._req("GET", path)
+            body = monitor.to_json()
+            body["metadata"]["resourceVersion"] = existing["metadata"].get(
+                "resourceVersion", ""
+            )
+            return DeploymentMonitor.from_json(self._req("PUT", path, body))
+        except NotFound:
+            return DeploymentMonitor.from_json(
+                self._req(
+                    "POST",
+                    self._crd_path("deploymentmonitors", monitor.namespace),
+                    monitor.to_json(),
+                )
+            )
+
+    def delete_monitor(self, namespace: str, name: str) -> None:
+        try:
+            self._req("DELETE", self._crd_path("deploymentmonitors", namespace, name))
+        except NotFound:
+            pass

