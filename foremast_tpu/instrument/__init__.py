@@ -1,0 +1,24 @@
+"""App instrumentation starter — the metrics-production leaf layer.
+
+Python equivalent of `foremast-spring-boot-k8s-metrics-starter/` (SURVEY.md
+section 2.4): standardizes how workloads emit HTTP metrics so foremast's
+recording rules and scoring work out of the box. WSGI and aiohttp
+middlewares, common tags, zero-initialized status counters, `/metrics`
+aliasing, caller tagging, and runtime metric hiding.
+"""
+
+from foremast_tpu.instrument.starter import (
+    HttpMetrics,
+    K8sMetricsConfig,
+    MetricsFilter,
+    instrument_aiohttp,
+    wsgi_middleware,
+)
+
+__all__ = [
+    "HttpMetrics",
+    "K8sMetricsConfig",
+    "MetricsFilter",
+    "instrument_aiohttp",
+    "wsgi_middleware",
+]

@@ -40,15 +40,26 @@ def now_rfc3339() -> str:
     return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 
 
-def _parse_ts(s: str) -> float:
+def parse_time(s: str) -> float:
+    """Unix-seconds or ISO-8601/RFC3339 (any offset/fraction form) ->
+    epoch seconds; 0.0 when empty or unparseable. The one shared time
+    parser for document timestamps across the job and watch planes."""
     if not s:
         return 0.0
     try:
-        return datetime.strptime(s, "%Y-%m-%dT%H:%M:%SZ").replace(
-            tzinfo=timezone.utc
-        ).timestamp()
+        return float(s)
+    except ValueError:
+        pass
+    try:
+        dt = datetime.fromisoformat(s.replace("Z", "+00:00"))
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=timezone.utc)
+        return dt.timestamp()
     except ValueError:
         return 0.0
+
+
+_parse_ts = parse_time
 
 
 class JobStore:

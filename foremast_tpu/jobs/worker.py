@@ -18,7 +18,6 @@ from __future__ import annotations
 import logging
 import time
 import uuid
-from datetime import datetime, timezone
 from typing import Callable
 
 from foremast_tpu.config import BrainConfig
@@ -37,33 +36,17 @@ from foremast_tpu.jobs.models import (
     STATUS_COMPLETED_UNKNOWN,
     STATUS_PREPROCESS_COMPLETED,
     STATUS_PREPROCESS_FAILED,
-    STATUS_PREPROCESS_INPROGRESS,
     AnomalyInfo,
     Document,
 )
-from foremast_tpu.jobs.store import JobStore
+from foremast_tpu.jobs.store import JobStore, parse_time
 from foremast_tpu.metrics.promql import decode_config
 from foremast_tpu.metrics.source import MetricSource
 
 log = logging.getLogger("foremast_tpu.worker")
 
 
-def _parse_time(s: str) -> float:
-    """RFC3339 (any ISO-8601 offset form) or unix-seconds string -> epoch
-    seconds (0 if unparseable)."""
-    if not s:
-        return 0.0
-    try:
-        return float(s)
-    except ValueError:
-        pass
-    try:
-        dt = datetime.fromisoformat(s.replace("Z", "+00:00"))
-        if dt.tzinfo is None:
-            dt = dt.replace(tzinfo=timezone.utc)
-        return dt.timestamp()
-    except ValueError:
-        return 0.0
+_parse_time = parse_time
 
 
 def infer_metric_type(alias: str, config: BrainConfig) -> str | None:
@@ -188,8 +171,7 @@ class BrainWorker:
         failed: list[Document] = []
         ok_docs: list[Document] = []
         for doc in docs:
-            doc.status = STATUS_PREPROCESS_INPROGRESS
-            self.store.update(doc)
+            # claim() already flipped + persisted preprocess_inprogress
             tasks = self._fetch_tasks(doc)
             if tasks is None:
                 doc.status = STATUS_PREPROCESS_FAILED

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import urllib.error
 import urllib.request
 from typing import Any, Protocol
 
@@ -78,10 +79,15 @@ class HttpAnalyst:
         return job_id
 
     def get_status(self, job_id: str) -> JobStatus:
-        with urllib.request.urlopen(
-            self.endpoint + "id/" + job_id, timeout=self.timeout
-        ) as resp:
-            payload = json.loads(resp.read())
+        try:
+            with urllib.request.urlopen(
+                self.endpoint + "id/" + job_id, timeout=self.timeout
+            ) as resp:
+                payload = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            if e.code == 404:  # contract parity with LocalAnalyst
+                return JobStatus(phase=MonitorPhase.FAILED, reason="job not found")
+            raise
         return JobStatus(
             phase=status_to_phase(payload.get("status", "")),
             reason=payload.get("reason", ""),

@@ -272,6 +272,19 @@ class Barrelman:
             if not current_pods:
                 log.warning("no pods found for %s/%s; aborting monitor", namespace, name)
                 return
+            if strategy == STRATEGY_CANARY and not baseline_pods:
+                # a canary Deployment owns only its own ReplicaSet; the
+                # baseline population is the PRIMARY Deployment's pods
+                # (reference walks the old Deployment's ReplicaSets,
+                # Barrelman.go:632-780)
+                try:
+                    primary = self.kube.get_deployment(
+                        namespace, name.removesuffix(CANARY_SUFFIX)
+                    )
+                    primary_cur, primary_old = self.get_pod_names(primary)
+                    baseline_pods = primary_cur + primary_old
+                except NotFound:
+                    pass
 
         now = self.clock()
         start = now

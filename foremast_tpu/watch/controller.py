@@ -24,10 +24,9 @@ from __future__ import annotations
 
 import logging
 import time as _time
-from datetime import datetime, timezone
 from typing import Callable
 
-from foremast_tpu.jobs.store import now_rfc3339
+from foremast_tpu.jobs.store import now_rfc3339, parse_time
 from foremast_tpu.watch.analyst import AnalystClient, HttpAnalyst
 from foremast_tpu.watch.barrelman import Barrelman
 from foremast_tpu.watch.crds import (
@@ -68,15 +67,6 @@ def convert_to_anomaly(payload: dict) -> dict:
     return out
 
 
-def _parse_rfc3339(s: str) -> float:
-    try:
-        return (
-            datetime.strptime(s, "%Y-%m-%dT%H:%M:%SZ")
-            .replace(tzinfo=timezone.utc)
-            .timestamp()
-        )
-    except (ValueError, TypeError):
-        return 0.0
 
 
 class MonitorController:
@@ -117,11 +107,17 @@ class MonitorController:
 
     def _poll_running(self, monitor: DeploymentMonitor) -> None:
         now = self.clock()
-        wait_until = _parse_rfc3339(monitor.wait_until)
-        status = self.analyst_factory(monitor.analyst_endpoint).get_status(
-            monitor.status.job_id
-        )
-        new_phase = status.phase
+        wait_until = parse_time(monitor.wait_until)
+        try:
+            status = self.analyst_factory(monitor.analyst_endpoint).get_status(
+                monitor.status.job_id
+            )
+            new_phase = status.phase
+        except Exception as e:  # noqa: BLE001 - analyst down must not stall expiry
+            log.warning(
+                "get_status failed for %s/%s: %s", monitor.namespace, monitor.name, e
+            )
+            status, new_phase = None, MonitorPhase.RUNNING
         if new_phase == MonitorPhase.RUNNING:
             if wait_until and now > wait_until:
                 # expiry defaults the job to Healthy (Barrelman.go:556-565)

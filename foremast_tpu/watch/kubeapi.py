@@ -18,6 +18,7 @@ their K8s wire form (plain dicts); the two foremast CRDs are typed
 
 from __future__ import annotations
 
+import copy
 import json
 import os
 import ssl
@@ -183,10 +184,11 @@ class InMemoryKube:
 
     def patch_deployment(self, namespace: str, name: str, patch: dict) -> dict:
         dep = self.get_deployment(namespace, name)
+        old = copy.deepcopy(dep)  # handlers must see the pre-patch object
         _deep_merge(dep, patch)
         self.actions.append(("patch", "Deployment", namespace, name, patch))
         for fn in list(self.deployment_handlers):
-            fn("update", dep, dep)
+            fn("update", dep, old)
         return dep
 
     def list_replicasets(self, namespace: str) -> list[dict]:

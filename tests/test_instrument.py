@@ -1,0 +1,143 @@
+"""Instrumentation starter + demo workload tests (SURVEY.md section 2.4/2.6
+feature list)."""
+
+import pytest
+
+from foremast_tpu.demo import DemoClient, ErrorGenerator, FileErrorGenerator, make_demo_app
+from foremast_tpu.instrument import HttpMetrics, K8sMetricsConfig, MetricsFilter
+from foremast_tpu.instrument.starter import _parse_pairs
+
+
+@pytest.fixture
+def demo():
+    app, metrics = make_demo_app()
+    return DemoClient(app), metrics
+
+
+def scrape(client) -> str:
+    status, body = client.get("/metrics")
+    assert status == 200
+    return body.decode()
+
+
+def test_routes_and_status_codes(demo):
+    client, _ = demo
+    assert client.get("/")[0] == 200
+    assert client.get("/error4xx")[0] == 404
+    assert client.get("/error5xx")[0] == 500
+
+
+def test_metrics_alias_paths(demo):
+    client, _ = demo
+    s1, b1 = client.get("/metrics")
+    s2, b2 = client.get("/actuator/prometheus")
+    assert s1 == s2 == 200
+    assert b"http_server_requests_seconds" in b1
+    assert b"http_server_requests_seconds" in b2
+
+
+def test_common_tags_present(demo):
+    client, _ = demo
+    client.get("/")
+    text = scrape(client)
+    assert 'app="spring-boot-demo"' in text
+
+
+def test_zero_initialized_statuses(demo):
+    client, _ = demo
+    # before any error traffic the 404/500 counters exist at 0
+    text = scrape(client)
+    assert 'status="500"' in text
+    assert 'status="404"' in text
+
+
+def test_request_timing_recorded(demo):
+    client, _ = demo
+    client.get("/error5xx")
+    client.get("/error5xx")
+    text = scrape(client)
+    line = next(
+        l for l in text.splitlines()
+        if l.startswith("http_server_requests_seconds_count")
+        and 'uri="/error5xx"' in l and 'status="500"' in l
+    )
+    assert float(line.rsplit(" ", 1)[1]) == 2.0
+
+
+def test_caller_tag_from_header():
+    metrics = HttpMetrics(
+        K8sMetricsConfig(common_tags={"app": "x"}, caller_header="X-Caller")
+    )
+    app, _ = make_demo_app(metrics)
+    client = DemoClient(app)
+    client.get("/", headers={"X-Caller": "checkout-svc"})
+    assert 'caller="checkout-svc"' in scrape(client)
+
+
+def test_runtime_disable_enable(demo):
+    client, _ = demo
+    client.get("/")
+    assert "http_server_requests_seconds" in scrape(client)
+    status, _ = client.get("/k8s-metrics/disable/http_server_requests_seconds")
+    assert status == 200
+    assert "http_server_requests_seconds" not in scrape(client)
+    client.get("/k8s-metrics/enable/http_server_requests_seconds")
+    assert "http_server_requests_seconds" in scrape(client)
+    assert client.get("/k8s-metrics/bogus/x")[0] == 404
+
+
+def test_filter_whitelist_blacklist_prefix():
+    f = MetricsFilter(K8sMetricsConfig(common_tags={}, blacklist={"secret_metric"}))
+    assert f.visible("anything")
+    assert not f.visible("secret_metric")
+    f.enable("secret_metric")
+    assert f.visible("secret_metric")
+
+    f2 = MetricsFilter(K8sMetricsConfig(common_tags={}, hide_prefix="jvm_"))
+    assert not f2.visible("jvm_threads")
+    assert f2.visible("http_server_requests_seconds")
+
+    f3 = MetricsFilter(K8sMetricsConfig(common_tags={}, whitelist={"only_this"}))
+    assert f3.visible("only_this")
+    assert not f3.visible("other")
+
+
+def test_tag_env_fallback(monkeypatch):
+    monkeypatch.setenv("K8S_METRICS_COMMON_TAGS", "env:prod , team:sre")
+    cfg = K8sMetricsConfig()
+    assert cfg.common_tags == {"env": "prod", "team": "sre"}
+    monkeypatch.delenv("K8S_METRICS_COMMON_TAGS")
+    monkeypatch.setenv("APP_NAME", "demo-app")
+    assert K8sMetricsConfig().common_tags == {"app": "demo-app"}
+    assert _parse_pairs("a:1,bad,b:2") == {"a": "1", "b": "2"}
+
+
+def test_error_generator_burst(demo):
+    client, _ = demo
+    ErrorGenerator(client, error_type="5xx", frequency=6).burst(6)
+    text = scrape(client)
+    line = next(
+        l for l in text.splitlines()
+        if l.startswith("http_server_requests_seconds_count")
+        and 'uri="/error5xx"' in l and 'status="500"' in l
+    )
+    assert float(line.rsplit(" ", 1)[1]) == 6.0
+
+
+def test_file_error_generator_replays_trace(demo, tmp_path):
+    client, _ = demo
+    trace = tmp_path / "trace.csv"
+    trace.write_text(
+        "2014-02-15 03:00:00,0.2\n2014-02-15 03:01:00,40.134\n2014-02-15 03:02:00,1.0\n"
+    )
+    gen = FileErrorGenerator(client, str(trace))
+    assert gen.rates() == [0.2, 40.134, 1.0]
+    total = gen.replay()
+    assert total == 0 + 40 + 1
+    text = scrape(client)
+    line = next(
+        l for l in text.splitlines()
+        if l.startswith("http_server_requests_seconds_count")
+        and 'uri="/error5xx"' in l
+    )
+    assert float(line.rsplit(" ", 1)[1]) == 41.0

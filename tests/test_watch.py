@@ -221,8 +221,9 @@ def test_metadata_fallback_chain(world):
     kube.add_metadata(
         DeploymentMetadata(name="java-service", namespace="foremast")
     )
-    # still negative-cached for 1 min
-    assert bman.get_metadata(dep) is None or True  # cache applies per-key
+    # every candidate key was negative-cached by the first lookup, so the
+    # new CR stays invisible until the 1-min TTL lapses
+    assert bman.get_metadata(dep) is None
     clock.t += 61
     md = bman.get_metadata(dep)
     assert md is not None and md.name == "java-service"
@@ -296,12 +297,19 @@ def test_canary_suffix_maps_to_primary_monitor(world):
     canary_uid = "dep-canary"
     kube.add_replicaset(make_rs("canary-rs", "demo", canary_uid, 1, image="demo:v2"))
     kube.add_pod(make_pod("canary-1", "demo", "rs-canary-rs"))
+    # primary deployment with live pods: the canary's baseline population
+    kube.apply_deployment(make_deployment(image="demo:v1", revision=1))
+    seed_pods(kube, old_rev=1, new_rev=1)
     kube.apply_deployment(
         make_deployment(name="demo-foremast-canary", uid=canary_uid, image="demo:v2")
     )
     # monitor is created under the PRIMARY name
     mon = kube.get_monitor("demo", "demo")
     assert mon.status.phase == MonitorPhase.RUNNING
+    # baseline query pinned to the primary's pods, not canary's own
+    doc = store.get(mon.status.job_id)
+    assert "canary-1" in doc.current_config
+    assert "demo-new-1" in doc.baseline_config or "demo-old-1" in doc.baseline_config
 
 
 # ---------------------------------------------------------------------------
