@@ -1,0 +1,6 @@
+"""`python -m foremast_tpu.demo` — run the instrumented demo workload."""
+
+from foremast_tpu.demo.app import main
+
+if __name__ == "__main__":
+    main()

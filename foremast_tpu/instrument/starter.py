@@ -224,10 +224,7 @@ def wsgi_middleware(app: Callable, metrics: HttpMetrics) -> Callable:
         if metrics.config.caller_header:
             key = "HTTP_" + metrics.config.caller_header.upper().replace("-", "_")
             caller = environ.get(key, "")
-        try:
-            result = app(environ, capturing_start_response)
-            return result
-        finally:
+        def record():
             metrics.observe(
                 method=environ.get("REQUEST_METHOD", "GET"),
                 uri=path,
@@ -236,7 +233,46 @@ def wsgi_middleware(app: Callable, metrics: HttpMetrics) -> Callable:
                 caller=caller,
             )
 
+        try:
+            result = app(environ, capturing_start_response)
+        except BaseException:
+            record()
+            raise
+        # PEP 3333 lets the app defer start_response until its result
+        # iterable is consumed (streaming apps) — record after iteration,
+        # not at call return, so status and duration cover the body
+        return _RecordingIterable(result, record)
+
     return wrapped
+
+
+class _RecordingIterable:
+    """Wraps a WSGI result; fires the record callback exactly once, when
+    the response body is exhausted or closed."""
+
+    def __init__(self, result, record):
+        self._result = result
+        self._record = record
+        self._done = False
+
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            self._record()
+
+    def __iter__(self):
+        try:
+            yield from self._result
+        finally:
+            self._finish()
+
+    def close(self):
+        try:
+            close = getattr(self._result, "close", None)
+            if close is not None:
+                close()
+        finally:
+            self._finish()
 
 
 def instrument_aiohttp(app, metrics: HttpMetrics) -> None:
@@ -257,6 +293,11 @@ def instrument_aiohttp(app, metrics: HttpMetrics) -> None:
             resp = await handler(request)
             status = resp.status
             return resp
+        except web.HTTPException as e:
+            # raising HTTPNotFound etc. is the idiomatic aiohttp response
+            # path, not a server error
+            status = e.status
+            raise
         finally:
             if request.path not in METRICS_PATHS and not request.path.startswith(
                 CONTROL_PREFIX
@@ -267,9 +308,10 @@ def instrument_aiohttp(app, metrics: HttpMetrics) -> None:
                 )
 
     async def expo(request):
+        # full exposition content type (incl. version param), same as WSGI
         return web.Response(
-            body=metrics.exposition(), content_type="text/plain",
-            charset="utf-8",
+            body=metrics.exposition(),
+            headers={"Content-Type": CONTENT_TYPE_LATEST},
         )
 
     async def control(request):

@@ -27,7 +27,16 @@ from foremast_tpu.jobs.store import (
     JobStore,
     now_rfc3339,
 )
-from foremast_tpu.jobs.worker import BrainWorker, infer_metric_type
+
+def __getattr__(name):
+    # worker imports the metrics package, which imports jobs.models via this
+    # package — resolve BrainWorker lazily so either side can load first
+    if name in ("BrainWorker", "infer_metric_type"):
+        from foremast_tpu.jobs import worker
+
+        return getattr(worker, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 __all__ = [
     "CLAIMABLE_STATUSES",

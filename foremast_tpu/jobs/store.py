@@ -59,9 +59,6 @@ def parse_time(s: str) -> float:
         return 0.0
 
 
-_parse_ts = parse_time
-
-
 class JobStore:
     """Interface: idempotent create, lookup, claim, update."""
 
@@ -95,7 +92,7 @@ def _is_claimable(doc: Document, now: float, max_stuck: float) -> bool:
     if doc.status in TERMINAL_STATUSES:
         return False
     if doc.status in CLAIMABLE_STATUSES:  # *_inprogress
-        return now - _parse_ts(doc.modified_at) > max_stuck
+        return now - parse_time(doc.modified_at) > max_stuck
     return False
 
 

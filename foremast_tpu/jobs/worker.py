@@ -46,9 +46,6 @@ from foremast_tpu.metrics.source import MetricSource
 log = logging.getLogger("foremast_tpu.worker")
 
 
-_parse_time = parse_time
-
-
 def infer_metric_type(alias: str, config: BrainConfig) -> str | None:
     """Map a metric alias onto a per-type threshold row by substring match
     (the reference keys its override matrix by metric *type* names like
@@ -127,7 +124,7 @@ class BrainWorker:
         self, doc: Document, verdicts: list[MetricVerdict], now: float
     ) -> Document:
         job_verdict = combine_verdicts(verdicts)
-        end = _parse_time(doc.end_time)
+        end = parse_time(doc.end_time)
         # a missing/unparseable endTime must not make the job immortal:
         # finalize on the first judgment instead of re-checking forever
         past_end = end <= 0 or now >= end

@@ -1,0 +1,233 @@
+"""Metric-aggregation layer: Prometheus recording rules, generated.
+
+The reference ships a hand-written PrometheusRule manifest
+(`deploy/foremast/2_barrelman/metrics-rules-default.yaml:15-39,45-56`) that
+pre-aggregates raw app/kubelet series into the three naming families the
+query builder consumes (`metricsquery.go:53-78`):
+
+    namespace_pod:<metric>          sum by (namespace, pod)
+    namespace_app:<metric>          sum by (namespace, app)
+    namespace_app_per_pod:<metric>  namespace_app:<metric> / namespace_app:pod_count
+
+Rather than maintaining a YAML blob, this module *generates* the rule set
+from a compact spec: HTTP request-rate families are one template over a
+status-class regex; the per-pod family is a pure quotient of the per-app
+family. `prometheus_rule_manifest()` renders the PrometheusRule custom
+resource used by deploy/, and `rule_expr()` lets tests and the replay
+metric store resolve what a recorded series means.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from collections.abc import Iterable
+
+# Rate window for request-class rules (reference uses [1m] throughout the
+# spring.boot.metrics.rules group; resource rules use [5m]).
+REQUEST_RATE_WINDOW = "1m"
+CPU_RATE_WINDOW = "5m"
+
+# status-class regex per derived request metric (reference
+# metrics-rules-default.yaml spring.boot group). `None` means no status
+# selector (total request count).
+REQUEST_CLASSES: dict[str, str | None] = {
+    "http_server_requests_2xx": "2[0-9]+",
+    "http_server_requests_error_4xx": "4[0-9]+",
+    "http_server_requests_error_5xx": "5[0-9]+",
+    "http_server_requests_errors": "[4-5][0-9]+",
+    "http_server_requests_count": None,
+}
+
+# Resource metrics from kubelet/cAdvisor, aggregated the same three ways.
+RESOURCE_METRICS = ("cpu_usage_seconds_total", "memory_usage_bytes")
+
+LATENCY_METRIC = "http_server_requests_latency"
+
+#: Every derived metric name the aggregation layer records (the vocabulary
+#: the DeploymentMetadata `monitoring:` lists draw from).
+ALL_METRICS: tuple[str, ...] = (
+    *REQUEST_CLASSES,
+    LATENCY_METRIC,
+    *RESOURCE_METRICS,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RecordingRule:
+    record: str
+    expr: str
+
+    def to_dict(self) -> dict[str, str]:
+        return {"record": self.record, "expr": self.expr}
+
+
+def _requests_rate(status_re: str | None, by: str) -> str:
+    sel = f'{{status=~"{status_re}"}}' if status_re else ""
+    return (
+        f"sum(rate(http_server_requests_seconds_count{sel}"
+        f"[{REQUEST_RATE_WINDOW}])) by (namespace, {by})"
+    )
+
+
+def _latency(by: str) -> str:
+    return (
+        'sum(rate(http_server_requests_seconds_sum{status="200"}'
+        f"[{REQUEST_RATE_WINDOW}])"
+        '/rate(http_server_requests_seconds_count{status="200"}'
+        f"[{REQUEST_RATE_WINDOW}])) by (namespace, {by})"
+    )
+
+
+# join raw cAdvisor pod_name series onto the `app` pod label via
+# kube-state-metrics, the reference's label_replace dance
+_APP_JOIN = (
+    " * on (namespace, pod_name) group_left(app) label_replace(label_replace("
+    'kube_pod_labels{job="kube-state-metrics"}, "pod_name", "$1", "pod", '
+    '"(.*)"), "app", "$1", "label_app", "(.*)")'
+)
+
+
+def _resource_expr(metric: str, by: str) -> str:
+    if metric == "cpu_usage_seconds_total":
+        inner = (
+            "sum(rate(container_cpu_usage_seconds_total"
+            f'{{job="kubelet", image!="", container_name!=""}}[{CPU_RATE_WINDOW}]))'
+            " by (namespace, pod_name)"
+        )
+    else:
+        inner = (
+            "sum(container_memory_usage_bytes"
+            '{job="kubelet", image!="", container_name!=""}) by (namespace, pod_name)'
+        )
+    if by == "pod":
+        return (
+            f'sum by (namespace, pod) (label_replace({inner}, "pod", "$1", '
+            '"pod_name", "(.*)"))'
+        )
+    return f"sum by (namespace, app) ({inner}{_APP_JOIN})"
+
+
+def core_rules() -> list[RecordingRule]:
+    """Resource aggregation + the pod_count denominator."""
+    rules = []
+    for metric in RESOURCE_METRICS:
+        rules.append(
+            RecordingRule(f"namespace_pod:{metric}", _resource_expr(metric, "pod"))
+        )
+        rules.append(
+            RecordingRule(f"namespace_app:{metric}", _resource_expr(metric, "app"))
+        )
+    rules.append(
+        RecordingRule(
+            "namespace_app:pod_count",
+            'count(label_replace(kube_pod_labels{job="kube-state-metrics"}, '
+            '"app", "$1", "label_app", "(.*)")) by (namespace, app)',
+        )
+    )
+    rules.extend(_per_pod_rules(RESOURCE_METRICS))
+    return rules
+
+
+def request_rules() -> list[RecordingRule]:
+    """HTTP request-class + latency aggregation (app-instrumented series)."""
+    rules = []
+    for by in ("pod", "app"):
+        prefix = "namespace_pod" if by == "pod" else "namespace_app"
+        for metric, status_re in REQUEST_CLASSES.items():
+            rules.append(
+                RecordingRule(f"{prefix}:{metric}", _requests_rate(status_re, by))
+            )
+        rules.append(RecordingRule(f"{prefix}:{LATENCY_METRIC}", _latency(by)))
+    rules.extend(_per_pod_rules((*REQUEST_CLASSES, LATENCY_METRIC)))
+    return rules
+
+
+def _per_pod_rules(metrics: Iterable[str]) -> list[RecordingRule]:
+    return [
+        RecordingRule(
+            f"namespace_app_per_pod:{m}",
+            f"namespace_app:{m} / namespace_app:pod_count",
+        )
+        for m in metrics
+    ]
+
+
+def all_rules() -> list[RecordingRule]:
+    return core_rules() + request_rules()
+
+
+def rule_expr(record: str) -> str | None:
+    """Resolve a recorded series name to its PromQL definition."""
+    for rule in all_rules():
+        if rule.record == record:
+            return rule.expr
+    return None
+
+
+def prometheus_rule_manifest(
+    name: str = "foremast-metrics-rules", namespace: str = "monitoring"
+) -> dict:
+    """The PrometheusRule custom resource (monitoring.coreos.com/v1)."""
+    return {
+        "apiVersion": "monitoring.coreos.com/v1",
+        "kind": "PrometheusRule",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": {"prometheus": "k8s", "role": "alert-rules"},
+        },
+        "spec": {
+            "groups": [
+                {
+                    "name": "core.metrics.aggregation.rules",
+                    "rules": [r.to_dict() for r in core_rules()],
+                },
+                {
+                    "name": "request.metrics.aggregation.rules",
+                    "rules": [r.to_dict() for r in request_rules()],
+                },
+            ]
+        },
+    }
+
+
+def _yaml_scalar(s: str) -> str:
+    """Quote a scalar for YAML output (JSON strings are valid YAML)."""
+    return json.dumps(s)
+
+
+def to_yaml(manifest: dict | None = None) -> str:
+    """Render the manifest as YAML without a yaml dependency (the image has
+    PyYAML, but keeping the renderer dependency-free makes the deploy
+    artifacts reproducible from a bare interpreter)."""
+    m = manifest if manifest is not None else prometheus_rule_manifest()
+    lines: list[str] = []
+
+    def emit(obj, indent: int, in_list: bool = False) -> None:
+        pad = "  " * indent
+        if isinstance(obj, dict):
+            first = True
+            for k, v in obj.items():
+                lead = pad[:-2] + "- " if in_list and first else pad
+                first = False
+                if isinstance(v, (dict, list)) and v:
+                    lines.append(f"{lead}{k}:")
+                    emit(v, indent + 1)
+                else:
+                    val = _yaml_scalar(v) if isinstance(v, str) else json.dumps(v)
+                    lines.append(f"{lead}{k}: {val}")
+        elif isinstance(obj, list):
+            for item in obj:
+                if isinstance(item, dict):
+                    emit(item, indent + 1, in_list=True)
+                else:
+                    val = (
+                        _yaml_scalar(item)
+                        if isinstance(item, str)
+                        else json.dumps(item)
+                    )
+                    lines.append(f"{pad}- {val}")
+
+    emit(m, 0)
+    return "\n".join(lines) + "\n"

@@ -141,3 +141,86 @@ def test_file_error_generator_replays_trace(demo, tmp_path):
         and 'uri="/error5xx"' in l
     )
     assert float(line.rsplit(" ", 1)[1]) == 41.0
+
+
+def test_wsgi_streaming_app_records_real_status():
+    """PEP 3333: apps may defer start_response until the body is iterated —
+    the middleware must record the real status, not a default 500."""
+    from foremast_tpu.instrument.starter import wsgi_middleware
+
+    def streaming_app(environ, start_response):
+        def gen():
+            start_response("200 OK", [("Content-Type", "text/plain")])
+            yield b"chunk1"
+            yield b"chunk2"
+
+        return gen()
+
+    metrics = HttpMetrics(K8sMetricsConfig(common_tags={"app": "x"}))
+    client = DemoClient(wsgi_middleware(streaming_app, metrics))
+    status, body = client.get("/stream")
+    assert status == 200 and body == b"chunk1chunk2"
+    text = scrape(client)
+    line = next(
+        l for l in text.splitlines()
+        if l.startswith("http_server_requests_seconds_count")
+        and 'uri="/stream"' in l
+    )
+    assert 'status="200"' in line
+    assert float(line.rsplit(" ", 1)[1]) == 1.0
+
+
+def test_wsgi_exception_recorded_as_500():
+    from foremast_tpu.instrument.starter import wsgi_middleware
+
+    def crashing_app(environ, start_response):
+        raise RuntimeError("boom")
+
+    metrics = HttpMetrics(K8sMetricsConfig(common_tags={"app": "x"}))
+    app = wsgi_middleware(crashing_app, metrics)
+    client = DemoClient(app)
+    with pytest.raises(RuntimeError):
+        client.get("/crash")
+    text = scrape(DemoClient(app))
+    assert any(
+        'uri="/crash"' in l and 'status="500"' in l
+        for l in text.splitlines()
+        if l.startswith("http_server_requests_seconds_count")
+    )
+
+
+def test_aiohttp_http_exception_status_not_500():
+    """Raising web.HTTPNotFound is aiohttp's idiomatic 404, not a 5xx."""
+    import asyncio
+
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from foremast_tpu.instrument.starter import instrument_aiohttp
+
+    async def run():
+        async def missing(request):
+            raise web.HTTPNotFound()
+
+        app = web.Application()
+        app.router.add_get("/gone", missing)
+        metrics = HttpMetrics(K8sMetricsConfig(common_tags={"app": "x"}))
+        instrument_aiohttp(app, metrics)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/gone")
+            assert r.status == 404
+            m = await client.get("/metrics")
+            assert m.headers["Content-Type"].startswith("text/plain; version=")
+            text = await m.text()
+        finally:
+            await client.close()
+        return text
+
+    text = asyncio.get_event_loop_policy().new_event_loop().run_until_complete(run())
+    lines = [
+        l for l in text.splitlines()
+        if l.startswith("http_server_requests_seconds_count") and 'uri="/gone"' in l
+    ]
+    assert lines and all('status="404"' in l for l in lines)

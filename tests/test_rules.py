@@ -1,0 +1,79 @@
+"""Recording-rule generator: naming-family coverage + manifest validity.
+
+The reference's rule manifest is `metrics-rules-default.yaml`; the query
+builder consumes the recorded names (`metricsquery.go:53-78`). These tests
+assert the generated rules expose the exact naming families the query layer
+depends on, and that the YAML renderer emits a parseable PrometheusRule.
+"""
+
+import yaml
+
+from foremast_tpu.metrics.rules import (
+    ALL_METRICS,
+    all_rules,
+    core_rules,
+    prometheus_rule_manifest,
+    request_rules,
+    rule_expr,
+    to_yaml,
+)
+
+
+def test_every_metric_recorded_at_all_three_levels():
+    names = {r.record for r in all_rules()}
+    for metric in ALL_METRICS:
+        assert f"namespace_pod:{metric}" in names
+        assert f"namespace_app:{metric}" in names
+        assert f"namespace_app_per_pod:{metric}" in names
+
+
+def test_per_pod_is_quotient_of_app_and_pod_count():
+    expr = rule_expr("namespace_app_per_pod:http_server_requests_latency")
+    assert expr == (
+        "namespace_app:http_server_requests_latency / namespace_app:pod_count"
+    )
+    assert rule_expr("namespace_app:pod_count") is not None
+
+
+def test_status_class_selectors():
+    assert 'status=~"5[0-9]+"' in rule_expr(
+        "namespace_pod:http_server_requests_error_5xx"
+    )
+    assert 'status=~"[4-5][0-9]+"' in rule_expr(
+        "namespace_pod:http_server_requests_errors"
+    )
+    # total count has no status selector
+    assert "status" not in rule_expr("namespace_pod:http_server_requests_count")
+    # latency is a sum/count ratio gated on 200s
+    latency = rule_expr("namespace_app:http_server_requests_latency")
+    assert "http_server_requests_seconds_sum" in latency
+    assert 'status="200"' in latency
+
+
+def test_resource_rules_join_app_label():
+    expr = rule_expr("namespace_app:cpu_usage_seconds_total")
+    assert "kube_pod_labels" in expr and "group_left(app)" in expr
+    pod_expr = rule_expr("namespace_pod:memory_usage_bytes")
+    assert "container_memory_usage_bytes" in pod_expr
+
+
+def test_no_duplicate_records():
+    records = [r.record for r in all_rules()]
+    assert len(records) == len(set(records))
+    assert len(core_rules()) + len(request_rules()) == len(records)
+
+
+def test_manifest_yaml_roundtrip():
+    text = to_yaml()
+    parsed = yaml.safe_load(text)
+    assert parsed == prometheus_rule_manifest()
+    assert parsed["kind"] == "PrometheusRule"
+    groups = {g["name"] for g in parsed["spec"]["groups"]}
+    assert groups == {
+        "core.metrics.aggregation.rules",
+        "request.metrics.aggregation.rules",
+    }
+
+
+def test_unknown_record_resolves_none():
+    assert rule_expr("namespace_pod:nope") is None
