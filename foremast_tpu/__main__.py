@@ -1,0 +1,6 @@
+"""`python -m foremast_tpu` — the foremast CLI."""
+
+from foremast_tpu.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,282 @@
+"""Command-line surface: score / serve / worker / watch / unwatch / rules.
+
+The reference drives its system with kubectl plus two tiny plugins
+(`bin/kubectl-watch`, `bin/kubectl-unwatch` — merge-patching
+DeploymentMonitor.spec.continuous, `bin/kubectl-watch:3`). This framework
+adds a first-class CLI:
+
+  score    one-shot health judgment of an ApplicationHealthAnalyzeRequest
+           JSON (the minimum end-to-end slice: request -> windows -> batched
+           TPU judgment -> reference wire-format response)
+  serve    the REST job gateway on :8099 (foremast-service equivalent)
+  worker   the scoring worker loop + :8000 gauge exposition (brain
+           equivalent)
+  watch    / unwatch — toggle continuous monitoring on a DeploymentMonitor
+           (kubectl-watch parity, via the API server)
+  rules    print the generated PrometheusRule recording-rules manifest
+
+`python -m foremast_tpu <cmd>` and the `bin/foremast` shim both land here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _add_score(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "score", help="one-shot health judgment of a request JSON"
+    )
+    p.add_argument(
+        "--request",
+        required=True,
+        help="path to ApplicationHealthAnalyzeRequest JSON ('-' for stdin)",
+    )
+    p.add_argument(
+        "--current",
+        action="append",
+        default=[],
+        metavar="ALIAS=CSV",
+        help="replay trace for the current window of ALIAS",
+    )
+    p.add_argument("--baseline", action="append", default=[], metavar="ALIAS=CSV")
+    p.add_argument("--historical", action="append", default=[], metavar="ALIAS=CSV")
+    p.add_argument(
+        "--prometheus",
+        action="store_true",
+        help="fetch real query_range URLs instead of replay traces",
+    )
+    p.add_argument(
+        "--follow",
+        action="store_true",
+        help="keep re-checking until the job reaches a terminal status "
+        "(the reference's incremental re-check loop); default judges once "
+        "and finalizes",
+    )
+    p.add_argument("--poll", type=float, default=5.0, help="--follow poll seconds")
+
+
+def _parse_assignments(pairs: list[str], flag: str) -> dict[str, str]:
+    out = {}
+    for pair in pairs:
+        alias, sep, path = pair.partition("=")
+        if not sep or not alias or not path:
+            raise SystemExit(f"{flag} expects ALIAS=CSV, got {pair!r}")
+        out[alias] = path
+    return out
+
+
+def cmd_score(args: argparse.Namespace) -> int:
+    from foremast_tpu.jobs.convert import request_to_document
+    from foremast_tpu.jobs.models import AnalyzeRequest, document_response
+    from foremast_tpu.jobs.store import InMemoryStore, parse_time
+    from foremast_tpu.jobs.worker import BrainWorker
+    from urllib.parse import unquote
+
+    from foremast_tpu.metrics.promql import decode_config
+    from foremast_tpu.metrics.source import PrometheusSource, ReplaySource
+
+    raw = sys.stdin.read() if args.request == "-" else open(args.request).read()
+    req = AnalyzeRequest.from_json(json.loads(raw))
+    doc = request_to_document(req)
+
+    if args.prometheus:
+        source = PrometheusSource()
+    else:
+        # replay traces are keyed by exact query URL: current/baseline/
+        # historical configs for the same alias differ only in their URLs,
+        # so route each category's URL to its own trace
+        source = ReplaySource()
+        for flag, config in (
+            ("--current", doc.current_config),
+            ("--baseline", doc.baseline_config),
+            ("--historical", doc.historical_config),
+        ):
+            assignments = _parse_assignments(getattr(args, flag[2:]), flag)
+            urls = decode_config(config)
+            for alias, path in assignments.items():
+                if alias not in urls:
+                    raise SystemExit(
+                        f"{flag} {alias}: no such alias in the request's "
+                        f"{flag[2:]} metrics (have: {sorted(urls) or 'none'})"
+                    )
+                # ReplaySource matches patterns against the *unquoted* URL
+                source.register_csv(unquote(urls[alias]), path)
+
+    store = InMemoryStore()
+    doc, _ = store.create(doc)
+    worker = BrainWorker(store, source, claim_limit=1)
+
+    if args.follow:
+        from foremast_tpu.jobs.models import TERMINAL_STATUSES
+
+        while store.get(doc.id).status not in TERMINAL_STATUSES:
+            worker.tick()
+            if store.get(doc.id).status in TERMINAL_STATUSES:
+                break
+            time.sleep(args.poll)
+    else:
+        # one-shot: clamp "now" past endTime so a healthy window finalizes
+        end = parse_time(doc.end_time)
+        worker.tick(now=max(time.time(), end + 1))
+
+    final = store.get(doc.id)
+    json.dump(document_response(final), sys.stdout, indent=2)
+    print()
+    return 0 if final.status != "preprocess_failed" else 1
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    from foremast_tpu.jobs.store import ElasticsearchStore, InMemoryStore
+    from foremast_tpu.service.app import serve
+
+    store = (
+        ElasticsearchStore(args.elastic_url) if args.elastic_url else InMemoryStore()
+    )
+    if args.elastic_url:
+        store.wait_ready()  # ES connect-retry loop (service main.go:248-260)
+    serve(
+        host=args.host,
+        port=args.port,
+        store=store,
+        query_endpoint=args.query_endpoint,
+    )
+    return 0
+
+
+def cmd_worker(args: argparse.Namespace) -> int:
+    from foremast_tpu.config import BrainConfig
+    from foremast_tpu.jobs.store import ElasticsearchStore, InMemoryStore
+    from foremast_tpu.jobs.worker import BrainWorker
+    from foremast_tpu.metrics.source import PrometheusSource
+    from foremast_tpu.observe.gauges import (
+        BrainGauges,
+        make_verdict_hook,
+        start_metrics_server,
+    )
+
+    config = BrainConfig.from_env()
+    store = (
+        ElasticsearchStore(args.elastic_url) if args.elastic_url else InMemoryStore()
+    )
+    if args.elastic_url:
+        store.wait_ready()
+    on_verdict = None
+    if args.gauge_port:
+        gauges = BrainGauges()
+        start_metrics_server(args.gauge_port)
+        on_verdict = make_verdict_hook(gauges)
+    worker = BrainWorker(
+        store, PrometheusSource(), config=config, on_verdict=on_verdict
+    )
+    worker.run(poll_seconds=args.poll)
+    return 0
+
+
+def _toggle_continuous(args: argparse.Namespace, value: bool) -> int:
+    from foremast_tpu.watch.kubeapi import HttpKube, NotFound
+
+    kube = HttpKube(base_url=args.api_server)
+    try:
+        # merge-patch only spec.continuous (what the reference plugin's
+        # `kubectl patch --type=merge` does) so concurrent spec/status
+        # writers are never reverted
+        monitor = kube.patch_monitor(
+            args.namespace, args.name, {"spec": {"continuous": value}}
+        )
+    except NotFound:
+        print(f"deploymentmonitor {args.namespace}/{args.name} not found", file=sys.stderr)
+        return 1
+    verb = "watching" if value else "no longer watching"
+    print(f"Foremast is {verb} application {args.name}")
+    print(f"Job: {monitor.status.job_id}")
+    return 0
+
+
+def cmd_watch(args: argparse.Namespace) -> int:
+    return _toggle_continuous(args, True)
+
+
+def cmd_unwatch(args: argparse.Namespace) -> int:
+    return _toggle_continuous(args, False)
+
+
+def cmd_rules(args: argparse.Namespace) -> int:
+    from foremast_tpu.metrics.rules import prometheus_rule_manifest, to_yaml
+
+    sys.stdout.write(
+        to_yaml(prometheus_rule_manifest(namespace=args.namespace))
+    )
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="foremast", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    _add_score(sub)
+
+    p = sub.add_parser("serve", help="REST job gateway on :8099")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8099)
+    p.add_argument(
+        "--elastic-url",
+        default=None,
+        help="Elasticsearch backend (ELASTIC_URL parity); default in-memory",
+    )
+    p.add_argument(
+        "--query-endpoint",
+        default=None,
+        help="upstream Prometheus for /api/v1 proxy (QUERY_SERVICE_ENDPOINT)",
+    )
+
+    p = sub.add_parser("worker", help="scoring worker loop (brain)")
+    p.add_argument("--elastic-url", default=None)
+    p.add_argument("--poll", type=float, default=5.0)
+    p.add_argument(
+        "--gauge-port",
+        type=int,
+        default=8000,
+        help="foremastbrain:* gauge exposition port (0 disables)",
+    )
+
+    for name, helptext in (
+        ("watch", "enable continuous monitoring (kubectl-watch parity)"),
+        ("unwatch", "disable continuous monitoring"),
+    ):
+        p = sub.add_parser(name, help=helptext)
+        p.add_argument("name", help="DeploymentMonitor name (the app)")
+        p.add_argument("--namespace", "-n", default="default")
+        p.add_argument(
+            "--api-server", default=None, help="API server URL (default in-cluster)"
+        )
+
+    p = sub.add_parser("rules", help="print recording-rules manifest YAML")
+    p.add_argument("--namespace", default="monitoring")
+
+    return parser
+
+
+COMMANDS = {
+    "score": cmd_score,
+    "serve": cmd_serve,
+    "worker": cmd_worker,
+    "watch": cmd_watch,
+    "unwatch": cmd_unwatch,
+    "rules": cmd_rules,
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return COMMANDS[args.command](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -20,6 +20,7 @@ metric store resolve what a recorded series means.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 from collections.abc import Iterable
 
@@ -157,12 +158,14 @@ def all_rules() -> list[RecordingRule]:
     return core_rules() + request_rules()
 
 
+@functools.lru_cache(maxsize=1)
+def _by_record() -> dict[str, str]:
+    return {r.record: r.expr for r in all_rules()}
+
+
 def rule_expr(record: str) -> str | None:
     """Resolve a recorded series name to its PromQL definition."""
-    for rule in all_rules():
-        if rule.record == record:
-            return rule.expr
-    return None
+    return _by_record().get(record)
 
 
 def prometheus_rule_manifest(

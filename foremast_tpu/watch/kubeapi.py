@@ -54,6 +54,9 @@ class KubeClient(Protocol):
     def list_monitors(self, namespace: str | None = None) -> list[DeploymentMonitor]: ...
     def get_monitor(self, namespace: str, name: str) -> DeploymentMonitor: ...
     def upsert_monitor(self, monitor: DeploymentMonitor) -> DeploymentMonitor: ...
+    def patch_monitor(
+        self, namespace: str, name: str, patch: dict
+    ) -> DeploymentMonitor: ...
     def delete_monitor(self, namespace: str, name: str) -> None: ...
 
 
@@ -227,6 +230,21 @@ class InMemoryKube:
             fn("update" if old else "add", monitor, old)
         return monitor
 
+    def patch_monitor(
+        self, namespace: str, name: str, patch: dict
+    ) -> DeploymentMonitor:
+        """Merge-patch a monitor (what `kubectl patch --type=merge` does):
+        only the patched fields change, concurrent writers are preserved."""
+        old = self.get_monitor(namespace, name)
+        obj = old.to_json()
+        _deep_merge(obj, patch)
+        merged = DeploymentMonitor.from_json(obj)
+        self.monitors[(namespace, name)] = merged
+        self.actions.append(("patch", "DeploymentMonitor", namespace, name, patch))
+        for fn in list(self.monitor_handlers):
+            fn("update", merged, old)
+        return merged
+
     def delete_monitor(self, namespace: str, name: str) -> None:
         m = self.monitors.pop((namespace, name), None)
         if m is not None:
@@ -372,6 +390,17 @@ class HttpKube:
                     monitor.to_json(),
                 )
             )
+
+    def patch_monitor(
+        self, namespace: str, name: str, patch: dict
+    ) -> DeploymentMonitor:
+        obj = self._req(
+            "PATCH",
+            self._crd_path("deploymentmonitors", namespace, name),
+            patch,
+            content_type="application/merge-patch+json",
+        )
+        return DeploymentMonitor.from_json(obj)
 
     def delete_monitor(self, namespace: str, name: str) -> None:
         try:

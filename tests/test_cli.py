@@ -1,0 +1,161 @@
+"""CLI surface: score (end-to-end slice), watch/unwatch, rules.
+
+The score test is the SURVEY.md section 7.3 "minimum end-to-end slice": a
+reference-wire-format request judged against the golden demo traces, with
+the response in the reference's DocumentResponse shape.
+"""
+
+import io
+import json
+import os
+
+import pytest
+
+from foremast_tpu.cli import main
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+NORMAL = os.path.join(DATA, "demo_canary_normal.csv")
+SPIKE = os.path.join(DATA, "demo_canary_spike.csv")
+
+
+def make_request(tmp_path, aliases=("error4xx",)):
+    def mq(query):
+        return {
+            "dataSourceType": "prometheus",
+            "parameters": {
+                "endpoint": "http://prometheus:9090/api/v1/",
+                "query": query,
+                "start": "1600000000",
+                "end": "1600000600",
+                "step": "60",
+            },
+        }
+
+    req = {
+        "appName": "demo-app",
+        "startTime": "2020-09-13T12:26:40Z",
+        "endTime": "2020-09-13T12:36:40Z",
+        "strategy": "canary",
+        "metrics": {
+            "current": {a: mq(f"cur:{a}") for a in aliases},
+            "baseline": {a: mq(f"base:{a}") for a in aliases},
+            "historical": {a: mq(f"hist:{a}") for a in aliases},
+        },
+    }
+    path = tmp_path / "request.json"
+    path.write_text(json.dumps(req))
+    return str(path)
+
+
+def run_score(capsys, request_path, current, baseline, historical):
+    argv = ["score", "--request", request_path]
+    for alias, path in current.items():
+        argv += ["--current", f"{alias}={path}"]
+    for alias, path in baseline.items():
+        argv += ["--baseline", f"{alias}={path}"]
+    for alias, path in historical.items():
+        argv += ["--historical", f"{alias}={path}"]
+    rc = main(argv)
+    out = capsys.readouterr().out
+    return rc, json.loads(out)
+
+
+def test_score_spike_trace_is_anomaly(tmp_path, capsys):
+    req = make_request(tmp_path)
+    rc, resp = run_score(
+        capsys,
+        req,
+        current={"error4xx": SPIKE},
+        baseline={"error4xx": NORMAL},
+        historical={"error4xx": NORMAL},
+    )
+    assert rc == 0
+    # external status enum (converter.go:11-30): unhealthy -> "anomaly"
+    assert resp["status"] == "anomaly"
+    assert resp["anomalyInfo"]["values"]["error4xx"], "flat [t,v,...] pairs"
+    # flat pair encoding: even length, alternating time/value
+    pairs = resp["anomalyInfo"]["values"]["error4xx"]
+    assert len(pairs) % 2 == 0
+    values = pairs[1::2]
+    assert any(v > 30 for v in values), "the 40.134 spike should be flagged"
+
+
+def test_score_normal_trace_is_healthy(tmp_path, capsys):
+    req = make_request(tmp_path)
+    rc, resp = run_score(
+        capsys,
+        req,
+        current={"error4xx": NORMAL},
+        baseline={"error4xx": NORMAL},
+        historical={"error4xx": NORMAL},
+    )
+    assert rc == 0
+    assert resp["status"] == "success"
+
+
+def test_score_unknown_alias_rejected(tmp_path, capsys):
+    req = make_request(tmp_path)
+    with pytest.raises(SystemExit):
+        main(["score", "--request", req, "--current", f"nope={NORMAL}"])
+
+
+def test_score_reads_stdin(tmp_path, capsys, monkeypatch):
+    req_path = make_request(tmp_path)
+    monkeypatch.setattr(
+        "sys.stdin", io.StringIO(open(req_path).read())
+    )
+    rc, resp = run_score(
+        capsys,
+        "-",
+        current={"error4xx": NORMAL},
+        baseline={"error4xx": NORMAL},
+        historical={"error4xx": NORMAL},
+    )
+    assert rc == 0 and resp["status"] == "success"
+
+
+def test_rules_prints_manifest(capsys):
+    import yaml
+
+    rc = main(["rules", "--namespace", "observ"])
+    assert rc == 0
+    parsed = yaml.safe_load(capsys.readouterr().out)
+    assert parsed["kind"] == "PrometheusRule"
+    assert parsed["metadata"]["namespace"] == "observ"
+
+
+def test_watch_unwatch_toggle_continuous(monkeypatch, capsys):
+    from foremast_tpu.watch.crds import DeploymentMonitor
+    from foremast_tpu.watch.kubeapi import InMemoryKube
+
+    from foremast_tpu.watch.crds import MonitorStatus
+
+    kube = InMemoryKube()
+    kube.upsert_monitor(
+        DeploymentMonitor(
+            name="demo", namespace="ns1", status=MonitorStatus(job_id="job-42")
+        )
+    )
+    monkeypatch.setattr(
+        "foremast_tpu.watch.kubeapi.HttpKube", lambda base_url=None: kube
+    )
+    rc = main(["watch", "demo", "-n", "ns1"])
+    assert rc == 0
+    assert kube.get_monitor("ns1", "demo").continuous is True
+    rc = main(["unwatch", "demo", "-n", "ns1"])
+    assert rc == 0
+    assert kube.get_monitor("ns1", "demo").continuous is False
+    # merge-patch semantics: untouched fields survive the toggle
+    assert kube.get_monitor("ns1", "demo").status.job_id == "job-42"
+    out = capsys.readouterr().out
+    assert "watching application demo" in out
+    assert "Job: job-42" in out
+
+
+def test_watch_missing_monitor_fails(monkeypatch, capsys):
+    from foremast_tpu.watch.kubeapi import InMemoryKube
+
+    monkeypatch.setattr(
+        "foremast_tpu.watch.kubeapi.HttpKube", lambda base_url=None: InMemoryKube()
+    )
+    assert main(["watch", "ghost", "-n", "ns1"]) == 1
