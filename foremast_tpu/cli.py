@@ -30,6 +30,7 @@ def _add_score(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser(
         "score", help="one-shot health judgment of a request JSON"
     )
+    p.set_defaults(fn=cmd_score)
     p.add_argument(
         "--request",
         required=True,
@@ -110,9 +111,13 @@ def cmd_score(args: argparse.Namespace) -> int:
     doc, _ = store.create(doc)
     worker = BrainWorker(store, source, claim_limit=1)
 
-    if args.follow:
-        from foremast_tpu.jobs.models import TERMINAL_STATUSES
+    from foremast_tpu.jobs.models import (
+        STATUS_COMPLETED_HEALTH,
+        STATUS_COMPLETED_UNHEALTH,
+        TERMINAL_STATUSES,
+    )
 
+    if args.follow:
         while store.get(doc.id).status not in TERMINAL_STATUSES:
             worker.tick()
             if store.get(doc.id).status in TERMINAL_STATUSES:
@@ -126,18 +131,32 @@ def cmd_score(args: argparse.Namespace) -> int:
     final = store.get(doc.id)
     json.dump(document_response(final), sys.stdout, indent=2)
     print()
-    return 0 if final.status != "preprocess_failed" else 1
+    # exit 0 only when the judgment actually evaluated the metrics
+    # (healthy OR anomaly); preprocess_failed / abort / completed_unknown
+    # mean no judgment was made, which must fail a CI gate.
+    return (
+        0
+        if final.status in (STATUS_COMPLETED_HEALTH, STATUS_COMPLETED_UNHEALTH)
+        else 1
+    )
+
+
+def _make_store(elastic_url: str | None):
+    """ES-backed store with the reference's connect-retry loop
+    (service main.go:248-260), or in-memory when no URL is given."""
+    from foremast_tpu.jobs.store import ElasticsearchStore, InMemoryStore
+
+    if not elastic_url:
+        return InMemoryStore()
+    store = ElasticsearchStore(elastic_url)
+    store.wait_ready()
+    return store
 
 
 def cmd_serve(args: argparse.Namespace) -> int:
-    from foremast_tpu.jobs.store import ElasticsearchStore, InMemoryStore
     from foremast_tpu.service.app import serve
 
-    store = (
-        ElasticsearchStore(args.elastic_url) if args.elastic_url else InMemoryStore()
-    )
-    if args.elastic_url:
-        store.wait_ready()  # ES connect-retry loop (service main.go:248-260)
+    store = _make_store(args.elastic_url)
     serve(
         host=args.host,
         port=args.port,
@@ -149,7 +168,6 @@ def cmd_serve(args: argparse.Namespace) -> int:
 
 def cmd_worker(args: argparse.Namespace) -> int:
     from foremast_tpu.config import BrainConfig
-    from foremast_tpu.jobs.store import ElasticsearchStore, InMemoryStore
     from foremast_tpu.jobs.worker import BrainWorker
     from foremast_tpu.metrics.source import PrometheusSource
     from foremast_tpu.observe.gauges import (
@@ -159,11 +177,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
     )
 
     config = BrainConfig.from_env()
-    store = (
-        ElasticsearchStore(args.elastic_url) if args.elastic_url else InMemoryStore()
-    )
-    if args.elastic_url:
-        store.wait_ready()
+    store = _make_store(args.elastic_url)
     on_verdict = None
     if args.gauge_port:
         gauges = BrainGauges()
@@ -221,8 +235,11 @@ def build_parser() -> argparse.ArgumentParser:
     sub = parser.add_subparsers(dest="command", required=True)
 
     _add_score(sub)
+    # each parser carries its handler via set_defaults(fn=...) so a new
+    # subcommand can never be registered without one
 
     p = sub.add_parser("serve", help="REST job gateway on :8099")
+    p.set_defaults(fn=cmd_serve)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8099)
     p.add_argument(
@@ -237,6 +254,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
 
     p = sub.add_parser("worker", help="scoring worker loop (brain)")
+    p.set_defaults(fn=cmd_worker)
     p.add_argument("--elastic-url", default=None)
     p.add_argument("--poll", type=float, default=5.0)
     p.add_argument(
@@ -246,11 +264,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="foremastbrain:* gauge exposition port (0 disables)",
     )
 
-    for name, helptext in (
-        ("watch", "enable continuous monitoring (kubectl-watch parity)"),
-        ("unwatch", "disable continuous monitoring"),
+    for name, fn, helptext in (
+        ("watch", cmd_watch, "enable continuous monitoring (kubectl-watch parity)"),
+        ("unwatch", cmd_unwatch, "disable continuous monitoring"),
     ):
         p = sub.add_parser(name, help=helptext)
+        p.set_defaults(fn=fn)
         p.add_argument("name", help="DeploymentMonitor name (the app)")
         p.add_argument("--namespace", "-n", default="default")
         p.add_argument(
@@ -258,24 +277,15 @@ def build_parser() -> argparse.ArgumentParser:
         )
 
     p = sub.add_parser("rules", help="print recording-rules manifest YAML")
+    p.set_defaults(fn=cmd_rules)
     p.add_argument("--namespace", default="monitoring")
 
     return parser
 
 
-COMMANDS = {
-    "score": cmd_score,
-    "serve": cmd_serve,
-    "worker": cmd_worker,
-    "watch": cmd_watch,
-    "unwatch": cmd_unwatch,
-    "rules": cmd_rules,
-}
-
-
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    return COMMANDS[args.command](args)
+    return args.fn(args)
 
 
 if __name__ == "__main__":

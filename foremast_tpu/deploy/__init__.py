@@ -1,0 +1,5 @@
+"""Deploy manifests as code (see manifests.py)."""
+
+from foremast_tpu.deploy.manifests import render, render_file, tree
+
+__all__ = ["render", "render_file", "tree"]

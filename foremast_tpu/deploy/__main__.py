@@ -1,0 +1,10 @@
+"""`python -m foremast_tpu.deploy [root]` — render the deploy/ tree."""
+
+import sys
+
+from foremast_tpu.deploy.manifests import render
+
+if __name__ == "__main__":
+    root = sys.argv[1] if len(sys.argv) > 1 else "deploy"
+    for path in render(root):
+        print(path)

@@ -1,0 +1,727 @@
+"""Deploy manifests, generated — the `deploy/` tree as code.
+
+The reference ships a hand-maintained manifest tree whose numbered dirs
+encode install order (`deploy/foremast/{00namespace,1_crds,2_barrelman,
+3_brain}`, SURVEY.md §2.7). This module *generates* the equivalent tree
+for the TPU framework so the CRD schemas, env-var matrix, ports, and
+RBAC verbs are derived from the same Python definitions the runtime uses
+(`watch/crds.py`, `config.BrainConfig`, `metrics/rules.py`) and can never
+drift from them. `python -m foremast_tpu.deploy deploy/` re-renders the
+checked-in tree; a test asserts it is current.
+
+Manifest parity map (reference -> here):
+  deploy/foremast/00namespace.yaml            -> 00namespace.yaml
+  deploy/foremast/1_crds/*.yaml               -> 1_crds/*.yaml (same group/
+      kind/plural so reference CRs apply unchanged)
+  deploy/foremast/2_barrelman/*               -> 2_watch/* (watch-plane RBAC,
+      controller Deployment, default DeploymentMetadata, recording rules)
+  deploy/foremast/3_brain/{es,foremast-service,foremast-brain}.yaml
+      -> 3_engine/{es,foremast-service,foremast-engine}.yaml; the engine
+      carries the full brain env matrix (`foremast-brain.yaml:21-81`) plus
+      the gauge ServiceMonitor on :8000 (`foremast-brain.yaml:87-122`)
+  deploy/prometheus-operator/0additional-scrape-configs.yaml
+      -> prometheus/additional-scrape-configs.yaml (pod-annotation scrape)
+  deploy/minikube.sh, deploy/export/*.sh      -> same names
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from foremast_tpu.config import BrainConfig, MetricTypeRule, _DEFAULT_RULES
+from foremast_tpu.metrics.rules import prometheus_rule_manifest
+from foremast_tpu.watch.crds import API_VERSION, GROUP, VERSION
+
+NAMESPACE = "foremast"
+IMAGE = "foremast/foremast-tpu:0.1.0"
+
+# ---------------------------------------------------------------------------
+# CRDs — openAPIV3 schemas derived from the watch/crds.py dataclasses.
+# ---------------------------------------------------------------------------
+
+_STR = {"type": "string"}
+_BOOL = {"type": "boolean"}
+_INT = {"type": "integer"}
+_OBJ = {"type": "object"}
+_STR_MAP = {"type": "object", "additionalProperties": _STR}
+
+
+def _crd(kind: str, plural: str, spec_schema: dict, status_schema: dict | None) -> dict:
+    props = {"spec": spec_schema}
+    if status_schema is not None:
+        props["status"] = status_schema
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": kind,
+                "listKind": f"{kind}List",
+                "plural": plural,
+                "singular": kind.lower(),
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": VERSION,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}} if status_schema else {},
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": props,
+                        }
+                    },
+                }
+            ],
+        },
+    }
+
+
+def deployment_metadata_crd() -> dict:
+    """DeploymentMetadata: per-app config CR (types.go:14-156 parity)."""
+    spec = {
+        "type": "object",
+        "properties": {
+            "analyst": {
+                "type": "object",
+                "properties": {"endpoint": _STR},
+            },
+            "metrics": {
+                "type": "object",
+                "properties": {
+                    "source": _STR,
+                    "endpoint": _STR,
+                    "monitoring": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "properties": {
+                                "metricName": _STR,
+                                "metricType": _STR,
+                                "metricAlias": _STR,
+                            },
+                            "required": ["metricName"],
+                        },
+                    },
+                },
+            },
+            "logs": _OBJ,
+            "descriptor": _OBJ,
+        },
+    }
+    return _crd("DeploymentMetadata", "deploymentmetadatas", spec, None)
+
+
+def deployment_monitor_crd() -> dict:
+    """DeploymentMonitor: per-deployment runtime CR (types.go:175-295)."""
+    spec = {
+        "type": "object",
+        "properties": {
+            "selector": _STR_MAP,
+            "analyst": {"type": "object", "properties": {"endpoint": _STR}},
+            "startTime": _STR,
+            "waitUntil": _STR,
+            "metrics": _OBJ,
+            "continuous": _BOOL,
+            "remediation": {
+                "type": "object",
+                "properties": {
+                    "option": {
+                        "type": "string",
+                        "enum": ["None", "AutoRollback", "AutoPause", "Auto"],
+                    },
+                    "parameters": _OBJ,
+                },
+            },
+            "rollbackRevision": _INT,
+        },
+    }
+    status = {
+        "type": "object",
+        "properties": {
+            "jobId": _STR,
+            "phase": {
+                "type": "string",
+                "enum": [
+                    "",
+                    "Healthy",
+                    "Running",
+                    "Failed",
+                    "Unhealthy",
+                    "Warning",
+                    "Expired",
+                    "Abort",
+                ],
+            },
+            "remediationTaken": _BOOL,
+            "anomaly": _OBJ,
+            "timestamp": _STR,
+            "expired": _BOOL,
+        },
+    }
+    return _crd("DeploymentMonitor", "deploymentmonitors", spec, status)
+
+
+# ---------------------------------------------------------------------------
+# Namespace / RBAC / watch plane
+# ---------------------------------------------------------------------------
+
+
+def namespace() -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {"name": NAMESPACE},
+    }
+
+
+def watch_rbac() -> list[dict]:
+    """ClusterRole covering what the watch plane touches: Deployments
+    (watch/diff/rollback/pause), ReplicaSets+Pods (pod discovery), Events,
+    and both CRDs (reference RBAC: foremast-barrelman-rbac.yaml)."""
+    name = "foremast-watch"
+    return [
+        {
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": {"name": name, "namespace": NAMESPACE},
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": {"name": name},
+            "rules": [
+                {
+                    "apiGroups": ["apps", "extensions"],
+                    "resources": [
+                        "deployments",
+                        "deployments/rollback",
+                        "replicasets",
+                    ],
+                    "verbs": ["get", "list", "watch", "update", "patch", "create"],
+                },
+                {
+                    "apiGroups": [""],
+                    "resources": ["pods", "namespaces"],
+                    "verbs": ["get", "list", "watch"],
+                },
+                {
+                    "apiGroups": [""],
+                    "resources": ["events"],
+                    "verbs": ["create", "patch"],
+                },
+                {
+                    "apiGroups": [GROUP],
+                    "resources": ["deploymentmetadatas", "deploymentmonitors"],
+                    "verbs": [
+                        "get",
+                        "list",
+                        "watch",
+                        "create",
+                        "update",
+                        "patch",
+                        "delete",
+                    ],
+                },
+                {
+                    "apiGroups": [GROUP],
+                    "resources": ["deploymentmonitors/status"],
+                    "verbs": ["get", "update", "patch"],
+                },
+            ],
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": name},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": name,
+            },
+            "subjects": [
+                {
+                    "kind": "ServiceAccount",
+                    "name": name,
+                    "namespace": NAMESPACE,
+                }
+            ],
+        },
+    ]
+
+
+def _container(name: str, args: list[str], env: list[dict], ports: list[dict],
+               cpu: str = "100m", memory: str = "128Mi") -> dict:
+    return {
+        "name": name,
+        "image": IMAGE,
+        "imagePullPolicy": "IfNotPresent",
+        "command": ["foremast"],
+        "args": args,
+        "env": env,
+        "ports": ports,
+        "resources": {
+            "requests": {"cpu": cpu, "memory": memory},
+            "limits": {"cpu": cpu, "memory": memory},
+        },
+    }
+
+
+def _deployment(name: str, container: dict, sa: str | None = None,
+                replicas: int = 1) -> dict:
+    spec: dict = {
+        "replicas": replicas,
+        "selector": {"matchLabels": {"app": name}},
+        "template": {
+            "metadata": {
+                "labels": {"app": name},
+                "annotations": {"prometheus.io/scrape": "true"},
+            },
+            "spec": {"containers": [container]},
+        },
+    }
+    if sa:
+        spec["template"]["spec"]["serviceAccountName"] = sa
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": name,
+            "namespace": NAMESPACE,
+            "labels": {"app": name},
+        },
+        "spec": spec,
+    }
+
+
+def watch_deployment() -> dict:
+    """The watch-plane controller (`foremast watch-plane`): informer-style
+    Deployment watcher + status poller + remediation (reference:
+    foremast-barrelman.yaml, 100m/30Mi budget)."""
+    env = [
+        {
+            "name": "NAMESPACE",
+            "valueFrom": {"fieldRef": {"fieldPath": "metadata.namespace"}},
+        },
+        {
+            "name": "ANALYST_ENDPOINT",
+            "value": f"http://foremast-service.{NAMESPACE}.svc:8099/v1/healthcheck/",
+        },
+        {
+            "name": "METRICS_ENDPOINT",
+            "value": "http://prometheus-k8s.monitoring.svc:9090/",
+        },
+    ]
+    c = _container("foremast-watch", ["watch-plane"], env, [], cpu="100m", memory="64Mi")
+    return _deployment("foremast-watch", c, sa="foremast-watch")
+
+
+def default_metadata_cr() -> dict:
+    """Cluster default DeploymentMetadata (`deployment-metadata-default.yaml`
+    role): the appType fallback record the watcher resolves when an app has
+    no metadata of its own (Barrelman.go:139-174 semantics)."""
+    monitored = [
+        {
+            "metricName": "namespace_app_per_pod:http_server_requests_latency",
+            "metricType": "latency",
+            "metricAlias": "latency",
+        },
+        {
+            "metricName": "namespace_app_per_pod:http_server_requests_error_5xx",
+            "metricType": "error5xx",
+            "metricAlias": "error5xx",
+        },
+        {
+            "metricName": "namespace_app_per_pod:http_server_requests_error_4xx",
+            "metricType": "error4xx",
+            "metricAlias": "error4xx",
+        },
+        {
+            "metricName": "namespace_app_per_pod:http_server_requests_count",
+            "metricType": "tps",
+            "metricAlias": "tps",
+        },
+    ]
+    return {
+        "apiVersion": API_VERSION,
+        "kind": "DeploymentMetadata",
+        "metadata": {"name": "default", "namespace": NAMESPACE},
+        "spec": {
+            "analyst": {
+                "endpoint": f"http://foremast-service.{NAMESPACE}.svc:8099/v1/healthcheck/"
+            },
+            "metrics": {
+                "source": "prometheus",
+                "endpoint": "http://prometheus-k8s.monitoring.svc:9090/",
+                "monitoring": monitored,
+            },
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Engine plane: ES, REST service, TPU scoring engine
+# ---------------------------------------------------------------------------
+
+
+def elasticsearch() -> list[dict]:
+    """Single-node ES for the durable job store (reference es.yaml role)."""
+    name = "elasticsearch"
+    return [
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": name, "namespace": NAMESPACE},
+            "spec": {
+                "selector": {"app": name},
+                "ports": [{"name": "http", "port": 9200, "targetPort": 9200}],
+            },
+        },
+        {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {"name": name, "namespace": NAMESPACE},
+            "spec": {
+                "serviceName": name,
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": {"labels": {"app": name}},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": name,
+                                "image": "docker.elastic.co/elasticsearch/elasticsearch-oss:6.8.23",
+                                "env": [
+                                    {"name": "discovery.type", "value": "single-node"},
+                                    {"name": "ES_JAVA_OPTS", "value": "-Xms512m -Xmx512m"},
+                                ],
+                                "ports": [{"containerPort": 9200}],
+                                "resources": {
+                                    "requests": {"cpu": "500m", "memory": "1Gi"},
+                                    "limits": {"cpu": "1", "memory": "1536Mi"},
+                                },
+                                "volumeMounts": [
+                                    {"name": "data", "mountPath": "/usr/share/elasticsearch/data"}
+                                ],
+                            }
+                        ],
+                        "volumes": [{"name": "data", "emptyDir": {}}],
+                    },
+                },
+            },
+        },
+    ]
+
+
+def service_deployment() -> list[dict]:
+    """REST job gateway on :8099 (`foremast serve`; reference
+    foremast-service.yaml, routes main.go:262-276)."""
+    env = [
+        {"name": "ELASTIC_URL", "value": f"http://elasticsearch.{NAMESPACE}.svc:9200"},
+        {
+            "name": "QUERY_SERVICE_ENDPOINT",
+            "value": "http://prometheus-k8s.monitoring.svc:9090/",
+        },
+    ]
+    c = _container(
+        "foremast-service",
+        ["serve", "--port", "8099"],
+        env,
+        [{"containerPort": 8099, "name": "http"}],
+        cpu="100m",
+        memory="64Mi",
+    )
+    return [
+        _deployment("foremast-service", c),
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": "foremast-service", "namespace": NAMESPACE},
+            "spec": {
+                "selector": {"app": "foremast-service"},
+                "ports": [{"name": "http", "port": 8099, "targetPort": 8099}],
+            },
+        },
+    ]
+
+
+def _rule_env(rules: tuple[MetricTypeRule, ...]) -> list[dict]:
+    """The reference's indexed env-var family for the per-metric-type
+    threshold matrix (`foremast-brain.yaml:32-73`)."""
+    out: list[dict] = [
+        {"name": "metric_type_threshold_count", "value": str(len(rules))}
+    ]
+    for i, r in enumerate(rules):
+        out += [
+            {"name": f"metric_type{i}", "value": r.metric_type},
+            {"name": f"threshold{i}", "value": _num(r.threshold)},
+            {"name": f"bound{i}", "value": str(r.bound)},
+            {"name": f"min_lower_bound{i}", "value": _num(r.min_lower_bound)},
+        ]
+    return out
+
+
+def _num(x: float) -> str:
+    return str(int(x)) if float(x) == int(x) else str(x)
+
+
+def engine_deployment(cfg: BrainConfig | None = None) -> list[dict]:
+    """The TPU scoring engine (`foremast worker`) — reference
+    foremast-brain.yaml role, but one jitted batch program per TPU host
+    instead of N CPU slivers. Env matrix mirrors BrainConfig.from_env.
+    Publishes foremastbrain:* gauges on :8000, scraped by a ServiceMonitor
+    (foremast-brain.yaml:87-122)."""
+    cfg = cfg or BrainConfig()
+    name = "foremast-engine"
+    env = [
+        {"name": "ES_ENDPOINT", "value": f"http://elasticsearch.{NAMESPACE}.svc:9200"},
+        {"name": "ML_ALGORITHM", "value": cfg.algorithm},
+        {"name": "threshold", "value": _num(cfg.anomaly.threshold)},
+        {"name": "min_lower_bound", "value": _num(cfg.anomaly.min_lower_bound)},
+        {"name": "bound", "value": str(cfg.anomaly.bound)},
+        *_rule_env(_DEFAULT_RULES),
+        {"name": "MIN_MANN_WHITE_DATA_POINTS", "value": str(cfg.pairwise.min_mann_white_points)},
+        {"name": "MIN_WILCOXON_DATA_POINTS", "value": str(cfg.pairwise.min_wilcoxon_points)},
+        {"name": "MIN_KRUSKAL_DATA_POINTS", "value": str(cfg.pairwise.min_kruskal_points)},
+        {"name": "ML_PAIRWISE_ALGORITHM", "value": cfg.pairwise.algorithm},
+        {"name": "MAX_STUCK_IN_SECONDS", "value": _num(cfg.max_stuck_seconds)},
+        {"name": "MAX_CACHE_SIZE", "value": str(cfg.max_cache_size)},
+    ]
+    c = _container(
+        name,
+        ["worker", "--gauge-port", "8000"],
+        env,
+        [{"containerPort": 8000, "name": "gauges"}],
+        cpu="4",
+        memory="8Gi",
+    )
+    # TPU scheduling: one worker per TPU host; the engine shards its batch
+    # over the host's chips via jax.sharding (parallel/mesh.py).
+    c["resources"]["limits"]["google.com/tpu"] = 8
+    c["resources"]["requests"]["google.com/tpu"] = 8
+    dep = _deployment(name, c)
+    dep["spec"]["template"]["spec"]["nodeSelector"] = {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4",
+    }
+    return [
+        dep,
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": name,
+                "namespace": NAMESPACE,
+                "labels": {"app": name},
+            },
+            "spec": {
+                "selector": {"app": name},
+                "ports": [{"name": "gauges", "port": 8000, "targetPort": 8000}],
+            },
+        },
+        {
+            "apiVersion": "monitoring.coreos.com/v1",
+            "kind": "ServiceMonitor",
+            "metadata": {
+                "name": name,
+                "namespace": NAMESPACE,
+                "labels": {"app": name},
+            },
+            "spec": {
+                "selector": {"matchLabels": {"app": name}},
+                "endpoints": [{"port": "gauges", "interval": "15s"}],
+                "namespaceSelector": {"matchNames": [NAMESPACE]},
+            },
+        },
+    ]
+
+
+def ui_deployment() -> list[dict]:
+    """The dashboard (`foremast ui`) — reference foremast-browser role."""
+    env = [
+        {
+            "name": "FOREMAST_SERVICE_ENDPOINT",
+            "value": f"http://foremast-service.{NAMESPACE}.svc:8099",
+        }
+    ]
+    c = _container(
+        "foremast-ui",
+        ["ui", "--port", "8080"],
+        env,
+        [{"containerPort": 8080, "name": "http"}],
+        cpu="100m",
+        memory="64Mi",
+    )
+    return [
+        _deployment("foremast-ui", c),
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": "foremast-ui", "namespace": NAMESPACE},
+            "spec": {
+                "selector": {"app": "foremast-ui"},
+                "ports": [{"name": "http", "port": 8080, "targetPort": 8080}],
+            },
+        },
+    ]
+
+
+def scrape_config_secret() -> dict:
+    """Pod-annotation scrape job for Prometheus (role of the reference's
+    base64 `0additional-scrape-configs.yaml`): scrape any pod annotated
+    prometheus.io/scrape=true, relabeling namespace/pod."""
+    job = """\
+- job_name: kubernetes-pods-scrape
+  kubernetes_sd_configs:
+    - role: pod
+  relabel_configs:
+    - source_labels: [__meta_kubernetes_pod_annotation_prometheus_io_scrape]
+      action: keep
+      regex: "true"
+    - source_labels: [__meta_kubernetes_pod_annotation_prometheus_io_path]
+      action: replace
+      target_label: __metrics_path__
+      regex: (.+)
+    - source_labels: [__address__, __meta_kubernetes_pod_annotation_prometheus_io_port]
+      action: replace
+      regex: ([^:]+)(?::\\d+)?;(\\d+)
+      replacement: $1:$2
+      target_label: __address__
+    - source_labels: [__meta_kubernetes_namespace]
+      action: replace
+      target_label: namespace
+    - source_labels: [__meta_kubernetes_pod_name]
+      action: replace
+      target_label: pod
+    - source_labels: [__meta_kubernetes_pod_label_app]
+      action: replace
+      target_label: app
+"""
+    return {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": {
+            "name": "additional-scrape-configs",
+            "namespace": "monitoring",
+        },
+        "stringData": {"prometheus-additional.yaml": job},
+    }
+
+
+# ---------------------------------------------------------------------------
+# Shell helpers
+# ---------------------------------------------------------------------------
+
+MINIKUBE_SH = """\
+#!/bin/sh
+# Local demo cluster (reference deploy/minikube.sh footprint: 4 CPU / 6 GB).
+minikube start --cpus 4 --memory 6144
+minikube addons enable ingress
+"""
+
+EXPORT_SERVICE_SH = """\
+#!/bin/sh
+# Port-forward the job gateway to localhost:8099.
+kubectl -n foremast port-forward svc/foremast-service 8099:8099
+"""
+
+EXPORT_PROMETHEUS_SH = """\
+#!/bin/sh
+# Port-forward Prometheus to localhost:9090.
+kubectl -n monitoring port-forward svc/prometheus-k8s 9090:9090
+"""
+
+EXPORT_UI_SH = """\
+#!/bin/sh
+# Port-forward the dashboard to localhost:8080.
+kubectl -n foremast port-forward svc/foremast-ui 8080:8080
+"""
+
+README = """\
+# Deploying foremast-tpu on Kubernetes
+
+Generated tree - do not edit by hand; run `python -m foremast_tpu.deploy deploy/`
+after changing `foremast_tpu/deploy/manifests.py`.
+
+Install order (numbered dirs, like the reference's deploy/foremast):
+
+    kubectl apply -f foremast/00namespace.yaml
+    kubectl apply -f foremast/1_crds/
+    kubectl apply -f foremast/2_watch/
+    kubectl apply -f foremast/3_engine/
+
+Prerequisites: a Prometheus (e.g. prometheus-operator / kube-prometheus) in
+namespace `monitoring`; add `prometheus/additional-scrape-configs.yaml` as an
+additionalScrapeConfigs secret so pod-annotation scraping works, and apply
+`foremast/2_watch/metrics-rules.yaml` (the generated recording rules) to the
+Prometheus rule selector.
+
+The engine Deployment requests a TPU host (GKE v5e 2x4 node selector); edit
+`engine_deployment()` for other topologies, or drop the TPU request to score
+on CPU. `minikube.sh` bootstraps a local demo cluster; `export/*.sh`
+port-forward the service (:8099), Prometheus (:9090), and the UI (:8080).
+"""
+
+
+# ---------------------------------------------------------------------------
+# Tree assembly
+# ---------------------------------------------------------------------------
+
+
+def tree(cfg: BrainConfig | None = None) -> dict[str, object]:
+    """path -> manifest list (YAML docs) or literal string content."""
+    rules = prometheus_rule_manifest(namespace=NAMESPACE)
+    return {
+        "README.md": README,
+        "minikube.sh": MINIKUBE_SH,
+        "export/export-service.sh": EXPORT_SERVICE_SH,
+        "export/export-prometheus.sh": EXPORT_PROMETHEUS_SH,
+        "export/export-ui.sh": EXPORT_UI_SH,
+        "prometheus/additional-scrape-configs.yaml": [scrape_config_secret()],
+        "foremast/00namespace.yaml": [namespace()],
+        "foremast/1_crds/deploymentmetadata.yaml": [deployment_metadata_crd()],
+        "foremast/1_crds/deploymentmonitor.yaml": [deployment_monitor_crd()],
+        "foremast/2_watch/foremast-watch-rbac.yaml": watch_rbac(),
+        "foremast/2_watch/foremast-watch.yaml": [watch_deployment()],
+        "foremast/2_watch/deployment-metadata-default.yaml": [default_metadata_cr()],
+        "foremast/2_watch/metrics-rules.yaml": [rules],
+        "foremast/3_engine/es.yaml": elasticsearch(),
+        "foremast/3_engine/foremast-service.yaml": service_deployment(),
+        "foremast/3_engine/foremast-engine.yaml": engine_deployment(cfg),
+        "foremast/3_engine/foremast-ui.yaml": ui_deployment(),
+    }
+
+
+def render_file(content: object) -> str:
+    import json
+
+    import yaml
+
+    if isinstance(content, str):
+        return content
+    # JSON round-trip breaks object identity between shared schema fragments
+    # so the YAML emitter never writes anchors/aliases.
+    return yaml.safe_dump_all(
+        json.loads(json.dumps(content)), sort_keys=False, default_flow_style=False
+    )
+
+
+def render(root: str) -> list[str]:
+    """Write the tree under `root`; returns the paths written."""
+    import os
+
+    written = []
+    for rel, content in tree().items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(render_file(content))
+        if path.endswith(".sh"):
+            os.chmod(path, 0o755)
+        written.append(path)
+    return written

@@ -236,7 +236,10 @@ class InMemoryKube:
         """Merge-patch a monitor (what `kubectl patch --type=merge` does):
         only the patched fields change, concurrent writers are preserved."""
         old = self.get_monitor(namespace, name)
-        obj = old.to_json()
+        # to_json() returns the monitor's live dicts by reference; deepcopy
+        # before merging so handlers see the true pre-patch object (same
+        # reason patch_deployment deepcopies).
+        obj = copy.deepcopy(old.to_json())
         _deep_merge(obj, patch)
         merged = DeploymentMonitor.from_json(obj)
         self.monitors[(namespace, name)] = merged

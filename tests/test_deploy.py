@@ -1,0 +1,78 @@
+"""Deploy-manifest generator tests: checked-in tree freshness + shape."""
+
+import os
+
+import yaml
+
+from foremast_tpu.config import _DEFAULT_RULES
+from foremast_tpu.deploy import render_file, tree
+from foremast_tpu.watch.crds import GROUP
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_checked_in_tree_is_current():
+    """deploy/ must match the generator (re-run `python -m
+    foremast_tpu.deploy deploy/` after editing manifests.py)."""
+    for rel, content in tree().items():
+        path = os.path.join(REPO, "deploy", rel)
+        assert os.path.exists(path), f"missing {rel}"
+        with open(path) as f:
+            assert f.read() == render_file(content), f"stale {rel}"
+
+
+def test_crds_match_runtime_types():
+    t = tree()
+    for rel, plural, kind in [
+        ("foremast/1_crds/deploymentmetadata.yaml", "deploymentmetadatas", "DeploymentMetadata"),
+        ("foremast/1_crds/deploymentmonitor.yaml", "deploymentmonitors", "DeploymentMonitor"),
+    ]:
+        (crd,) = t[rel]
+        assert crd["metadata"]["name"] == f"{plural}.{GROUP}"
+        assert crd["spec"]["names"]["kind"] == kind
+        assert crd["spec"]["versions"][0]["name"] == "v1alpha1"
+
+
+def test_monitor_crd_enums():
+    (crd,) = tree()["foremast/1_crds/deploymentmonitor.yaml"]
+    props = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]["properties"]
+    phases = props["status"]["properties"]["phase"]["enum"]
+    assert {"Healthy", "Running", "Unhealthy", "Expired", "Abort"} <= set(phases)
+    opts = props["spec"]["properties"]["remediation"]["properties"]["option"]["enum"]
+    assert opts == ["None", "AutoRollback", "AutoPause", "Auto"]
+
+
+def test_engine_env_matrix_roundtrips_through_brainconfig():
+    """The engine Deployment's env block must reproduce BrainConfig when
+    parsed by BrainConfig.from_env — the no-drift guarantee."""
+    from foremast_tpu.config import BrainConfig
+
+    docs = tree()["foremast/3_engine/foremast-engine.yaml"]
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    env = {
+        e["name"]: e["value"]
+        for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]
+        if "value" in e
+    }
+    cfg = BrainConfig.from_env(env)
+    assert cfg.algorithm == "moving_average_all"
+    assert cfg.anomaly.rules == _DEFAULT_RULES
+    assert cfg.pairwise.min_mann_white_points == 20
+    assert cfg.max_stuck_seconds == 90.0
+
+
+def test_rendered_yaml_parses_and_has_no_aliases():
+    for rel, content in tree().items():
+        text = render_file(content)
+        if rel.endswith((".yaml", ".yml")):
+            docs = list(yaml.safe_load_all(text))
+            assert docs, rel
+            assert "&id" not in text, f"yaml anchors leaked into {rel}"
+
+
+def test_rbac_covers_rollback_and_crds():
+    docs = tree()["foremast/2_watch/foremast-watch-rbac.yaml"]
+    role = next(d for d in docs if d["kind"] == "ClusterRole")
+    resources = {r for rule in role["rules"] for r in rule["resources"]}
+    assert {"deployments", "deployments/rollback", "replicasets", "pods",
+            "deploymentmonitors", "deploymentmetadatas"} <= resources
