@@ -143,9 +143,18 @@ def cmd_score(args: argparse.Namespace) -> int:
 
 def _make_store(elastic_url: str | None):
     """ES-backed store with the reference's connect-retry loop
-    (service main.go:248-260), or in-memory when no URL is given."""
+    (service main.go:248-260), or in-memory when no URL is given.
+
+    Falls back to the reference's env vars (`ELASTIC_URL` for the service,
+    `ES_ENDPOINT` for the engine, main.go:236-243 / foremast-brain.yaml:22)
+    so the deployed containers need no flags."""
+    import os
+
     from foremast_tpu.jobs.store import ElasticsearchStore, InMemoryStore
 
+    elastic_url = (
+        elastic_url or os.environ.get("ELASTIC_URL") or os.environ.get("ES_ENDPOINT")
+    )
     if not elastic_url:
         return InMemoryStore()
     store = ElasticsearchStore(elastic_url)
@@ -218,6 +227,34 @@ def cmd_unwatch(args: argparse.Namespace) -> int:
     return _toggle_continuous(args, False)
 
 
+def cmd_watch_plane(args: argparse.Namespace) -> int:
+    """Run the deployed watch-plane controller (barrelman equivalent)."""
+    import os
+
+    from foremast_tpu.watch.kubeapi import HttpKube
+    from foremast_tpu.watch.plane import WatchPlane
+
+    kube = HttpKube(base_url=args.api_server)
+    plane = WatchPlane(
+        kube, own_namespace=args.namespace or os.environ.get("NAMESPACE", "foremast")
+    )
+    plane.run()
+    return 0
+
+
+def cmd_ui(args: argparse.Namespace) -> int:
+    from foremast_tpu.ui.app import serve as serve_ui
+
+    serve_ui(
+        host=args.host,
+        port=args.port,
+        service_endpoint=args.service_endpoint,
+        namespace=args.namespace,
+        app_name=args.app,
+    )
+    return 0
+
+
 def cmd_rules(args: argparse.Namespace) -> int:
     from foremast_tpu.metrics.rules import prometheus_rule_manifest, to_yaml
 
@@ -275,6 +312,32 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument(
             "--api-server", default=None, help="API server URL (default in-cluster)"
         )
+
+    p = sub.add_parser(
+        "watch-plane",
+        help="K8s controller loop: deployment watcher + status poller + remediation",
+    )
+    p.set_defaults(fn=cmd_watch_plane)
+    p.add_argument(
+        "--api-server", default=None, help="API server URL (default in-cluster)"
+    )
+    p.add_argument(
+        "--namespace",
+        default=None,
+        help="controller's own namespace (NAMESPACE downward-API parity)",
+    )
+
+    p = sub.add_parser("ui", help="dashboard on :8080 (foremast-browser parity)")
+    p.set_defaults(fn=cmd_ui)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument(
+        "--service-endpoint",
+        default=None,
+        help="job-gateway base URL (FOREMAST_SERVICE_ENDPOINT)",
+    )
+    p.add_argument("--namespace", default=None, help="charted namespace label")
+    p.add_argument("--app", default=None, help="charted app label")
 
     p = sub.add_parser("rules", help="print recording-rules manifest YAML")
     p.set_defaults(fn=cmd_rules)

@@ -26,8 +26,6 @@ Manifest parity map (reference -> here):
 
 from __future__ import annotations
 
-import dataclasses
-
 from foremast_tpu.config import BrainConfig, MetricTypeRule, _DEFAULT_RULES
 from foremast_tpu.metrics.rules import prometheus_rule_manifest
 from foremast_tpu.watch.crds import API_VERSION, GROUP, VERSION
@@ -543,10 +541,13 @@ def engine_deployment(cfg: BrainConfig | None = None) -> list[dict]:
 
 def ui_deployment() -> list[dict]:
     """The dashboard (`foremast ui`) — reference foremast-browser role."""
+    # NOTE: the endpoint is fetched by the *viewer's browser*, not the UI
+    # pod, so it must be browser-reachable. Default matches the
+    # export-service.sh port-forward; point it at your ingress in prod.
     env = [
         {
             "name": "FOREMAST_SERVICE_ENDPOINT",
-            "value": f"http://foremast-service.{NAMESPACE}.svc:8099",
+            "value": "http://localhost:8099",
         }
     ]
     c = _container(

@@ -73,11 +73,24 @@ class BrainGauges:
             an.labels(**labels).set(anomaly_value)
 
 
-def make_verdict_hook(gauges: BrainGauges, namespace: str = "default"):
+def make_verdict_hook(gauges: BrainGauges, namespace: str | None = None):
     """BrainWorker.on_verdict adapter: publish the latest band edge and
-    anomalous value per metric after each judgment."""
+    anomalous value per metric after each judgment.
+
+    The `exported_namespace` label is derived per-document from the job's
+    PromQL selector (`namespace="..."` inside currentConfig) so the gauge
+    lands next to the base series it models; the static `namespace`
+    argument (default: NAMESPACE env, then "default") is only the
+    fallback for jobs whose queries carry no namespace selector."""
+    import os
+    import urllib.parse
+
+    default_ns = namespace or os.environ.get("NAMESPACE", "default")
+    ns_re = re.compile(r'namespace="([^"]+)"')
 
     def hook(doc, verdicts):
+        m = ns_re.search(urllib.parse.unquote(doc.current_config or ""))
+        namespace = m.group(1) if m else default_ns
         for v in verdicts:
             if len(v.upper) == 0:
                 continue

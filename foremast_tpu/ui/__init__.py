@@ -1,0 +1,5 @@
+"""Dashboard plane (the foremast-browser equivalent)."""
+
+from foremast_tpu.ui.metrics import DEFAULT_PANELS, Panel, dashboard_config
+
+__all__ = ["DEFAULT_PANELS", "Panel", "dashboard_config"]

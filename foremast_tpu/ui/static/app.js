@@ -1,0 +1,350 @@
+/* Foremast dashboard.
+ *
+ * Role parity with the reference UI (foremast-browser/src/App.js): poll the
+ * service's query proxy every 15 s for each panel's four series
+ * (base / upper / lower / anomaly), join anomaly timestamps onto the base
+ * series so anomalies plot as dots on the measured curve (App.js:231-260),
+ * render time-series panels with a crosshair synchronized across all panels
+ * (App.js:44-78) plus a scatter chart. No chart library: plain SVG.
+ */
+"use strict";
+
+const CFG = window.FOREMAST_CONFIG;
+const PAD = { l: 44, r: 10, t: 8, b: 18 };
+const panels = []; // {cfg, el, data, svg}
+let tableMode = false;
+
+/* ---------------- data ---------------- */
+
+async function queryRange(query, start, end, step) {
+  const u = new URL(CFG.serviceEndpoint + "/api/v1/query_range");
+  u.searchParams.set("query", query);
+  u.searchParams.set("start", start);
+  u.searchParams.set("end", end);
+  u.searchParams.set("step", step);
+  const r = await fetch(u);
+  if (!r.ok) throw new Error(`query_range ${r.status}`);
+  const body = await r.json();
+  const res = body?.data?.result;
+  if (!res || !res.length) return [];
+  // [[unix_ts, "value"], ...] -> [{t, v}]
+  return res[0].values.map(([t, v]) => ({ t: +t, v: +v }));
+}
+
+async function fetchPanel(p) {
+  const end = Math.floor(Date.now() / 1000);
+  const start = end - CFG.windowSeconds;
+  const byType = {};
+  await Promise.all(
+    p.cfg.series.map(async (s) => {
+      try {
+        byType[s.type] = await queryRange(s.query, start, end, CFG.stepSeconds);
+      } catch (e) {
+        byType[s.type] = [];
+      }
+    })
+  );
+  const scale = p.cfg.scale || 1;
+  for (const k of Object.keys(byType))
+    byType[k] = byType[k].map(({ t, v }) => ({ t, v: v * scale }));
+  // join anomalies onto the base curve: an anomaly dot is drawn at the
+  // *measured* value for that timestamp (reference App.js:231-260). The
+  // engine's anomaly gauge is sticky ("last anomalous value", never
+  // cleared), so the raw series repeats the value at every scrape after an
+  // anomaly — an anomaly *event* is where the series starts or its value
+  // changes, not every sample.
+  const baseByT = new Map(byType.base?.map((d) => [d.t, d.v]));
+  const events = [];
+  let prev = undefined;
+  for (const d of byType.anomaly || []) {
+    // a series that already exists at the window's left edge is an old
+    // sticky value, not an event inside this window
+    const atLeftEdge = prev === undefined && d.t <= start + CFG.stepSeconds;
+    if ((prev === undefined && !atLeftEdge) || (prev !== undefined && d.v !== prev))
+      events.push(d);
+    prev = d.v;
+  }
+  byType.anomalyJoined = events
+    .filter((d) => baseByT.has(d.t))
+    .map((d) => ({ t: d.t, v: baseByT.get(d.t) }));
+  p.data = byType;
+}
+
+/* ---------------- scales / svg helpers ---------------- */
+
+function svgEl(tag, attrs) {
+  const el = document.createElementNS("http://www.w3.org/2000/svg", tag);
+  for (const [k, v] of Object.entries(attrs || {})) el.setAttribute(k, v);
+  return el;
+}
+
+function extent(seriesList, pick) {
+  let lo = Infinity, hi = -Infinity;
+  for (const s of seriesList)
+    for (const d of s) {
+      const x = pick(d);
+      if (Number.isFinite(x)) { if (x < lo) lo = x; if (x > hi) hi = x; }
+    }
+  return lo <= hi ? [lo, hi] : null;
+}
+
+function niceTicks(lo, hi, n) {
+  const span = hi - lo || 1;
+  const step = Math.pow(10, Math.floor(Math.log10(span / n)));
+  const err = span / n / step;
+  const mult = err >= 7.5 ? 10 : err >= 3.5 ? 5 : err >= 1.5 ? 2 : 1;
+  const s = step * mult;
+  const ticks = [];
+  for (let v = Math.ceil(lo / s) * s; v <= hi + 1e-9; v += s) ticks.push(v);
+  return ticks;
+}
+
+const fmtV = (v) =>
+  Math.abs(v) >= 1e6 ? (v / 1e6).toFixed(1) + "M"
+  : Math.abs(v) >= 1e3 ? (v / 1e3).toFixed(1) + "k"
+  : Math.abs(v) >= 100 ? v.toFixed(0)
+  : Math.abs(v) >= 1 ? v.toFixed(1) : v.toPrecision(2);
+const fmtT = (t) => {
+  const d = new Date(t * 1000);
+  return `${String(d.getHours()).padStart(2, "0")}:${String(d.getMinutes()).padStart(2, "0")}`;
+};
+
+/* ---------------- panel rendering ---------------- */
+
+function renderPanel(p) {
+  const box = p.el.querySelector(".chartbox");
+  box.innerHTML = "";
+  const d = p.data || {};
+  const base = d.base || [];
+  if (!base.length) {
+    const e = document.createElement("div");
+    e.className = "empty";
+    e.textContent = "no data";
+    box.appendChild(e);
+    return;
+  }
+  if (tableMode) return renderTable(p, box);
+
+  const W = box.clientWidth || 440, H = 180;
+  const svg = svgEl("svg", { viewBox: `0 0 ${W} ${H}` });
+  const all = [base, d.upper || [], d.lower || []];
+  const tExt = extent([base], (x) => x.t);
+  const vExt = extent(all, (x) => x.v);
+  if (!tExt || !vExt) {  // all-NaN series (e.g. PromQL 0/0) — treat as empty
+    const e = document.createElement("div");
+    e.className = "empty";
+    e.textContent = "no data";
+    box.appendChild(e);
+    return;
+  }
+  const [t0, t1] = tExt;
+  let [v0, v1] = vExt;
+  if (v0 === v1) { v0 -= 1; v1 += 1; }
+  const padV = (v1 - v0) * 0.08;
+  v0 -= padV; v1 += padV;
+  const X = (t) => PAD.l + ((t - t0) / (t1 - t0 || 1)) * (W - PAD.l - PAD.r);
+  const Y = (v) => H - PAD.b - ((v - v0) / (v1 - v0)) * (H - PAD.t - PAD.b);
+  p.X = X; p.Y = Y; p.t0 = t0; p.t1 = t1; p.W = W; p.H = H;
+
+  for (const v of niceTicks(v0, v1, 4)) {
+    svg.appendChild(svgEl("line", { class: "gridline", x1: PAD.l, x2: W - PAD.r, y1: Y(v), y2: Y(v) }));
+    const txt = svgEl("text", { x: PAD.l - 6, y: Y(v) + 3, "text-anchor": "end" });
+    txt.textContent = fmtV(v);
+    svg.appendChild(txt);
+  }
+  const nT = Math.max(2, Math.floor(W / 140));
+  for (const t of niceTicks(t0, t1, nT)) {
+    const txt = svgEl("text", { x: X(t), y: H - 4, "text-anchor": "middle" });
+    txt.textContent = fmtT(t);
+    svg.appendChild(txt);
+  }
+  svg.appendChild(svgEl("line", { class: "axisline", x1: PAD.l, x2: W - PAD.r, y1: H - PAD.b, y2: H - PAD.b }));
+
+  // model band: fill between upper and lower where both exist
+  const up = d.upper || [], lo = d.lower || [];
+  if (up.length && lo.length) {
+    const loByT = new Map(lo.map((x) => [x.t, x.v]));
+    const pts = up.filter((x) => loByT.has(x.t));
+    if (pts.length) {
+      const fwd = pts.map((x) => `${X(x.t)},${Y(x.v)}`);
+      const back = pts.slice().reverse().map((x) => `${X(x.t)},${Y(loByT.get(x.t))}`);
+      svg.appendChild(svgEl("polygon", { class: "band-area", points: fwd.concat(back).join(" ") }));
+    }
+    for (const edge of [up, lo])
+      svg.appendChild(svgEl("polyline", { class: "band-edge", points: edge.map((x) => `${X(x.t)},${Y(x.v)}`).join(" ") }));
+  }
+
+  svg.appendChild(svgEl("polyline", { class: "baseline-path", points: base.map((x) => `${X(x.t)},${Y(x.v)}`).join(" ") }));
+
+  for (const a of d.anomalyJoined || [])
+    svg.appendChild(svgEl("circle", { class: "anom", cx: X(a.t), cy: Y(a.v), r: 4.5 }));
+
+  // crosshair layer (populated by the shared hover handler)
+  p.xhair = svgEl("line", { class: "xhair", y1: PAD.t, y2: H - PAD.b, visibility: "hidden" });
+  p.hoverdot = svgEl("circle", { class: "hoverdot", r: 4, visibility: "hidden" });
+  svg.appendChild(p.xhair);
+  svg.appendChild(p.hoverdot);
+
+  svg.addEventListener("mousemove", (ev) => {
+    const rect = svg.getBoundingClientRect();
+    const frac = (ev.clientX - rect.left) / rect.width;
+    const t = t0 + Math.max(0, Math.min(1, (frac * W - PAD.l) / (W - PAD.l - PAD.r))) * (t1 - t0);
+    syncCrosshair(t, ev);
+  });
+  svg.addEventListener("mouseleave", () => syncCrosshair(null));
+  p.svg = svg;
+  box.appendChild(svg);
+}
+
+function renderTable(p, box) {
+  const d = p.data;
+  const wrap = document.createElement("div");
+  wrap.className = "tablebox";
+  const anomT = new Set((d.anomalyJoined || []).map((a) => a.t));
+  const upByT = new Map((d.upper || []).map((x) => [x.t, x.v]));
+  const loByT = new Map((d.lower || []).map((x) => [x.t, x.v]));
+  const rows = d.base
+    .map((x) =>
+      `<tr><td>${new Date(x.t * 1000).toLocaleTimeString()}</td>` +
+      `<td>${fmtV(x.v)}</td>` +
+      `<td>${upByT.has(x.t) ? fmtV(upByT.get(x.t)) : ""}</td>` +
+      `<td>${loByT.has(x.t) ? fmtV(loByT.get(x.t)) : ""}</td>` +
+      `<td>${anomT.has(x.t) ? "⚠ anomaly" : ""}</td></tr>`
+    )
+    .join("");
+  wrap.innerHTML = `<table class="data"><thead><tr><th>time</th><th>value</th><th>upper</th><th>lower</th><th>state</th></tr></thead><tbody>${rows}</tbody></table>`;
+  box.appendChild(wrap);
+}
+
+/* ---------------- synchronized crosshair + tooltip ---------------- */
+
+const tooltip = document.createElement("div");
+tooltip.className = "tooltip";
+document.body.appendChild(tooltip);
+
+function nearest(series, t) {
+  let best = null, bd = Infinity;
+  for (const d of series) {
+    const dd = Math.abs(d.t - t);
+    if (dd < bd) { bd = dd; best = d; }
+  }
+  return best;
+}
+
+function syncCrosshair(t, ev) {
+  let tipRows = [];
+  for (const p of panels) {
+    if (!p.svg || !p.X) continue;
+    if (t == null) {
+      p.xhair.setAttribute("visibility", "hidden");
+      p.hoverdot.setAttribute("visibility", "hidden");
+      continue;
+    }
+    const pt = nearest(p.data.base, t);
+    if (!pt) continue;
+    const x = p.X(pt.t);
+    p.xhair.setAttribute("x1", x);
+    p.xhair.setAttribute("x2", x);
+    p.xhair.setAttribute("visibility", "visible");
+    p.hoverdot.setAttribute("cx", x);
+    p.hoverdot.setAttribute("cy", p.Y(pt.v));
+    p.hoverdot.setAttribute("visibility", "visible");
+    const isAnom = (p.data.anomalyJoined || []).some((a) => a.t === pt.t);
+    tipRows.push(
+      `<div class="row"><span>${p.cfg.commonName}</span>` +
+      `<span class="v">${fmtV(pt.v)} ${p.cfg.unit}${isAnom ? ' <span class="anom-flag">⚠</span>' : ""}</span></div>`
+    );
+  }
+  if (t == null || !ev || !tipRows.length) {
+    tooltip.style.display = "none";
+    return;
+  }
+  tooltip.innerHTML = `<div class="t">${new Date(t * 1000).toLocaleTimeString()}</div>` + tipRows.join("");
+  tooltip.style.display = "block";
+  const tw = tooltip.offsetWidth, th = tooltip.offsetHeight;
+  let tx = ev.clientX + 14, ty = ev.clientY + 12;
+  if (tx + tw > innerWidth - 8) tx = ev.clientX - tw - 14;
+  if (ty + th > innerHeight - 8) ty = ev.clientY - th - 12;
+  tooltip.style.left = tx + "px";
+  tooltip.style.top = ty + "px";
+}
+
+/* ---------------- scatter (first two panels, joined on time) ---------------- */
+
+function renderScatter() {
+  const wrap = document.getElementById("scatterWrap");
+  wrap.innerHTML = "";
+  const [pa, pb] = panels;
+  if (!pa?.data?.base?.length || !pb?.data?.base?.length) return;
+  const bByT = new Map(pb.data.base.map((d) => [d.t, d.v]));
+  const pts = pa.data.base.filter((d) => bByT.has(d.t)).map((d) => ({ x: d.v, y: bByT.get(d.t) }));
+  if (!pts.length) return;
+
+  const div = document.createElement("div");
+  div.className = "panel";
+  div.innerHTML = `<h2>${pa.cfg.commonName} vs ${pb.cfg.commonName}</h2>`;
+  const W = 520, H = 220;
+  const svg = svgEl("svg", { viewBox: `0 0 ${W} ${H}`, style: "height:220px" });
+  const xExt = extent([pts], (d) => d.x);
+  const yExt = extent([pts], (d) => d.y);
+  if (!xExt || !yExt) return;
+  let [x0, x1] = xExt;
+  let [y0, y1] = yExt;
+  if (x0 === x1) { x0 -= 1; x1 += 1; }
+  if (y0 === y1) { y0 -= 1; y1 += 1; }
+  const X = (v) => PAD.l + ((v - x0) / (x1 - x0)) * (W - PAD.l - PAD.r);
+  const Y = (v) => H - PAD.b - ((v - y0) / (y1 - y0)) * (H - PAD.t - PAD.b);
+  for (const v of niceTicks(y0, y1, 4)) {
+    svg.appendChild(svgEl("line", { class: "gridline", x1: PAD.l, x2: W - PAD.r, y1: Y(v), y2: Y(v) }));
+    const txt = svgEl("text", { x: PAD.l - 6, y: Y(v) + 3, "text-anchor": "end" });
+    txt.textContent = fmtV(v);
+    svg.appendChild(txt);
+  }
+  for (const v of niceTicks(x0, x1, 5)) {
+    const txt = svgEl("text", { x: X(v), y: H - 4, "text-anchor": "middle" });
+    txt.textContent = fmtV(v);
+    svg.appendChild(txt);
+  }
+  for (const d of pts)
+    svg.appendChild(svgEl("circle", { class: "scatter-dot", cx: X(d.x), cy: Y(d.y), r: 3.5 }));
+  div.appendChild(svg);
+  wrap.appendChild(div);
+}
+
+/* ---------------- bootstrap ---------------- */
+
+function buildPanels() {
+  const root = document.getElementById("panels");
+  for (const cfg of CFG.panels) {
+    const el = document.createElement("div");
+    el.className = "panel";
+    el.innerHTML =
+      `<h2>${cfg.commonName} <span style="font-weight:400;color:var(--text-muted)">(${cfg.unit})</span></h2>` +
+      `<div class="legend">` +
+      `<span><span class="key base"></span>measured</span>` +
+      `<span><span class="key band"></span>model band</span>` +
+      `<span><span class="dot"></span>anomaly</span>` +
+      `</div><div class="chartbox"></div>`;
+    root.appendChild(el);
+    panels.push({ cfg, el, data: null });
+  }
+}
+
+async function refresh() {
+  await Promise.all(panels.map(fetchPanel));
+  for (const p of panels) renderPanel(p);
+  renderScatter();
+  document.getElementById("updated").textContent =
+    "updated " + new Date().toLocaleTimeString();
+}
+
+document.getElementById("scope").textContent = `${CFG.namespace} / ${CFG.app}`;
+document.getElementById("tableToggle").addEventListener("change", (e) => {
+  tableMode = e.target.checked;
+  for (const p of panels) renderPanel(p);
+});
+addEventListener("resize", () => { for (const p of panels) renderPanel(p); });
+
+buildPanels();
+refresh();
+setInterval(refresh, CFG.pollSeconds * 1000);

@@ -1,0 +1,114 @@
+"""The deployed watch-plane process: informer-equivalent loop tying
+Barrelman (deployment events) and MonitorController (status poll +
+remediation) to a KubeClient.
+
+The reference runs two shared informers — Deployments resynced every 30 s
+and DeploymentMonitors polled every 10 s (`cmd/manager/main.go:39-104`,
+`Barrelman.go:467-472`). Kubernetes watch streams are an optimization of
+list+diff; this plane implements the same event semantics with periodic
+lists diffed against a local snapshot (add/update/delete by UID + spec),
+which survives API-server reconnects for free and needs no client
+machinery. Event *detection* granularity is the resync period, exactly
+like a reference informer that missed its watch stream.
+"""
+
+from __future__ import annotations
+
+import logging
+import time as _time
+from typing import Callable
+
+from foremast_tpu.watch.barrelman import Barrelman
+from foremast_tpu.watch.controller import MonitorController
+from foremast_tpu.watch.kubeapi import KubeClient
+
+log = logging.getLogger("foremast_tpu.watch.plane")
+
+DEPLOY_RESYNC_SECONDS = 30.0  # main.go:58 (deployment informer resync)
+MONITOR_POLL_SECONDS = 10.0  # Barrelman.go:467
+
+
+def _key(dep: dict) -> tuple[str, str]:
+    meta = dep.get("metadata", {})
+    return meta.get("namespace", ""), meta.get("name", "")
+
+
+class DeploymentInformer:
+    """List+diff informer: emits add/update/delete events with the
+    previous object, matching the handler contract of
+    Barrelman.handle_deployment."""
+
+    def __init__(
+        self,
+        kube: KubeClient,
+        handler: Callable[[str, dict, dict | None], None],
+    ) -> None:
+        self.kube = kube
+        self.handler = handler
+        self._snapshot: dict[tuple[str, str], dict] = {}
+        self._primed = False
+
+    def resync(self) -> None:
+        current = {_key(d): d for d in self.kube.list_deployments()}
+        if not self._primed:
+            # first list primes the cache; emit adds so monitors get
+            # created for pre-existing Deployments (AddFunc semantics)
+            self._primed = True
+            for dep in current.values():
+                self._emit("add", dep, None)
+            self._snapshot = current
+            return
+        for key, dep in current.items():
+            old = self._snapshot.get(key)
+            if old is None:
+                self._emit("add", dep, None)
+            elif dep.get("metadata", {}).get("resourceVersion") != old.get(
+                "metadata", {}
+            ).get("resourceVersion"):
+                self._emit("update", dep, old)
+        for key, old in self._snapshot.items():
+            if key not in current:
+                self._emit("delete", old, None)
+        self._snapshot = current
+
+    def _emit(self, event: str, dep: dict, old: dict | None) -> None:
+        try:
+            self.handler(event, dep, old)
+        except Exception:  # noqa: BLE001 - one bad object must not kill the loop
+            log.exception("handler failed for %s %s", event, _key(dep))
+
+
+class WatchPlane:
+    """The whole deployed controller: deployment informer + monitor poll."""
+
+    def __init__(
+        self,
+        kube: KubeClient,
+        own_namespace: str = "foremast",
+        clock: Callable[[], float] = _time.time,
+        sleep: Callable[[float], None] = _time.sleep,
+    ) -> None:
+        self.barrelman = Barrelman(kube, own_namespace=own_namespace, clock=clock)
+        self.controller = MonitorController(kube, barrelman=self.barrelman, clock=clock)
+        self.informer = DeploymentInformer(kube, self.barrelman.handle_deployment)
+        self.clock = clock
+        self.sleep = sleep
+
+    def step(self, now: float | None = None, last_resync: float = 0.0) -> float:
+        """One scheduler step: monitor tick always; deployment resync when
+        due. Returns the new last_resync time."""
+        now = self.clock() if now is None else now
+        if now - last_resync >= DEPLOY_RESYNC_SECONDS or last_resync == 0.0:
+            self.informer.resync()
+            last_resync = now
+        self.controller.tick()
+        return last_resync
+
+    def run(self, stop: Callable[[], bool] = lambda: False) -> None:
+        last_resync = 0.0
+        while not stop():
+            try:
+                last_resync = self.step(last_resync=last_resync)
+            except Exception:  # noqa: BLE001 - keep the control loop alive
+                log.exception("watch-plane step failed")
+            self.sleep(MONITOR_POLL_SECONDS)

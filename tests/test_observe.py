@@ -57,6 +57,38 @@ def test_worker_publishes_gauges(demo_traces):
     assert "foremastbrain_error4xx_anomaly" in text  # spike published
 
 
+def test_verdict_hook_derives_namespace_from_query():
+    """exported_namespace comes from the job's PromQL selector so gauges
+    land next to the base series they model (UI joins on it)."""
+    reg = CollectorRegistry()
+    gauges = BrainGauges(registry=reg)
+    hook = make_verdict_hook(gauges, "fallback-ns")
+
+    class V:
+        alias = "latency"
+        upper = [1.0]
+        lower = [0.5]
+        anomaly_pairs = []
+
+    doc = Document(
+        id="n1",
+        app_name="shop",
+        current_config=(
+            "latency== http://prom/api/v1/query_range?query=namespace_pod"
+            "%3Alatency%7Bnamespace%3D%22prod%22%2Cpod%3D~%22a%7Cb%22%7D"
+        ),
+    )
+    hook(doc, [V()])
+    text = generate_latest(reg).decode()
+    assert 'exported_namespace="prod"' in text
+
+    # no namespace selector in the query -> static fallback
+    doc2 = Document(id="n2", app_name="shop", current_config="latency== http://x/q")
+    hook(doc2, [V()])
+    text = generate_latest(reg).decode()
+    assert 'exported_namespace="fallback-ns"' in text
+
+
 def test_json_logging(capsys):
     import io
 

@@ -1,0 +1,90 @@
+"""Watch-plane loop tests: list+diff informer semantics + scheduler step."""
+
+from foremast_tpu.watch.kubeapi import InMemoryKube
+from foremast_tpu.watch.plane import (
+    DEPLOY_RESYNC_SECONDS,
+    DeploymentInformer,
+    WatchPlane,
+)
+
+
+def _dep(ns, name, image="app:v1", rv="1", labels=None):
+    return {
+        "metadata": {
+            "namespace": ns,
+            "name": name,
+            "resourceVersion": rv,
+            "labels": labels if labels is not None else {"app": name},
+            "uid": f"uid-{ns}-{name}",
+        },
+        "spec": {
+            "template": {"spec": {"containers": [{"name": "c", "image": image}]}}
+        },
+    }
+
+
+def test_informer_emits_add_update_delete():
+    kube = InMemoryKube()
+    events = []
+    inf = DeploymentInformer(kube, lambda e, d, old: events.append((e, d, old)))
+
+    kube.deployments[("ns", "a")] = _dep("ns", "a")
+    inf.resync()
+    assert [e for e, *_ in events] == ["add"]
+
+    # unchanged resourceVersion -> no event
+    inf.resync()
+    assert len(events) == 1
+
+    # image change bumps resourceVersion -> update with the old object
+    kube.deployments[("ns", "a")] = _dep("ns", "a", image="app:v2", rv="2")
+    inf.resync()
+    assert events[-1][0] == "update"
+    assert events[-1][2]["metadata"]["resourceVersion"] == "1"
+
+    del kube.deployments[("ns", "a")]
+    inf.resync()
+    assert events[-1][0] == "delete"
+
+
+def test_informer_handler_errors_do_not_stop_resync():
+    kube = InMemoryKube()
+    kube.deployments[("ns", "a")] = _dep("ns", "a")
+    kube.deployments[("ns", "b")] = _dep("ns", "b")
+    seen = []
+
+    def handler(e, d, old):
+        seen.append(d["metadata"]["name"])
+        raise RuntimeError("boom")
+
+    DeploymentInformer(kube, handler).resync()
+    assert sorted(seen) == ["a", "b"]
+
+
+def test_watchplane_step_resync_schedule():
+    kube = InMemoryKube()
+    now = [1000.0]
+    plane = WatchPlane(kube, clock=lambda: now[0], sleep=lambda s: None)
+    resyncs = []
+    plane.informer.resync = lambda: resyncs.append(now[0])  # type: ignore[method-assign]
+
+    last = plane.step(last_resync=0.0)
+    assert resyncs == [1000.0] and last == 1000.0
+    # within the resync period: monitor tick only
+    now[0] += 10
+    assert plane.step(last_resync=last) == last
+    assert len(resyncs) == 1
+    # past the period: resync again
+    now[0] += DEPLOY_RESYNC_SECONDS
+    last2 = plane.step(last_resync=last)
+    assert len(resyncs) == 2 and last2 == now[0]
+
+
+def test_watchplane_creates_monitor_for_existing_deployment():
+    """First resync primes with add events -> Barrelman ensures a monitor
+    CR exists for every labeled Deployment (AddFunc semantics)."""
+    kube = InMemoryKube()
+    kube.deployments[("prod", "shop")] = _dep("prod", "shop")
+    plane = WatchPlane(kube, clock=lambda: 0.0, sleep=lambda s: None)
+    plane.step(last_resync=0.0)
+    assert ("prod", "shop") in kube.monitors

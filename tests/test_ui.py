@@ -1,0 +1,66 @@
+"""Dashboard-plane tests: panel config generation + server surface."""
+
+import asyncio
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from foremast_tpu.observe.gauges import _san
+from foremast_tpu.ui.app import make_app, render_index
+from foremast_tpu.ui.metrics import DEFAULT_PANELS, Panel, dashboard_config
+
+
+def test_panel_series_names_match_engine_gauges():
+    """The dashboard must chart exactly the series names BrainGauges
+    exports — derived through the same sanitizer."""
+    p = Panel("namespace_app_per_pod:http_server_requests_latency", "Latency")
+    series = p.series("ns1", "app1")
+    types = [s["type"] for s in series]
+    assert types == ["base", "upper", "lower", "anomaly"]
+    g = "foremastbrain_" + _san(p.metric)
+    assert series[1]["name"] == f"{g}_upper"
+    assert series[2]["name"] == f"{g}_lower"
+    assert series[3]["name"] == f"{g}_anomaly"
+    # base selects namespace/app; gauges select exported_namespace/app
+    assert 'namespace="ns1"' in series[0]["query"]
+    assert 'exported_namespace="ns1"' in series[1]["query"]
+
+
+def test_dashboard_config_shape():
+    cfg = dashboard_config("http://svc:8099/", namespace="n", app="a")
+    assert cfg["serviceEndpoint"] == "http://svc:8099"  # trailing / stripped
+    assert cfg["pollSeconds"] == 15  # reference App.js:20,78
+    assert cfg["stepSeconds"] == 15
+    assert len(cfg["panels"]) == len(DEFAULT_PANELS)
+    for panel in cfg["panels"]:
+        assert {"metric", "commonName", "scale", "unit", "series"} <= set(panel)
+
+
+def test_render_index_injects_config():
+    cfg = dashboard_config("http://svc:8099")
+    html = render_index(cfg)
+    assert "__CONFIG__" not in html
+    # the blob must be parseable JSON exactly as injected
+    start = html.index("window.FOREMAST_CONFIG = ") + len("window.FOREMAST_CONFIG = ")
+    end = html.index(";</script>", start)
+    assert json.loads(html[start:end]) == cfg
+
+
+def test_ui_server_serves_index_config_and_static():
+    async def main():
+        app = make_app(service_endpoint="http://svc:8099", namespace="n", app_name="a")
+        async with TestClient(TestServer(app)) as c:
+            r = await c.get("/")
+            assert r.status == 200
+            body = await r.text()
+            assert "FOREMAST_CONFIG" in body
+            assert '"serviceEndpoint": "http://svc:8099"' in body
+            r = await c.get("/config")
+            assert (await r.json())["app"] == "a"
+            for path in ("/static/app.js", "/static/style.css"):
+                r = await c.get(path)
+                assert r.status == 200, path
+            r = await c.get("/healthz")
+            assert (await r.json()) == {"ok": True}
+
+    asyncio.run(main())
