@@ -37,6 +37,7 @@ from foremast_tpu.config import (
     PAIRWISE_MANN_WHITE,
     PAIRWISE_WILCOXON,
 )
+from foremast_tpu.ops import kernels
 from foremast_tpu.ops.anomaly import compute_bounds, detect_anomalies
 from foremast_tpu.ops.forecasters import (
     Forecast,
@@ -180,18 +181,18 @@ def pairwise_decision(
 DIFF_THRESHOLD_FACTOR = 0.5
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "algorithm",
-        "pairwise_algorithm",
-        "p_threshold",
-        "min_mw",
-        "min_wilcoxon",
-        "min_kruskal",
-    ),
+_STATIC = (
+    "algorithm",
+    "pairwise_algorithm",
+    "p_threshold",
+    "min_mw",
+    "min_wilcoxon",
+    "min_kruskal",
 )
-def score(
+
+
+@partial(jax.jit, static_argnames=_STATIC)
+def _score_xla(
     batch: ScoreBatch,
     algorithm: str = "moving_average_all",
     pairwise_algorithm: str = PAIRWISE_ALL,
@@ -200,8 +201,8 @@ def score(
     min_wilcoxon: int = 20,
     min_kruskal: int = 5,
 ) -> ScoreResult:
-    """Judge a whole batch in one compiled program (call stack 3.2 of
-    SURVEY.md collapsed into array ops)."""
+    """The pure-XLA scoring program (partitions under GSPMD for the
+    sharded path — no custom calls, so the mesh slices it freely)."""
     hist = batch.historical
     cur = batch.current
     base = batch.baseline
@@ -216,6 +217,10 @@ def score(
         min_kruskal,
     )
 
+    eff_threshold = jnp.where(
+        differs, batch.threshold * DIFF_THRESHOLD_FACTOR, batch.threshold
+    )
+
     fit = AI_MODEL.get(algorithm)
     if fit is None:
         # models/ registers its detectors (seasonal/prophet/...) on import;
@@ -226,9 +231,6 @@ def score(
     fc: Forecast = fit(hist.values, hist.mask)
     pred = horizon(fc, cur.length)  # [B, Tc] forecast over current window
 
-    eff_threshold = jnp.where(
-        differs, batch.threshold * DIFF_THRESHOLD_FACTOR, batch.threshold
-    )
     upper, lower = compute_bounds(pred, fc.scale, eff_threshold, batch.min_lower_bound)
     anomalies = detect_anomalies(cur.values, cur.mask, upper, lower, batch.bound)
 
@@ -251,3 +253,80 @@ def score(
         p_value=p,
         dist_differs=differs,
     )
+
+
+@partial(jax.jit, static_argnames=_STATIC)
+def _score_pallas(
+    batch: ScoreBatch,
+    algorithm: str = "moving_average_all",
+    pairwise_algorithm: str = PAIRWISE_ALL,
+    p_threshold: float = 0.05,
+    min_mw: int = 20,
+    min_wilcoxon: int = 20,
+    min_kruskal: int = 5,
+) -> ScoreResult:
+    """Fused-kernel path: pairwise stays XLA; the moving_average_all
+    judgment runs as one pallas_call (ops/kernels.py)."""
+    del algorithm  # dispatcher guarantees moving_average_all
+    cur = batch.current
+    p, differs = pairwise_decision(
+        cur,
+        batch.baseline,
+        pairwise_algorithm,
+        p_threshold,
+        min_mw,
+        min_wilcoxon,
+        min_kruskal,
+    )
+    eff_threshold = jnp.where(
+        differs, batch.threshold * DIFF_THRESHOLD_FACTOR, batch.threshold
+    )
+    verdict, anomalies, upper, lower = kernels.ma_judgment(
+        batch.historical.values,
+        batch.historical.mask,
+        cur.values,
+        cur.mask,
+        eff_threshold,
+        batch.bound,
+        batch.min_lower_bound,
+        batch.min_points,
+    )
+    return ScoreResult(
+        verdict=verdict,
+        anomalies=anomalies,
+        upper=upper,
+        lower=lower,
+        p_value=p,
+        dist_differs=differs,
+    )
+
+
+def _is_multi_device(batch: ScoreBatch) -> bool:
+    """True when the batch is placed across >1 device (GSPMD path)."""
+    sharding = getattr(batch.current.values, "sharding", None)
+    if sharding is None:
+        return False
+    try:
+        return len(sharding.device_set) > 1
+    except Exception:  # tracers / abstract values: assume the safe path
+        return True
+
+
+def score(batch: ScoreBatch, **kwargs) -> ScoreResult:
+    """Judge a whole batch in one compiled program (call stack 3.2 of
+    SURVEY.md collapsed into array ops).
+
+    Un-jitted dispatcher over two jitted programs so (a) the
+    FOREMAST_PALLAS gate is honored at *call* time, not frozen into a
+    trace cache, and (b) multi-device batches always take the XLA
+    program, which GSPMD partitions freely (a pallas_call has no
+    partitioning rule and would force a gather).
+    """
+    algorithm = kwargs.get("algorithm", "moving_average_all")
+    if (
+        algorithm == "moving_average_all"
+        and kernels.use_pallas()
+        and not _is_multi_device(batch)
+    ):
+        return _score_pallas(batch, **kwargs)
+    return _score_xla(batch, **kwargs)

@@ -1,0 +1,120 @@
+"""Pallas kernel tests (interpret mode on CPU): parity with the XLA path."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from foremast_tpu.engine import scoring
+from foremast_tpu.ops.anomaly import BOUND_BOTH, BOUND_LOWER, BOUND_UPPER
+from foremast_tpu.ops.kernels import ma_judgment, masked_stats, use_pallas
+from foremast_tpu.ops.windows import MetricWindows, masked_mean, masked_std
+
+
+def _rand_batch(rng, b=5, t=300):
+    vals = rng.normal(2.0, 1.5, size=(b, t)).astype(np.float32)
+    mask = rng.random((b, t)) > 0.2
+    mask[0] = False  # one fully-masked series
+    mask[1, 5:] = False  # one nearly-empty series
+    return jnp.asarray(vals), jnp.asarray(mask)
+
+
+def test_masked_stats_matches_windows_ops():
+    rng = np.random.default_rng(0)
+    vals, mask = _rand_batch(rng)
+    cnt, mean, std = masked_stats(vals, mask, interpret=True)
+    np.testing.assert_allclose(cnt, mask.sum(axis=-1), rtol=0)
+    np.testing.assert_allclose(mean, masked_mean(vals, mask), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        std, masked_std(vals, mask, ddof=0), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_masked_stats_unaligned_shapes():
+    """B and T deliberately not multiples of the tile sizes."""
+    rng = np.random.default_rng(1)
+    vals, mask = _rand_batch(rng, b=3, t=131)
+    cnt, mean, std = masked_stats(vals, mask, interpret=True)
+    assert cnt.shape == (3,)
+    np.testing.assert_allclose(mean, masked_mean(vals, mask), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("bound", [BOUND_UPPER, BOUND_LOWER, BOUND_BOTH])
+def test_ma_judgment_matches_xla_score(bound, monkeypatch):
+    """The fused kernel must reproduce the XLA score() verdicts, flags,
+    and bounds for algorithm=moving_average_all."""
+    monkeypatch.setenv("FOREMAST_PALLAS", "0")  # XLA reference path
+    rng = np.random.default_rng(2)
+    b = 6
+    hist_v, hist_m = _rand_batch(rng, b=b, t=400)
+    cur_v = rng.normal(2.0, 1.5, size=(b, 30)).astype(np.float32)
+    cur_v[2, 7] = 50.0  # guaranteed upper breach
+    cur_v[3, 3] = -50.0  # guaranteed lower breach
+    cur_m = np.ones((b, 30), bool)
+    cur_m[4, :] = False  # no current data -> unknown
+    cur_v, cur_m = jnp.asarray(cur_v), jnp.asarray(cur_m)
+
+    batch = scoring.ScoreBatch(
+        historical=MetricWindows(values=hist_v, mask=hist_m, times=jnp.zeros(hist_v.shape, jnp.int32)),
+        current=MetricWindows(values=cur_v, mask=cur_m, times=jnp.zeros(cur_v.shape, jnp.int32)),
+        baseline=MetricWindows(
+            values=jnp.zeros_like(cur_v), mask=jnp.zeros_like(cur_m),
+            times=jnp.zeros(cur_v.shape, jnp.int32),
+        ),
+        threshold=jnp.full((b,), 2.0, jnp.float32),
+        bound=jnp.full((b,), bound, jnp.int32),
+        min_lower_bound=jnp.zeros((b,), jnp.float32),
+        min_points=jnp.full((b,), 10.0, jnp.float32),
+    )
+    ref = scoring.score(batch)
+
+    verdict, anomalies, upper, lower = ma_judgment(
+        hist_v,
+        hist_m,
+        cur_v,
+        cur_m,
+        batch.threshold,
+        batch.bound,
+        batch.min_lower_bound,
+        batch.min_points,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(verdict, ref.verdict)
+    np.testing.assert_array_equal(anomalies, ref.anomalies)
+    np.testing.assert_allclose(upper, ref.upper, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(lower, ref.lower, rtol=1e-4, atol=1e-4)
+
+
+def test_score_dispatches_to_pallas_path(monkeypatch):
+    """FOREMAST_PALLAS=1 routes score() through the kernel (interpret mode
+    off-TPU) and still produces the XLA-path results."""
+    rng = np.random.default_rng(3)
+    b = 4
+    hist_v, hist_m = _rand_batch(rng, b=b, t=256)
+    cur_v = jnp.asarray(rng.normal(2.0, 1.5, size=(b, 20)).astype(np.float32))
+    cur_m = jnp.ones((b, 20), bool)
+    batch = scoring.ScoreBatch(
+        historical=MetricWindows(values=hist_v, mask=hist_m, times=jnp.zeros(hist_v.shape, jnp.int32)),
+        current=MetricWindows(values=cur_v, mask=cur_m, times=jnp.zeros(cur_v.shape, jnp.int32)),
+        baseline=MetricWindows(
+            values=jnp.zeros_like(cur_v), mask=jnp.zeros_like(cur_m),
+            times=jnp.zeros(cur_v.shape, jnp.int32),
+        ),
+        threshold=jnp.full((b,), 2.0, jnp.float32),
+        bound=jnp.full((b,), 1, jnp.int32),
+        min_lower_bound=jnp.zeros((b,), jnp.float32),
+        min_points=jnp.full((b,), 10.0, jnp.float32),
+    )
+
+    monkeypatch.setenv("FOREMAST_PALLAS", "0")
+    assert not use_pallas()
+    ref = scoring.score(batch)
+
+    monkeypatch.setenv("FOREMAST_PALLAS", "1")
+    assert use_pallas()
+    # score() dispatches at call time, so the env flip takes effect
+    # without any cache clearing
+    out = scoring.score(batch)
+
+    np.testing.assert_array_equal(out.verdict, ref.verdict)
+    np.testing.assert_array_equal(out.anomalies, ref.anomalies)
+    np.testing.assert_allclose(out.upper, ref.upper, rtol=1e-4, atol=1e-4)
