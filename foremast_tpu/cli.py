@@ -176,6 +176,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
 
 
 def cmd_worker(args: argparse.Namespace) -> int:
+    from foremast_tpu import native
     from foremast_tpu.config import BrainConfig
     from foremast_tpu.jobs.worker import BrainWorker
     from foremast_tpu.metrics.source import PrometheusSource
@@ -185,6 +186,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
         start_metrics_server,
     )
 
+    native.ensure_built()  # startup-time compile, never in the hot path
     config = BrainConfig.from_env()
     store = _make_store(args.elastic_url)
     on_verdict = None

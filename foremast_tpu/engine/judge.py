@@ -154,16 +154,24 @@ class HealthJudge:
         ps = np.asarray(res.p_value)
         differs = np.asarray(res.dist_differs)
 
+        from foremast_tpu import native
+
+        use_native = native.available()
         out = []
         for i, t in enumerate(tasks):
             n = len(t.cur_values)
             # flat [t, v, ...] pairs — barrelman's convertToAnomaly format
             # (Barrelman.go:605-615)
-            idx = np.nonzero(anoms[i, :n])[0]
-            flat = np.empty(2 * len(idx), dtype=np.float64)
-            flat[0::2] = np.asarray(t.cur_times)[idx]
-            flat[1::2] = np.asarray(t.cur_values)[idx]
-            pairs = flat.tolist()
+            if use_native:
+                pairs = native.anomaly_pairs(
+                    anoms[i, :n], np.asarray(t.cur_times), np.asarray(t.cur_values)
+                )
+            else:
+                idx = np.nonzero(anoms[i, :n])[0]
+                flat = np.empty(2 * len(idx), dtype=np.float64)
+                flat[0::2] = np.asarray(t.cur_times)[idx]
+                flat[1::2] = np.asarray(t.cur_values)[idx]
+                pairs = flat.tolist()
             out.append(
                 MetricVerdict(
                     job_id=t.job_id,

@@ -1,0 +1,170 @@
+"""ctypes bindings for the native runtime library (native/windowpack.cpp).
+
+Loading policy: `load()` only loads an existing
+`native/build/libforemast_native.so` — it never compiles, so the scoring
+hot path can't stall behind a surprise 2-minute build. Long-lived entry
+points (worker/serve CLI) call `ensure_built()` once at startup, which
+runs `make -C native` when a toolchain is available. Without the library
+everything falls back to the pure-Python paths — the framework never
+*requires* native code (SURVEY.md: the reference has none, so this layer
+has no parity obligation; it serves the 100k windows/sec target).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger("foremast_tpu.native")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libforemast_native.so")
+ABI_VERSION = 3
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception as e:  # noqa: BLE001 - any failure means "no native lib"
+        log.debug("native build failed: %s", e)
+        return False
+
+
+def ensure_built() -> bool:
+    """Build the library if missing (startup-time hook; see module doc).
+
+    Never rebuilds a library this process may already have mapped —
+    rewriting a dlopen'd .so in place corrupts the mapping."""
+    global _tried
+    if os.environ.get("FOREMAST_NATIVE", "") == "0":
+        return False
+    with _lock:
+        if _lib is not None:
+            return True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return False
+        _tried = False  # a fresh load attempt may now succeed
+    return load() is not None
+
+
+def load() -> ctypes.CDLL | None:
+    """The already-built library, or None (no compile happens here).
+
+    Disable entirely with FOREMAST_NATIVE=0."""
+    global _lib, _tried
+    if os.environ.get("FOREMAST_NATIVE", "") == "0":
+        return None
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH):
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            log.warning("could not load %s: %s", _LIB_PATH, e)
+            return None
+        lib.fp_abi_version.restype = ctypes.c_int32
+        if lib.fp_abi_version() != ABI_VERSION:
+            # Do NOT rebuild here: the stale object is mapped into this
+            # process, and rewriting its inode risks handing back the old
+            # mapping (glibc dev/inode caching) or a SIGBUS. Fall back to
+            # Python; `make -C native` in a fresh process fixes it.
+            log.warning(
+                "stale native library (abi %s != %s); run `make -C native` "
+                "and restart — falling back to pure Python",
+                lib.fp_abi_version(),
+                ABI_VERSION,
+            )
+            return None
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+        lib.fp_pack_windows.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p), i64p,
+            ctypes.c_int64, ctypes.c_int64,
+            f32p, i32p, u8p,
+        ]
+        lib.fp_pack_windows.restype = None
+        lib.fp_anomaly_pairs.argtypes = [u8p, i64p, f32p, ctypes.c_int64, f64p]
+        lib.fp_anomaly_pairs.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def pack_windows(
+    series: list[tuple[np.ndarray, np.ndarray]], length: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray] | None:
+    """Native ragged->[B, T] packing; None when the library is unavailable.
+
+    Returns (values f32 [B,T], times i32 [B,T], mask bool [B,T]) with the
+    exact semantics of MetricWindows.from_ragged (truncate to T, zero pad).
+    """
+    lib = load()
+    if lib is None:
+        return None
+    b = len(series)
+    # keep the normalized buffers alive for the call; no staging copy —
+    # the library reads straight from each numpy buffer via pointer arrays
+    vals = [np.ascontiguousarray(v, dtype=np.float32) for _, v in series]
+    times = [np.ascontiguousarray(t, dtype=np.int64) for t, _ in series]
+    for i, (t, v) in enumerate(zip(times, vals)):
+        if len(t) != len(v):  # the C code indexes times by len(values)
+            raise ValueError(
+                f"series {i}: {len(t)} timestamps for {len(v)} values"
+            )
+    lens = np.fromiter((len(v) for v in vals), np.int64, count=b)
+    vptrs = (ctypes.c_void_p * b)(*(v.ctypes.data for v in vals))
+    tptrs = (ctypes.c_void_p * b)(*(t.ctypes.data for t in times))
+    # np.zeros: the library writes only each row's valid prefix, so the
+    # padding stays on copy-on-write zero pages and is never faulted in
+    out_values = np.zeros((b, length), np.float32)
+    out_times = np.zeros((b, length), np.int32)
+    out_mask = np.zeros((b, length), np.uint8)
+    lib.fp_pack_windows(
+        vptrs, tptrs, lens, b, length, out_values, out_times, out_mask
+    )
+    return out_values, out_times, out_mask.view(bool)
+
+
+def anomaly_pairs(
+    flags: np.ndarray, times: np.ndarray, values: np.ndarray
+) -> list[float] | None:
+    """Native flat [t1, v1, ...] pair encoding; None when unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    flags = np.ascontiguousarray(flags, dtype=np.uint8)
+    times = np.ascontiguousarray(times, dtype=np.int64)
+    values = np.ascontiguousarray(values, dtype=np.float32)
+    n = len(flags)
+    if len(times) != n or len(values) != n:
+        raise ValueError(
+            f"length mismatch: {n} flags, {len(times)} times, {len(values)} values"
+        )
+    out = np.empty(2 * n, np.float64)
+    k = int(lib.fp_anomaly_pairs(flags, times, values, n, out))
+    return out[: 2 * k].tolist()

@@ -60,14 +60,21 @@ class MetricWindows:
             length = max((len(v) for _, v in series), default=1)
             length = max(length, 1)
         b = len(series)
-        values = np.zeros((b, length), dtype=np.float32)
-        times = np.zeros((b, length), dtype=np.int32)
-        mask = np.zeros((b, length), dtype=bool)
-        for i, (t, v) in enumerate(series):
-            n = min(len(v), length)
-            values[i, :n] = np.asarray(v, dtype=np.float32)[:n]
-            times[i, :n] = np.asarray(t, dtype=np.int64)[:n].astype(np.int32)
-            mask[i, :n] = True
+
+        from foremast_tpu import native
+
+        packed = native.pack_windows(list(series), length) if b else None
+        if packed is not None:
+            values, times, mask = packed
+        else:
+            values = np.zeros((b, length), dtype=np.float32)
+            times = np.zeros((b, length), dtype=np.int32)
+            mask = np.zeros((b, length), dtype=bool)
+            for i, (t, v) in enumerate(series):
+                n = min(len(v), length)
+                values[i, :n] = np.asarray(v, dtype=np.float32)[:n]
+                times[i, :n] = np.asarray(t, dtype=np.int64)[:n].astype(np.int32)
+                mask[i, :n] = True
         return MetricWindows(
             values=jnp.asarray(values), mask=jnp.asarray(mask), times=jnp.asarray(times)
         )

@@ -1,0 +1,93 @@
+// foremast-tpu native runtime: window packing (the data-loader hot path).
+//
+// The engine's host side packs thousands of ragged (times, values) series
+// per tick into fixed-shape [B, T] batches (mask-padded) before device
+// transfer (SURVEY.md section 7.4: "host-side dispatcher that packs pending
+// jobs into fixed-shape batches"). The reference has no native code (its
+// brain is Python on a 100m-CPU sliver, foremast-brain.yaml:82-86); at this
+// framework's throughput target (100k windows/sec) the per-series Python
+// loop becomes the bottleneck, so the inner scatter runs here instead.
+//
+// ABI: plain C, consumed via ctypes (foremast_tpu/native.py). Inputs are
+// per-series pointer tables plus a lengths array, so Python makes exactly
+// one call per batch regardless of B and no staging copy is needed.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Pack ragged series into [B, T] values/times/mask.
+//  values: float32*[B]   per-series value buffers (no staging copy —
+//                        Python passes raw numpy pointers)
+//  times:  int64*[B]     per-series timestamp buffers
+//  lens:   int64[B]      per-series lengths
+//  B, T:   batch and window length
+//  out_values: float32[B*T]   caller-zeroed (np.zeros) — only the valid
+//  out_times:  int32[B*T]     prefix is written here, so OS zero pages
+//  out_mask:   uint8[B*T]     cover the padding without ever faulting the
+//                             tail in (int32 times: f32 ulp at current
+//                             epochs is 128 s — see windows.py)
+// Series longer than T are truncated to their first T samples (same
+// semantics as MetricWindows.from_ragged).
+void fp_pack_windows(const float* const* values, const int64_t* const* times,
+                     const int64_t* lens, int64_t B, int64_t T,
+                     float* out_values, int32_t* out_times,
+                     uint8_t* out_mask) {
+  auto pack_range = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const int64_t n = std::min<int64_t>(lens[i], T);
+      float* ov = out_values + i * T;
+      int32_t* ot = out_times + i * T;
+      uint8_t* om = out_mask + i * T;
+      std::memcpy(ov, values[i], sizeof(float) * n);
+      const int64_t* ts = times[i];
+      for (int64_t j = 0; j < n; ++j) ot[j] = static_cast<int32_t>(ts[j]);
+      std::memset(om, 1, n);
+    }
+  };
+
+  // Parallelize across series for large batches; the per-series work is a
+  // short memcpy, so only spin up threads when there is real volume.
+  const int64_t kParallelThreshold = 1024;
+  unsigned hw = std::thread::hardware_concurrency();
+  if (B < kParallelThreshold || hw < 2) {
+    pack_range(0, B);
+    return;
+  }
+  const int64_t n_threads = std::min<int64_t>(hw, 8);
+  std::vector<std::thread> workers;
+  workers.reserve(n_threads);
+  const int64_t chunk = (B + n_threads - 1) / n_threads;
+  for (int64_t t = 0; t < n_threads; ++t) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = std::min(lo + chunk, B);
+    if (lo >= hi) break;
+    workers.emplace_back(pack_range, lo, hi);
+  }
+  for (auto& w : workers) w.join();
+}
+
+// Encode anomaly (time, value) pairs for one window into the reference's
+// flat [t1, v1, t2, v2, ...] wire form (Barrelman.go:605-615).
+// Returns the number of pairs written; out must hold 2*n doubles.
+int64_t fp_anomaly_pairs(const uint8_t* flags, const int64_t* times,
+                         const float* values, int64_t n, double* out) {
+  int64_t k = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (flags[i]) {
+      out[2 * k] = static_cast<double>(times[i]);
+      out[2 * k + 1] = static_cast<double>(values[i]);
+      ++k;
+    }
+  }
+  return k;
+}
+
+// ABI version tag so the Python side can detect stale builds.
+int32_t fp_abi_version() { return 3; }
+
+}  // extern "C"

@@ -1,0 +1,82 @@
+"""Native runtime library tests: build, ABI, parity with the Python paths."""
+
+import numpy as np
+import pytest
+
+from foremast_tpu import native
+from foremast_tpu.ops.windows import MetricWindows
+
+pytestmark = pytest.mark.skipif(
+    not native.ensure_built(),  # builds once at collection; load() never compiles
+    reason="native library unavailable (no C++ toolchain)",
+)
+
+
+def _series(rng, n):
+    t = (1_700_000_000 + 60 * np.arange(n)).astype(np.int64)
+    v = rng.normal(size=n).astype(np.float32)
+    return t, v
+
+
+def test_pack_windows_matches_python_path(monkeypatch):
+    rng = np.random.default_rng(0)
+    series = [_series(rng, n) for n in (0, 1, 7, 48, 100)]
+    length = 48  # forces both padding and truncation
+
+    values, times, mask = native.pack_windows(series, length)
+
+    monkeypatch.setenv("FOREMAST_NATIVE", "0")
+    ref = MetricWindows.from_ragged(series, length)
+    np.testing.assert_array_equal(values, np.asarray(ref.values))
+    np.testing.assert_array_equal(times, np.asarray(ref.times))
+    np.testing.assert_array_equal(mask, np.asarray(ref.mask))
+
+
+def test_from_ragged_uses_native_and_matches():
+    """from_ragged with the native path on must equal the pure path."""
+    rng = np.random.default_rng(1)
+    series = [_series(rng, n) for n in (5, 30, 12)]
+    w = MetricWindows.from_ragged(series, 30)
+    assert w.values.shape == (3, 30)
+    assert int(w.count()[0]) == 5
+    assert int(w.count()[1]) == 30
+    np.testing.assert_allclose(np.asarray(w.values)[0, :5], series[0][1][:5])
+    assert not np.asarray(w.mask)[0, 5:].any()
+
+
+def test_pack_windows_large_batch_parallel_path():
+    """Cross the kParallelThreshold so the threaded path runs."""
+    rng = np.random.default_rng(2)
+    series = [_series(rng, 16) for _ in range(2048)]
+    values, times, mask = native.pack_windows(series, 16)
+    assert values.shape == (2048, 16)
+    assert mask.all()
+    i = 1234
+    np.testing.assert_array_equal(values[i], series[i][1])
+
+
+def test_anomaly_pairs_wire_format():
+    t = np.array([10, 20, 30, 40], np.int64)
+    v = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    flags = np.array([0, 1, 0, 1], np.uint8)
+    pairs = native.anomaly_pairs(flags, t, v)
+    assert pairs == [20.0, 2.0, 40.0, 4.0]
+
+
+def test_abi_version():
+    lib = native.load()
+    assert lib.fp_abi_version() == native.ABI_VERSION
+
+
+def test_pack_windows_rejects_length_mismatch():
+    t = np.arange(3, dtype=np.int64)
+    v = np.zeros(5, np.float32)
+    with pytest.raises(ValueError, match="3 timestamps for 5 values"):
+        native.pack_windows([(t, v)], 8)
+
+
+def test_anomaly_pairs_rejects_length_mismatch():
+    with pytest.raises(ValueError, match="length mismatch"):
+        native.anomaly_pairs(
+            np.ones(4, np.uint8), np.arange(3, dtype=np.int64), np.zeros(4, np.float32)
+        )
