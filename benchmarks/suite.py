@@ -1,0 +1,247 @@
+"""Benchmark suite — the five BASELINE.md configs plus golden-trace F1.
+
+`bench.py` at the repo root prints the single headline line the driver
+records; this suite measures every BASELINE config individually:
+
+  1. single-metric pairwise health check (latency)
+  2. 4-metric joint score (latency + error4xx + error5xx + tps, Mann-Whitney)
+  3. Holt-Winters seasonal forecaster anomaly bounds (fitted per series)
+  4. LSTM-autoencoder multivariate detector (train + score)
+  5. cluster-wide batch: 10k services x 4 metrics x 30-min windows
+  F1. anomaly F1 on the spring-boot-demo canary trace (quality gate —
+      the reference's CPU brain flags exactly the data2.txt spikes, so
+      parity means F1 = 1.0 on this trace)
+
+Usage: python -m benchmarks.suite [--small] [--config N]
+Prints one JSON line per config. --small shrinks shapes for CPU smoke
+runs (CI); full shapes target a single TPU chip — the v5e-8 north star
+(100k windows/sec) divides to 12.5k windows/sec/chip, reported as
+`vs_target_per_chip` where windows/sec is the metric.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PER_CHIP_TARGET = 100_000 / 8
+
+
+def _bench(fn, *args, iters=5):
+    """Compile, warm, then time `iters` dispatches (block at the end)."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _emit(config, metric, value, unit, **extra):
+    line = {"config": config, "metric": metric, "value": round(value, 2), "unit": unit}
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def _score_batch(b, th, tc, seed=0):
+    from foremast_tpu.parallel.batch import throughput_batch
+
+    return jax.device_put(throughput_batch(b, th, tc, seed=seed))
+
+
+# ---------------------------------------------------------------------------
+
+
+def config1_single_metric_pairwise(small: bool):
+    """Canary check on one metric per service: pairwise + MA bounds."""
+    from foremast_tpu.engine import scoring
+
+    b = 1024 if small else 8192
+    batch = _score_batch(b, 512 if small else 10080, 10)
+    dt = _bench(lambda x: scoring.score(x), batch)
+    wps = b / dt
+    _emit(
+        "1-single-metric-pairwise",
+        "windows_per_sec",
+        wps,
+        "windows/s",
+        vs_target_per_chip=round(wps / PER_CHIP_TARGET, 3),
+    )
+
+
+def config2_four_metric_joint(small: bool):
+    """4 metrics per service, Mann-Whitney joint verdict."""
+    from foremast_tpu.engine import scoring
+    from foremast_tpu.config import PAIRWISE_MANN_WHITE
+
+    services = 512 if small else 4096
+    b = services * 4
+    batch = _score_batch(b, 512 if small else 10080, 30)
+    dt = _bench(
+        lambda x: scoring.score(x, pairwise_algorithm=PAIRWISE_MANN_WHITE), batch
+    )
+    _emit(
+        "2-four-metric-mann-whitney",
+        "services_per_sec",
+        services / dt,
+        "services/s",
+        windows_per_sec=round(b / dt, 1),
+    )
+
+
+def config3_holt_winters(small: bool):
+    """Fitted Holt-Winters bounds (grid-search fit per series)."""
+    from foremast_tpu.engine import scoring
+
+    b = 128 if small else 1024
+    th = 512 if small else 2016  # 7 d at 5-min resample: the scan length
+    batch = _score_batch(b, th, 30)
+    dt = _bench(lambda x: scoring.score(x, algorithm="holt_winters"), batch)
+    wps = b / dt
+    _emit(
+        "3-holt-winters-bounds",
+        "windows_per_sec",
+        wps,
+        "windows/s",
+        scan_length=th,
+    )
+
+
+def config4_lstm_ae(small: bool):
+    """LSTM-autoencoder fleet: train S per-service models, then score."""
+    from foremast_tpu.models.lstm_ae import LSTMAEConfig, fit_many, score_many
+
+    s = 32 if small else 256  # services (one model each)
+    n_win, t_len, f = 8, 30, 4
+    steps = 20 if small else 100
+    cfg = LSTMAEConfig(features=f, hidden=16 if small else 32)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0.5, 0.1, size=(s, n_win, t_len, f)).astype(np.float32))
+    mask = jnp.ones((s, n_win, t_len), bool)
+
+    t0 = time.perf_counter()
+    params, mu, sd, _ = fit_many(jax.random.key(0), x, mask, cfg, steps=steps)
+    jax.block_until_ready(mu)
+    train_s = time.perf_counter() - t0
+
+    dt = _bench(lambda *a: score_many(*a), params, x, mask, mu, sd, 3.0)
+    wps = s * n_win / dt
+    _emit(
+        "4-lstm-autoencoder",
+        "windows_scored_per_sec",
+        wps,
+        "windows/s",
+        models_trained=s,
+        train_steps=steps,
+        train_seconds=round(train_s, 2),
+    )
+
+
+def config5_cluster_batch(small: bool):
+    """BASELINE config 5: 10k services x 4 metrics x 30-min windows.
+
+    On one chip this is the per-chip share of the fleet; the driver's
+    dryrun exercises the same program sharded over an 8-device mesh."""
+    from foremast_tpu.engine import scoring
+
+    services = 1250 if small else 10_000
+    b = services * 4
+    batch = _score_batch(b, 256 if small else 1440, 30)  # 1-day hist/window
+    dt = _bench(lambda x: scoring.score(x), batch, iters=3)
+    wps = b / dt
+    _emit(
+        "5-cluster-batch",
+        "windows_per_sec",
+        wps,
+        "windows/s",
+        services=services,
+        vs_target_per_chip=round(wps / PER_CHIP_TARGET, 3),
+    )
+
+
+def config_f1_golden_trace(small: bool):
+    """Quality gate: F1 on the demo canary traces (BASELINE 'CPU-parity
+    anomaly F1'). data2.txt carries the injected spikes; every spike point
+    must flag and nothing else (the reference demo's pass criterion —
+    docs/guides/installation.md:84-143 runbook)."""
+    import csv
+    import os
+    from datetime import datetime, timezone
+
+    from foremast_tpu.engine.judge import HealthJudge, MetricTask
+
+    data = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "tests", "data")
+
+    def load(name):
+        # rows are "YYYY-mm-dd HH:MM:SS,value" (the reference demo's
+        # FileErrorGenerator trace format)
+        ts, vs = [], []
+        with open(os.path.join(data, name)) as f:
+            for row in csv.reader(f):
+                if row:
+                    dt = datetime.strptime(row[0], "%Y-%m-%d %H:%M:%S")
+                    ts.append(int(dt.replace(tzinfo=timezone.utc).timestamp()))
+                    vs.append(float(row[1]))
+        return np.asarray(ts, np.int64), np.asarray(vs, np.float32)
+
+    nt, nv = load("demo_canary_normal.csv")
+    st, sv = load("demo_canary_spike.csv")
+    hist_t = np.concatenate([nt - 86400 * (i + 1) for i in range(6)])
+    hist_v = np.tile(nv, 6)
+
+    task = MetricTask(
+        job_id="golden", alias="error5xx", metric_type="error5xx",
+        hist_times=hist_t, hist_values=hist_v,
+        cur_times=st, cur_values=sv,
+        base_times=nt, base_values=nv,
+    )
+    (verdict,) = HealthJudge().judge([task])
+    flagged = set(verdict.anomaly_pairs[0::2])
+    truth = {float(t) for t, v in zip(st, sv) if v > 10.0}  # the 40.x spikes
+    tp = len(flagged & truth)
+    fp = len(flagged - truth)
+    fn = len(truth - flagged)
+    precision = tp / max(tp + fp, 1)
+    recall = tp / max(tp + fn, 1)
+    f1 = 2 * precision * recall / max(precision + recall, 1e-9)
+    _emit(
+        "f1-golden-trace",
+        "anomaly_f1",
+        f1,
+        "f1",
+        precision=round(precision, 3),
+        recall=round(recall, 3),
+        spikes=len(truth),
+    )
+
+
+CONFIGS = {
+    "1": config1_single_metric_pairwise,
+    "2": config2_four_metric_joint,
+    "3": config3_holt_winters,
+    "4": config4_lstm_ae,
+    "5": config5_cluster_batch,
+    "f1": config_f1_golden_trace,
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--small", action="store_true", help="CPU smoke shapes")
+    ap.add_argument("--config", default=None, help="run one config (1-5, f1)")
+    args = ap.parse_args(argv)
+    keys = [args.config] if args.config else list(CONFIGS)
+    for k in keys:
+        CONFIGS[k](args.small)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -4,8 +4,9 @@ Loading policy: `load()` only loads an existing
 `native/build/libforemast_native.so` — it never compiles, so the scoring
 hot path can't stall behind a surprise 2-minute build. Long-lived entry
 points (worker/serve CLI) call `ensure_built()` once at startup, which
-runs `make -C native` when a toolchain is available. Without the library
-everything falls back to the pure-Python paths — the framework never
+runs `make -C native` when a toolchain is available (the serve CLI does not
+score windows, so it never needs the library). Without it everything
+falls back to the pure-Python paths — the framework never
 *requires* native code (SURVEY.md: the reference has none, so this layer
 has no parity obligation; it serves the 100k windows/sec target).
 """
@@ -25,7 +26,7 @@ log = logging.getLogger("foremast_tpu.native")
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE_DIR = os.path.join(_REPO, "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libforemast_native.so")
-ABI_VERSION = 3
+ABI_VERSION = 4
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -42,7 +43,9 @@ def _build() -> bool:
         )
         return True
     except Exception as e:  # noqa: BLE001 - any failure means "no native lib"
-        log.debug("native build failed: %s", e)
+        # warning, not debug: this is a one-shot startup event and the
+        # operator needs to know the fast path is off and why
+        log.warning("native build failed (pure-Python fallback active): %s", e)
         return False
 
 
@@ -57,9 +60,15 @@ def ensure_built() -> bool:
     with _lock:
         if _lib is not None:
             return True
-        if not os.path.exists(_LIB_PATH) and not _build():
+        if _tried:
+            # a load already ran and may have mapped a stale .so —
+            # rebuilding its inode now is exactly the hazard we avoid
             return False
-        _tried = False  # a fresh load attempt may now succeed
+        # run make unconditionally: a current build is a timestamp no-op,
+        # a stale-ABI build (windowpack.cpp newer than the .so) rebuilds
+        # here, BEFORE anything is mapped — the only safe moment
+        if not _build():
+            return False
     return load() is not None
 
 
@@ -105,7 +114,7 @@ def load() -> ctypes.CDLL | None:
             f32p, i32p, u8p,
         ]
         lib.fp_pack_windows.restype = None
-        lib.fp_anomaly_pairs.argtypes = [u8p, i64p, f32p, ctypes.c_int64, f64p]
+        lib.fp_anomaly_pairs.argtypes = [u8p, i64p, f64p, ctypes.c_int64, f64p]
         lib.fp_anomaly_pairs.restype = ctypes.c_int64
         _lib = lib
         return _lib
@@ -159,7 +168,8 @@ def anomaly_pairs(
         return None
     flags = np.ascontiguousarray(flags, dtype=np.uint8)
     times = np.ascontiguousarray(times, dtype=np.int64)
-    values = np.ascontiguousarray(values, dtype=np.float32)
+    # float64 so the wire pairs match the Python fallback bit-for-bit
+    values = np.ascontiguousarray(values, dtype=np.float64)
     n = len(flags)
     if len(times) != n or len(values) != n:
         raise ValueError(

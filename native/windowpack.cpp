@@ -73,14 +73,16 @@ void fp_pack_windows(const float* const* values, const int64_t* const* times,
 
 // Encode anomaly (time, value) pairs for one window into the reference's
 // flat [t1, v1, t2, v2, ...] wire form (Barrelman.go:605-615).
+// values are double so float64 task inputs keep full precision (the
+// Python fallback emits float64 — the wire forms must match bit-for-bit).
 // Returns the number of pairs written; out must hold 2*n doubles.
 int64_t fp_anomaly_pairs(const uint8_t* flags, const int64_t* times,
-                         const float* values, int64_t n, double* out) {
+                         const double* values, int64_t n, double* out) {
   int64_t k = 0;
   for (int64_t i = 0; i < n; ++i) {
     if (flags[i]) {
       out[2 * k] = static_cast<double>(times[i]);
-      out[2 * k + 1] = static_cast<double>(values[i]);
+      out[2 * k + 1] = values[i];
       ++k;
     }
   }
@@ -88,6 +90,6 @@ int64_t fp_anomaly_pairs(const uint8_t* flags, const int64_t* times,
 }
 
 // ABI version tag so the Python side can detect stale builds.
-int32_t fp_abi_version() { return 3; }
+int32_t fp_abi_version() { return 4; }
 
 }  // extern "C"

@@ -1,0 +1,20 @@
+"""Benchmark-suite smoke: the F1 quality gate must hold (CPU, tiny)."""
+
+import json
+
+import benchmarks.suite as suite
+
+
+def test_golden_trace_f1_is_perfect(capsys):
+    suite.main(["--small", "--config", "f1"])
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["config"] == "f1-golden-trace"
+    assert line["value"] == 1.0
+    assert line["precision"] == 1.0 and line["recall"] == 1.0
+
+
+def test_suite_config1_runs_small(capsys):
+    suite.main(["--small", "--config", "1"])
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["metric"] == "windows_per_sec"
+    assert line["value"] > 0
