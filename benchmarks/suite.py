@@ -235,7 +235,12 @@ CONFIGS = {
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--small", action="store_true", help="CPU smoke shapes")
-    ap.add_argument("--config", default=None, help="run one config (1-5, f1)")
+    ap.add_argument(
+        "--config",
+        default=None,
+        choices=list(CONFIGS),
+        help="run one config (1-5, f1)",
+    )
     args = ap.parse_args(argv)
     keys = [args.config] if args.config else list(CONFIGS)
     for k in keys:

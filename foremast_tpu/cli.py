@@ -189,13 +189,20 @@ def cmd_worker(args: argparse.Namespace) -> int:
     native.ensure_built()  # startup-time compile, never in the hot path
     config = BrainConfig.from_env()
     store = _make_store(args.elastic_url)
+
+    judge = None
+    if args.sharded:
+        from foremast_tpu.parallel import ShardedJudge, init_distributed, make_global_mesh
+
+        init_distributed()  # no-op single-host; JAX_COORDINATOR_* envs for pods
+        judge = ShardedJudge(config, mesh=make_global_mesh())
     on_verdict = None
     if args.gauge_port:
         gauges = BrainGauges()
         start_metrics_server(args.gauge_port)
         on_verdict = make_verdict_hook(gauges)
     worker = BrainWorker(
-        store, PrometheusSource(), config=config, on_verdict=on_verdict
+        store, PrometheusSource(), config=config, judge=judge, on_verdict=on_verdict
     )
     worker.run(poll_seconds=args.poll)
     return 0
@@ -296,6 +303,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_worker)
     p.add_argument("--elastic-url", default=None)
     p.add_argument("--poll", type=float, default=5.0)
+    p.add_argument(
+        "--sharded",
+        action="store_true",
+        help="score over the full device mesh (multi-host via "
+        "JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID)",
+    )
     p.add_argument(
         "--gauge-port",
         type=int,

@@ -492,7 +492,7 @@ def engine_deployment(cfg: BrainConfig | None = None) -> list[dict]:
     ]
     c = _container(
         name,
-        ["worker", "--gauge-port", "8000"],
+        ["worker", "--gauge-port", "8000", "--sharded"],
         env,
         [{"containerPort": 8000, "name": "gauges"}],
         cpu="4",

@@ -31,6 +31,7 @@ ABI_VERSION = 4
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _tried = False
+_mapped = False  # a .so was actually dlopen'd (even if ABI-stale)
 
 
 def _build() -> bool:
@@ -60,15 +61,18 @@ def ensure_built() -> bool:
     with _lock:
         if _lib is not None:
             return True
-        if _tried:
-            # a load already ran and may have mapped a stale .so —
+        if _mapped:
+            # a .so is dlopen'd in this process (it was ABI-stale) —
             # rebuilding its inode now is exactly the hazard we avoid
             return False
-        # run make unconditionally: a current build is a timestamp no-op,
-        # a stale-ABI build (windowpack.cpp newer than the .so) rebuilds
-        # here, BEFORE anything is mapped — the only safe moment
-        if not _build():
+        # Best-effort make BEFORE anything is mapped: a current build is a
+        # timestamp no-op, a source-newer-than-.so build refreshes, and a
+        # toolchain-less image fails harmlessly — a prebuilt .so on disk
+        # still loads below.
+        _build()
+        if not os.path.exists(_LIB_PATH):
             return False
+        _tried = False  # allow a fresh load even if one ran before the build
     return load() is not None
 
 
@@ -76,7 +80,7 @@ def load() -> ctypes.CDLL | None:
     """The already-built library, or None (no compile happens here).
 
     Disable entirely with FOREMAST_NATIVE=0."""
-    global _lib, _tried
+    global _lib, _tried, _mapped
     if os.environ.get("FOREMAST_NATIVE", "") == "0":
         return None
     with _lock:
@@ -90,6 +94,7 @@ def load() -> ctypes.CDLL | None:
         except OSError as e:
             log.warning("could not load %s: %s", _LIB_PATH, e)
             return None
+        _mapped = True
         lib.fp_abi_version.restype = ctypes.c_int32
         if lib.fp_abi_version() != ABI_VERSION:
             # Do NOT rebuild here: the stale object is mapped into this

@@ -4,6 +4,8 @@ from foremast_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
     data_sharding,
+    init_distributed,
+    make_global_mesh,
     make_mesh,
     pad_to_multiple,
     replicated,
@@ -25,6 +27,8 @@ __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
     "data_sharding",
+    "init_distributed",
+    "make_global_mesh",
     "make_mesh",
     "pad_to_multiple",
     "replicated",

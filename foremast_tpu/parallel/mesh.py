@@ -66,3 +66,80 @@ def shard_leading(tree, mesh: Mesh):
 def pad_to_multiple(n: int, k: int) -> int:
     """Smallest multiple of k >= n (batch padding for even sharding)."""
     return ((n + k - 1) // k) * k
+
+
+# ---------------------------------------------------------------------------
+# Multi-host (the reference's NCCL/MPI-equivalent layer, SURVEY.md §2.8:
+# its distribution is shared-nothing pods over HTTP/ES; ours is XLA
+# collectives over ICI within a slice and DCN across slices)
+# ---------------------------------------------------------------------------
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize jax.distributed for multi-host meshes.
+
+    No-op (returns False) when single-process: explicit args win, then the
+    standard cluster envs (JAX_COORDINATOR_ADDRESS / NUM_PROCESSES /
+    PROCESS_ID, or a TPU pod's metadata which jax auto-detects). Safe to
+    call twice. After this, `jax.devices()` is global and `make_mesh`
+    spans all hosts.
+    """
+    import os
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    num_processes = num_processes if num_processes is not None else (
+        int(env_np) if env_np else None
+    )
+    env_pid = os.environ.get("JAX_PROCESS_ID")
+    process_id = process_id if process_id is not None else (
+        int(env_pid) if env_pid else None
+    )
+    if coordinator_address is None and num_processes is None:
+        return False  # single-host: nothing to coordinate
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # idempotent ONLY for the already-initialized case; a connect or
+        # barrier failure must surface — swallowing it would leave this
+        # process on a local-only "global" mesh while its peers hang at
+        # the init barrier
+        if "already initialized" not in str(e).lower():
+            raise
+    return True
+
+
+def make_global_mesh(n_model: int = 1) -> Mesh:
+    """A (data, model) mesh over ALL hosts' devices.
+
+    Axis order puts `data` outermost so the batch axis crosses DCN (pure
+    DP needs no inter-chip traffic there — each host scores its slice and
+    only verdict gathers cross hosts) while `model` stays inside a host's
+    ICI domain where tensor-parallel collectives are cheap. This is the
+    scaling-book recipe: collectives ride ICI, DCN only sees the
+    embarrassingly-parallel axis.
+    """
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    if n_model > 1:
+        local = [d for d in devs if d.process_index == devs[0].process_index]
+        # groups of n_model consecutive devices form the model axis
+        # (row-major reshape), so each host's device count must divide
+        # cleanly or a group would straddle hosts and its collectives
+        # would ride DCN
+        if n_model > len(local) or len(local) % n_model != 0:
+            raise ValueError(
+                f"model axis {n_model} must evenly divide the {len(local)} "
+                "devices of a single host — tensor parallelism must stay "
+                "inside ICI"
+            )
+    return make_mesh(n_model=n_model, devices=devs)

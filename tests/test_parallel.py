@@ -155,3 +155,29 @@ def test_lstm_ae_train_step_sharded(mesh_2d):
     # params actually updated
     diff = jax.tree.map(lambda a, b_: float(jnp.abs(a - b_).max()), params, p2)
     assert max(jax.tree.leaves(diff)) > 0
+
+
+def test_make_global_mesh_single_host():
+    from foremast_tpu.parallel.mesh import make_global_mesh
+
+    mesh = make_global_mesh()
+    assert mesh.shape["data"] == jax.device_count()
+    assert mesh.shape["model"] == 1
+    mesh2 = make_global_mesh(n_model=2)
+    assert mesh2.shape["model"] == 2
+    assert mesh2.shape["data"] == jax.device_count() // 2
+
+
+def test_make_global_mesh_model_axis_exceeds_host_fails(monkeypatch):
+    from foremast_tpu.parallel.mesh import make_global_mesh
+
+    with pytest.raises(ValueError, match="single host"):
+        make_global_mesh(n_model=jax.device_count() * 2)
+
+
+def test_init_distributed_single_host_noop(monkeypatch):
+    from foremast_tpu.parallel.mesh import init_distributed
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    assert init_distributed() is False
