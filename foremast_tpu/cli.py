@@ -192,10 +192,13 @@ def cmd_worker(args: argparse.Namespace) -> int:
 
     judge = None
     if args.sharded:
+        from foremast_tpu.engine.multivariate import MultivariateJudge
         from foremast_tpu.parallel import ShardedJudge, init_distributed, make_global_mesh
 
         init_distributed()  # no-op single-host; JAX_COORDINATOR_* envs for pods
-        judge = ShardedJudge(config, mesh=make_global_mesh())
+        judge = MultivariateJudge(
+            config, univariate=ShardedJudge(config, mesh=make_global_mesh())
+        )
     on_verdict = None
     if args.gauge_port:
         gauges = BrainGauges()

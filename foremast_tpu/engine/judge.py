@@ -51,6 +51,9 @@ class MetricTask:
     cur_values: np.ndarray
     base_times: np.ndarray | None = None
     base_values: np.ndarray | None = None
+    # stable service identity (job ids change per run); keys the
+    # per-service model cache in the multivariate judge
+    app: str = ""
 
     def __post_init__(self):
         if (self.base_times is None) != (self.base_values is None):

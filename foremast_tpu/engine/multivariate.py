@@ -1,0 +1,376 @@
+"""Multivariate job judgment — the reference's metric-count model rule.
+
+Reference model zoo (`docs/guides/design.md:57-93`): 1 metric -> the
+univariate forecasters; 2 metrics -> Bivariate Normal Distribution; 3+
+metrics -> Deep Learning (LSTM). The brain selects via its AI_MODEL
+registry; here the same selection is explicit:
+
+  * `ML_ALGORITHM=auto`             -> by metric count (the design.md rule)
+  * `ML_ALGORITHM=bivariate_normal` -> joint 2-metric judgment (pairs only)
+  * `ML_ALGORITHM=lstm_autoencoder` -> joint judgment for 2+ metrics
+  * anything else                   -> univariate per-metric (HealthJudge)
+
+Joint detectors align the job's metrics on common timestamps (a joint
+observation needs every coordinate), judge the joint series, and
+attribute flagged timestamps back to every alias in the job (the wire
+format is per-alias anomaly pairs, `Barrelman.go:593-620`). Per-alias
+gauge bounds stay meaningful via marginal mean +/- threshold * sigma.
+
+LSTM-AE fleets are trained per (app, alias-set) with a bounded
+`ModelCache` (`MAX_CACHE_SIZE`, `foremast-brain/README.md:30`) so repeat
+judgments of the same service skip training.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from foremast_tpu.config import BrainConfig
+from foremast_tpu.engine import scoring
+from foremast_tpu.engine.judge import HealthJudge, MetricTask, MetricVerdict, bucket_length
+from foremast_tpu.models.bivariate import detect_bivariate, fit_bivariate
+from foremast_tpu.models.cache import ModelCache
+from foremast_tpu.models.lstm_ae import LSTMAEConfig, fit_many, score_many
+
+log = logging.getLogger("foremast_tpu.engine.multivariate")
+
+ALGO_BIVARIATE = "bivariate_normal"
+ALGO_LSTM = "lstm_autoencoder"
+ALGO_AUTO = "auto"
+MULTIVARIATE_ALGOS = frozenset({ALGO_BIVARIATE, ALGO_LSTM, ALGO_AUTO})
+
+# Univariate fallback when a multivariate algorithm is configured but the
+# job's metric count doesn't fit (e.g. a 1-metric job under `auto`) — the
+# reference's deployed default (`foremast-brain.yaml:24-25`).
+FALLBACK_UNIVARIATE = "moving_average_all"
+
+
+def select_mode(algorithm: str, n_metrics: int) -> str:
+    """'univariate' | 'bivariate' | 'lstm' for a job with n_metrics."""
+    if algorithm == ALGO_AUTO:
+        if n_metrics <= 1:
+            return "univariate"
+        return "bivariate" if n_metrics == 2 else "lstm"
+    if algorithm == ALGO_BIVARIATE:
+        return "bivariate" if n_metrics == 2 else "univariate"
+    if algorithm == ALGO_LSTM:
+        return "lstm" if n_metrics >= 2 else "univariate"
+    return "univariate"
+
+
+def _align(tasks: list[MetricTask], which: str) -> tuple[np.ndarray, np.ndarray]:
+    """Common timestamps + stacked values [F, n] for one job's window set.
+
+    which: 'hist' or 'cur'. Joint observations exist only where every
+    metric has a sample.
+    """
+    times = [np.asarray(getattr(t, f"{which}_times"), np.int64) for t in tasks]
+    vals = [np.asarray(getattr(t, f"{which}_values"), np.float32) for t in tasks]
+    common = times[0]
+    for t in times[1:]:
+        common = np.intersect1d(common, t, assume_unique=False)
+    if len(common) == 0:
+        return common, np.zeros((len(tasks), 0), np.float32)
+    cols = []
+    for t, v in zip(times, vals):
+        # first occurrence per timestamp (times may repeat in raw traces)
+        order = np.argsort(t, kind="stable")
+        ts = t[order]
+        idx = np.searchsorted(ts, common)
+        cols.append(v[order][idx])
+    return common, np.stack(cols, axis=0)
+
+
+def _marginal_bounds(hist: np.ndarray, threshold: float, tc: int):
+    """Per-metric constant gauge bounds from historical moments.
+
+    hist [F, n] -> (upper [F, tc], lower [F, tc]) — mean +/- thr*sigma,
+    the same semantics every univariate detector publishes."""
+    if hist.shape[1] == 0:
+        z = np.zeros((hist.shape[0], tc), np.float32)
+        return z, z
+    mu = hist.mean(axis=1)
+    sd = hist.std(axis=1)
+    up = np.repeat((mu + threshold * sd)[:, None], tc, axis=1).astype(np.float32)
+    lo = np.repeat(
+        np.maximum(mu - threshold * sd, 0.0)[:, None], tc, axis=1
+    ).astype(np.float32)
+    return up, lo
+
+
+def _pack(rows: list[np.ndarray], length: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Ragged rows -> ([B, length] values, [B, length] mask)."""
+    b = len(rows)
+    out = np.zeros((b, length), np.float32)
+    mask = np.zeros((b, length), bool)
+    for i, r in enumerate(rows):
+        n = min(len(r), length)
+        out[i, :n] = r[:n]
+        mask[i, :n] = True
+    return jnp.asarray(out), jnp.asarray(mask)
+
+
+@dataclasses.dataclass
+class _JointJob:
+    tasks: list[MetricTask]
+    hist_t: np.ndarray
+    hist_v: np.ndarray  # [F, nh]
+    cur_t: np.ndarray
+    cur_v: np.ndarray  # [F, nc]
+
+
+class MultivariateJudge:
+    """Dispatcher: routes each job to univariate/bivariate/LSTM judgment.
+
+    Drop-in for HealthJudge at the worker level: same
+    `judge(tasks) -> [MetricVerdict]` surface over the flat task list.
+    """
+
+    def __init__(
+        self,
+        config: BrainConfig | None = None,
+        univariate: HealthJudge | None = None,
+        cache: ModelCache | None = None,
+    ):
+        self.config = config or BrainConfig()
+        uni_cfg = self.config
+        if self.config.algorithm in MULTIVARIATE_ALGOS:
+            uni_cfg = dataclasses.replace(self.config, algorithm=FALLBACK_UNIVARIATE)
+        self.univariate = univariate or HealthJudge(uni_cfg)
+        if self.univariate.config.algorithm in MULTIVARIATE_ALGOS:
+            # an injected judge (e.g. ShardedJudge) built from the raw
+            # config must not hand a multivariate algorithm name to the
+            # univariate scoring program
+            self.univariate.config = uni_cfg
+        self.cache = cache or ModelCache(self.config.max_cache_size)
+        self.lstm_steps = int(os.environ.get("FOREMAST_LSTM_STEPS", "60"))
+
+    # -- public ----------------------------------------------------------
+
+    def judge(self, tasks: list[MetricTask]) -> list[MetricVerdict]:
+        if not tasks:
+            return []
+        by_job: dict[str, list[MetricTask]] = {}
+        for t in tasks:
+            by_job.setdefault(t.job_id, []).append(t)
+
+        uni: list[MetricTask] = []
+        bi: list[list[MetricTask]] = []
+        lstm: list[list[MetricTask]] = []
+        for job_tasks in by_job.values():
+            mode = select_mode(self.config.algorithm, len(job_tasks))
+            if mode == "bivariate":
+                bi.append(job_tasks)
+            elif mode == "lstm":
+                lstm.append(job_tasks)
+            else:
+                uni.extend(job_tasks)
+
+        out: list[MetricVerdict] = []
+        if uni:
+            out.extend(self.univariate.judge(uni))
+        if bi:
+            out.extend(self._judge_bivariate(bi))
+        if lstm:
+            out.extend(self._judge_lstm(lstm))
+        return out
+
+    # -- shared helpers --------------------------------------------------
+
+    def _joint(self, job_tasks: list[MetricTask]) -> _JointJob:
+        ht, hv = _align(job_tasks, "hist")
+        ct, cv = _align(job_tasks, "cur")
+        return _JointJob(job_tasks, ht, hv, ct, cv)
+
+    def _unknown(self, job_tasks: list[MetricTask]) -> list[MetricVerdict]:
+        return [
+            MetricVerdict(
+                job_id=t.job_id,
+                alias=t.alias,
+                verdict=scoring.UNKNOWN,
+                anomaly_pairs=[],
+                upper=np.zeros(len(t.cur_values), np.float32),
+                lower=np.zeros(len(t.cur_values), np.float32),
+                p_value=1.0,
+                dist_differs=False,
+            )
+            for t in job_tasks
+        ]
+
+    def _emit(
+        self,
+        job: _JointJob,
+        flags: np.ndarray,  # [nc] bool over the aligned current points
+        threshold: float,
+    ) -> list[MetricVerdict]:
+        """Joint flags -> per-alias verdicts in the reference wire form."""
+        flagged_times = job.cur_t[flags]
+        verdict = scoring.UNHEALTHY if flags.any() else scoring.HEALTHY
+        up, lo = _marginal_bounds(job.hist_v, threshold, max(len(job.cur_t), 1))
+        out = []
+        for f, t in enumerate(job.tasks):
+            # pairs carry each alias's own measured value at the joint
+            # anomalous timestamps
+            vals = job.cur_v[f][flags]
+            pairs: list[float] = []
+            for ts, v in zip(flagged_times, vals):
+                pairs.extend([float(ts), float(v)])
+            out.append(
+                MetricVerdict(
+                    job_id=t.job_id,
+                    alias=t.alias,
+                    verdict=verdict,
+                    anomaly_pairs=pairs,
+                    upper=up[f],
+                    lower=lo[f],
+                    p_value=1.0,  # pairwise tests are a univariate concept
+                    dist_differs=False,
+                )
+            )
+        return out
+
+    # -- bivariate -------------------------------------------------------
+
+    def _judge_bivariate(self, jobs: list[list[MetricTask]]) -> list[MetricVerdict]:
+        threshold = self.config.anomaly.rule_for(None).threshold
+        min_pts = self.config.min_historical_points
+        joints, out = [], []
+        for job_tasks in jobs:
+            j = self._joint(job_tasks)
+            if len(j.hist_t) < min_pts or len(j.cur_t) == 0:
+                out.extend(self._unknown(job_tasks))
+            else:
+                joints.append(j)
+        if not joints:
+            return out
+
+        th = bucket_length(max(len(j.hist_t) for j in joints))
+        tc = bucket_length(max(len(j.cur_t) for j in joints))
+        hx, hm = _pack([j.hist_v[0] for j in joints], th)
+        hy, _ = _pack([j.hist_v[1] for j in joints], th)
+        cx, cm = _pack([j.cur_v[0] for j in joints], tc)
+        cy, _ = _pack([j.cur_v[1] for j in joints], tc)
+
+        fit = fit_bivariate(hx, hy, hm, min_points=min_pts)
+        flags = np.asarray(detect_bivariate(fit, cx, cy, cm, threshold))
+        valid = np.asarray(fit.valid)
+        for i, j in enumerate(joints):
+            if not valid[i]:
+                out.extend(self._unknown(j.tasks))
+            else:
+                out.extend(self._emit(j, flags[i, : len(j.cur_t)], threshold))
+        return out
+
+    # -- LSTM autoencoder ------------------------------------------------
+
+    def _judge_lstm(self, jobs: list[list[MetricTask]]) -> list[MetricVerdict]:
+        threshold = self.config.anomaly.rule_for(None).threshold
+        min_pts = self.config.min_historical_points
+        out: list[MetricVerdict] = []
+        # group by (feature count, per-JOB window bucket): fit_many needs
+        # uniform [S, W, T, F], and using a group-wide max tc would let one
+        # long-current job starve a short-history job into all-masked
+        # training windows (mu=sd=0 -> everything flags)
+        groups: dict[tuple[int, int], list[_JointJob]] = {}
+        for job_tasks in jobs:
+            j = self._joint(job_tasks)
+            f = j.hist_v.shape[0]
+            tc = bucket_length(max(len(j.cur_t), 1))
+            # the history must fill at least one training window of this
+            # job's own bucket, and clear the configured minimum
+            if len(j.cur_t) == 0 or len(j.hist_t) < max(min_pts, tc):
+                out.extend(self._unknown(job_tasks))
+            else:
+                groups.setdefault((f, tc), []).append(j)
+
+        for (f, tc), joints in groups.items():
+            out.extend(self._judge_lstm_group(joints, f, tc, threshold))
+        return out
+
+    def _judge_lstm_group(
+        self, joints: list[_JointJob], f: int, tc: int, threshold: float
+    ) -> list[MetricVerdict]:
+        cfg = LSTMAEConfig(features=f)
+        # entry per joint job, kept locally — the bounded ModelCache may
+        # evict mid-batch, so never re-read what was just trained
+        entries: dict[int, tuple] = {}
+        to_train: list[_JointJob] = []
+        for j in joints:
+            cached = self.cache.get(self._key(j, tc))
+            if cached is None:
+                to_train.append(j)
+            else:
+                entries[id(j)] = cached
+
+        if to_train:
+            # chop each history into tc-length windows (newest-aligned);
+            # every job has >= 1 real window (admission: hist >= tc), and
+            # shorter histories pad with fully-masked windows
+            n_win = min(max(len(j.hist_t) // tc for j in to_train), 8)
+            xs, ms = [], []
+            for j in to_train:
+                wins, wmask = [], []
+                usable = (len(j.hist_t) // tc) * tc
+                chunks = j.hist_v[:, len(j.hist_t) - usable:].reshape(f, -1, tc)
+                for w in range(min(chunks.shape[1], n_win)):
+                    wins.append(chunks[:, -(w + 1), :].T)  # [tc, F]
+                    wmask.append(np.ones(tc, bool))
+                while len(wins) < n_win:
+                    wins.append(np.zeros((tc, f), np.float32))
+                    wmask.append(np.zeros(tc, bool))
+                xs.append(np.stack(wins))  # [n_win, tc, F]
+                ms.append(np.stack(wmask))
+            x = jnp.asarray(np.stack(xs))  # [S, n_win, tc, F]
+            mask = jnp.asarray(np.stack(ms))
+            params, mu, sd, _ = fit_many(
+                jax.random.key(0), x, mask, cfg, steps=self.lstm_steps
+            )
+            mu_np, sd_np = np.asarray(mu), np.asarray(sd)
+            for i, j in enumerate(to_train):
+                leaf = jax.tree.map(lambda a, i=i: a[i], params)
+                entry = (leaf, float(mu_np[i]), float(sd_np[i]))
+                entries[id(j)] = entry
+                self.cache.put(self._key(j, tc), entry)
+
+        # score every joint job against its (possibly cached) model
+        out: list[MetricVerdict] = []
+        ordered = [entries[id(j)] for j in joints]
+        stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *[e[0] for e in ordered])
+        mu = jnp.asarray([e[1] for e in ordered])
+        sd = jnp.asarray([e[2] for e in ordered])
+        cur_rows = []
+        cur_masks = []
+        for j in joints:
+            row = np.zeros((tc, f), np.float32)
+            n = min(len(j.cur_t), tc)
+            row[:n] = j.cur_v[:, :n].T
+            m = np.zeros(tc, bool)
+            m[:n] = True
+            cur_rows.append(row[None])  # [1, tc, F]
+            cur_masks.append(m[None])
+        xq = jnp.asarray(np.stack(cur_rows))  # [S, 1, tc, F]
+        mq = jnp.asarray(np.stack(cur_masks))
+        flags, _err = score_many(stacked, xq, mq, mu, sd, threshold)
+        flags = np.asarray(flags)[:, 0, :]  # [S, tc]
+        for i, j in enumerate(joints):
+            out.extend(self._emit(j, flags[i, : len(j.cur_t)], threshold))
+        return out
+
+    def _key(self, j: _JointJob, tc: int) -> tuple:
+        # per (app, aliases, feature-count, window-bucket): job ids differ
+        # per run, but different SERVICES with the same standard alias set
+        # (the instrument starter emits identical names for every app)
+        # must never share a model
+        return (
+            "lstm",
+            j.tasks[0].app,
+            tuple(t.alias for t in j.tasks),
+            j.hist_v.shape[0],
+            tc,
+        )

@@ -74,7 +74,14 @@ class BrainWorker:
         self.store = store
         self.source = source
         self.config = config or BrainConfig()
-        self.judge = judge or HealthJudge(self.config)
+        if judge is None:
+            # MultivariateJudge dispatches by metric count (design.md:57-93:
+            # 1 -> univariate, 2 -> bivariate normal, 3+ -> LSTM) and
+            # delegates univariate jobs to a plain HealthJudge
+            from foremast_tpu.engine.multivariate import MultivariateJudge
+
+            judge = MultivariateJudge(self.config)
+        self.judge = judge
         self.worker_id = worker_id or f"brain-{uuid.uuid4().hex[:8]}"
         self.claim_limit = claim_limit
         self.on_verdict = on_verdict  # gauge-export hook (observe/)
@@ -110,6 +117,7 @@ class BrainWorker:
                         hist_values=hv,
                         cur_times=ct,
                         cur_values=cv,
+                        app=doc.app_name,
                         **kw,
                     )
                 )

@@ -1,0 +1,212 @@
+"""Multivariate dispatch tests: metric-count rule, bivariate + LSTM joints."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from foremast_tpu.config import BrainConfig
+from foremast_tpu.engine import scoring
+from foremast_tpu.engine.judge import MetricTask
+from foremast_tpu.engine.multivariate import (
+    ALGO_AUTO,
+    ALGO_BIVARIATE,
+    ALGO_LSTM,
+    MultivariateJudge,
+    select_mode,
+)
+
+
+def _task(job, alias, hist_v, cur_v, t0=1_700_000_000, step=60):
+    hist_t = t0 + step * np.arange(len(hist_v), dtype=np.int64)
+    cur_t = t0 + step * (len(hist_v) + np.arange(len(cur_v), dtype=np.int64))
+    return MetricTask(
+        job_id=job,
+        alias=alias,
+        metric_type=None,
+        hist_times=hist_t,
+        hist_values=np.asarray(hist_v, np.float32),
+        cur_times=cur_t,
+        cur_values=np.asarray(cur_v, np.float32),
+    )
+
+
+def test_select_mode_rule():
+    assert select_mode(ALGO_AUTO, 1) == "univariate"
+    assert select_mode(ALGO_AUTO, 2) == "bivariate"
+    assert select_mode(ALGO_AUTO, 3) == "lstm"
+    assert select_mode(ALGO_AUTO, 4) == "lstm"
+    assert select_mode(ALGO_BIVARIATE, 2) == "bivariate"
+    assert select_mode(ALGO_BIVARIATE, 3) == "univariate"
+    assert select_mode(ALGO_LSTM, 2) == "lstm"
+    assert select_mode(ALGO_LSTM, 1) == "univariate"
+    assert select_mode("moving_average_all", 5) == "univariate"
+
+
+def _correlated(rng, n, rho=0.9):
+    x = rng.normal(1.0, 0.2, n)
+    y = rho * x + np.sqrt(1 - rho**2) * rng.normal(0.0, 0.2, n) + 1.0
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def test_bivariate_joint_detects_correlation_break():
+    """A point normal in each marginal but off the correlation ridge must
+    flag jointly — the capability univariate scoring cannot provide."""
+    rng = np.random.default_rng(0)
+    hx, hy = _correlated(rng, 400)
+    cx, cy = _correlated(rng, 20)
+    # break the ridge at one point: both values in-range marginally
+    cx[10], cy[10] = float(np.max(hx)) * 0.95, float(np.min(hy)) * 1.05
+
+    cfg = BrainConfig(algorithm=ALGO_BIVARIATE)
+    judge = MultivariateJudge(cfg)
+    t1 = _task("j1", "latency", hx, cx)
+    t2 = _task("j1", "tps", hy, cy)
+    verdicts = judge.judge([t1, t2])
+    assert len(verdicts) == 2
+    assert all(v.verdict == scoring.UNHEALTHY for v in verdicts)
+    # both aliases carry the SAME flagged timestamp with their own values
+    ts1 = verdicts[0].anomaly_pairs[0::2]
+    ts2 = verdicts[1].anomaly_pairs[0::2]
+    assert ts1 == ts2
+    assert float(t1.cur_times[10]) in ts1
+
+
+def test_bivariate_healthy_on_ridge():
+    rng = np.random.default_rng(1)
+    hx, hy = _correlated(rng, 400)
+    cx, cy = _correlated(rng, 20)
+    cfg = BrainConfig(algorithm=ALGO_BIVARIATE)
+    # threshold high enough to ignore sampling noise
+    cfg = dataclasses.replace(
+        cfg, anomaly=dataclasses.replace(cfg.anomaly, threshold=6.0, rules=())
+    )
+    verdicts = MultivariateJudge(cfg).judge(
+        [_task("j1", "a", hx, cx), _task("j1", "b", hy, cy)]
+    )
+    assert all(v.verdict == scoring.HEALTHY for v in verdicts)
+
+
+def test_bivariate_insufficient_history_unknown():
+    cfg = BrainConfig(algorithm=ALGO_BIVARIATE)
+    verdicts = MultivariateJudge(cfg).judge(
+        [_task("j1", "a", [1.0, 2.0], [1.0]), _task("j1", "b", [1.0, 2.0], [1.0])]
+    )
+    assert all(v.verdict == scoring.UNKNOWN for v in verdicts)
+
+
+def test_auto_mixes_modes_per_job():
+    """auto: a 1-metric job goes univariate, a 2-metric job bivariate."""
+    rng = np.random.default_rng(2)
+    hx, hy = _correlated(rng, 300)
+    cfg = BrainConfig(algorithm=ALGO_AUTO)
+    judge = MultivariateJudge(cfg)
+    tasks = [
+        _task("solo", "latency", hx, hx[:10]),
+        _task("pair", "a", hx, hx[:10]),
+        _task("pair", "b", hy, hy[:10]),
+    ]
+    verdicts = judge.judge(tasks)
+    assert {v.job_id for v in verdicts} == {"solo", "pair"}
+    assert len(verdicts) == 3
+
+
+def test_lstm_joint_flags_spike_and_caches():
+    rng = np.random.default_rng(3)
+    f = 3
+    hist = rng.normal(0.5, 0.05, size=(f, 240)).astype(np.float32)
+    cur = rng.normal(0.5, 0.05, size=(f, 12)).astype(np.float32)
+    cur_spiked = cur.copy()
+    cur_spiked[:, 6] = 10.0  # joint spike across all metrics
+
+    cfg = BrainConfig(algorithm=ALGO_LSTM)
+    judge = MultivariateJudge(cfg)
+    judge.lstm_steps = 30  # keep the test fast
+
+    tasks = [_task("jl", f"m{i}", hist[i], cur_spiked[i]) for i in range(f)]
+    verdicts = judge.judge(tasks)
+    assert len(verdicts) == f
+    assert all(v.verdict == scoring.UNHEALTHY for v in verdicts)
+    spike_t = float(tasks[0].cur_times[6])
+    for v in verdicts:
+        assert spike_t in v.anomaly_pairs[0::2]
+
+    assert len(judge.cache) == 1  # model cached by (aliases, F, bucket)
+
+    # clean window scores healthy against the CACHED model (no retrain)
+    judge.lstm_steps = 10**9  # would hang if training ran again
+    tasks2 = [_task("jl2", f"m{i}", hist[i], cur[i]) for i in range(f)]
+    verdicts2 = judge.judge(tasks2)
+    assert all(v.verdict == scoring.HEALTHY for v in verdicts2)
+
+
+def test_lstm_cache_is_per_app():
+    """Two SERVICES with the identical standard alias set must not share
+    a model (the starter gives every app the same metric names)."""
+    rng = np.random.default_rng(4)
+    hist = rng.normal(0.5, 0.05, size=(3, 240)).astype(np.float32)
+    cur = rng.normal(0.5, 0.05, size=(3, 12)).astype(np.float32)
+    cfg = BrainConfig(algorithm=ALGO_LSTM)
+    judge = MultivariateJudge(cfg)
+    judge.lstm_steps = 5
+
+    def tasks(job, app):
+        return [
+            dataclasses.replace(_task(job, f"m{i}", hist[i], cur[i]), app=app)
+            for i in range(3)
+        ]
+
+    judge.judge(tasks("ja", "app-a"))
+    judge.judge(tasks("jb", "app-b"))
+    assert len(judge.cache) == 2
+
+
+def test_lstm_short_history_job_not_poisoned_by_long_group_peer():
+    """A short-history job batched with a long-current job must not train
+    on all-masked windows (mu=sd=0 would flag every clean point)."""
+    rng = np.random.default_rng(5)
+    short_h = rng.normal(0.5, 0.05, size=(3, 30)).astype(np.float32)
+    short_c = rng.normal(0.5, 0.05, size=(3, 12)).astype(np.float32)
+    long_h = rng.normal(0.5, 0.05, size=(3, 600)).astype(np.float32)
+    long_c = rng.normal(0.5, 0.05, size=(3, 100)).astype(np.float32)
+
+    cfg = BrainConfig(algorithm=ALGO_LSTM)
+    judge = MultivariateJudge(cfg)
+    judge.lstm_steps = 30
+    tasks = [_task("short", f"m{i}", short_h[i], short_c[i]) for i in range(3)]
+    tasks += [
+        dataclasses.replace(_task("long", f"m{i}", long_h[i], long_c[i]), app="other")
+        for i in range(3)
+    ]
+    verdicts = judge.judge(tasks)
+    short_vs = [v for v in verdicts if v.job_id == "short"]
+    assert short_vs and all(v.verdict != scoring.UNHEALTHY for v in short_vs)
+
+
+def test_lstm_mid_batch_cache_eviction_does_not_crash():
+    """More distinct alias sets than max_cache_size in ONE batch must not
+    lose entries before scoring."""
+    rng = np.random.default_rng(6)
+    cfg = dataclasses.replace(BrainConfig(algorithm=ALGO_LSTM), max_cache_size=1)
+    judge = MultivariateJudge(cfg)
+    judge.lstm_steps = 5
+    tasks = []
+    for job in ("j1", "j2"):
+        hist = rng.normal(0.5, 0.05, size=(3, 240)).astype(np.float32)
+        cur = rng.normal(0.5, 0.05, size=(3, 12)).astype(np.float32)
+        tasks += [
+            dataclasses.replace(_task(job, f"m{i}", hist[i], cur[i]), app=job)
+            for i in range(3)
+        ]
+    verdicts = judge.judge(tasks)  # must not raise
+    assert len(verdicts) == 6
+
+
+def test_worker_uses_multivariate_judge_by_default():
+    from foremast_tpu.jobs.store import InMemoryStore
+    from foremast_tpu.jobs.worker import BrainWorker
+    from foremast_tpu.metrics.source import ReplaySource
+    from foremast_tpu.engine.multivariate import MultivariateJudge
+
+    w = BrainWorker(InMemoryStore(), ReplaySource(), BrainConfig())
+    assert isinstance(w.judge, MultivariateJudge)
