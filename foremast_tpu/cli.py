@@ -190,15 +190,50 @@ def cmd_worker(args: argparse.Namespace) -> int:
     config = BrainConfig.from_env()
     store = _make_store(args.elastic_url)
 
-    judge = None
+    from foremast_tpu.engine.multivariate import MultivariateJudge
+
+    univariate = None
     if args.sharded:
-        from foremast_tpu.engine.multivariate import MultivariateJudge
         from foremast_tpu.parallel import ShardedJudge, init_distributed, make_global_mesh
 
+        # MUST run before any jax computation — including an orbax restore
         init_distributed()  # no-op single-host; JAX_COORDINATOR_* envs for pods
-        judge = MultivariateJudge(
-            config, univariate=ShardedJudge(config, mesh=make_global_mesh())
-        )
+        univariate = ShardedJudge(config, mesh=make_global_mesh())
+    judge = MultivariateJudge(config, univariate=univariate)
+
+    ckpt_path = None
+    if args.model_cache_dir:
+        import jax as _jax
+
+        if _jax.process_count() > 1:
+            # orbax save/restore is a cross-process collective; each host's
+            # cache is independent (shared-nothing job claims), so a shared
+            # checkpoint would both collide and deadlock the idle barrier
+            print(
+                "model-cache checkpointing disabled under multi-host "
+                "(per-host caches stay in memory)",
+                file=sys.stderr,
+            )
+        else:
+            import ast
+            import os as _os
+
+            ckpt_path = _os.path.abspath(
+                _os.path.join(args.model_cache_dir, "model_cache")
+            )
+            if _os.path.exists(ckpt_path):
+                try:
+                    n = judge.cache.load(ckpt_path, key_parser=ast.literal_eval)
+                    print(
+                        f"restored {n} cached models from {ckpt_path}",
+                        file=sys.stderr,
+                    )
+                except Exception as e:  # noqa: BLE001 - stale/corrupt checkpoint
+                    print(
+                        f"model-cache restore failed ({e}); starting cold",
+                        file=sys.stderr,
+                    )
+
     on_verdict = None
     if args.gauge_port:
         gauges = BrainGauges()
@@ -207,7 +242,21 @@ def cmd_worker(args: argparse.Namespace) -> int:
     worker = BrainWorker(
         store, PrometheusSource(), config=config, judge=judge, on_verdict=on_verdict
     )
-    worker.run(poll_seconds=args.poll)
+
+    after_tick = None
+    if ckpt_path:
+        state = {"dirty": False}
+
+        def after_tick(n, _state=state):
+            # checkpoint when work happened, on the following idle tick —
+            # so saves never add latency to a busy scoring cycle
+            if n > 0:
+                _state["dirty"] = True
+            elif _state["dirty"]:
+                judge.cache.save(ckpt_path)
+                _state["dirty"] = False
+
+    worker.run(poll_seconds=args.poll, after_tick=after_tick)
     return 0
 
 
@@ -311,6 +360,12 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="score over the full device mesh (multi-host via "
         "JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID)",
+    )
+    p.add_argument(
+        "--model-cache-dir",
+        default=None,
+        help="orbax-checkpoint trained models here (warm restart skips "
+        "LSTM retraining); restored on startup",
     )
     p.add_argument(
         "--gauge-port",

@@ -36,7 +36,13 @@ from foremast_tpu.engine import scoring
 from foremast_tpu.engine.judge import HealthJudge, MetricTask, MetricVerdict, bucket_length
 from foremast_tpu.models.bivariate import detect_bivariate, fit_bivariate
 from foremast_tpu.models.cache import ModelCache
-from foremast_tpu.models.lstm_ae import LSTMAEConfig, fit_many, score_many
+from foremast_tpu.models.lstm_ae import (
+    AEParams,
+    LSTMAEConfig,
+    LSTMParams,
+    fit_many,
+    score_many,
+)
 
 log = logging.getLogger("foremast_tpu.engine.multivariate")
 
@@ -114,6 +120,30 @@ def _pack(rows: list[np.ndarray], length: int) -> tuple[jnp.ndarray, jnp.ndarray
         out[i, :n] = r[:n]
         mask[i, :n] = True
     return jnp.asarray(out), jnp.asarray(mask)
+
+
+def _coerce_entry(entry) -> tuple:
+    """Normalize a cache entry to (AEParams, float, float).
+
+    Orbax restores NamedTuple pytrees as plain dicts and tuples as lists
+    (models/cache.py load); scoring stacks entries with jax.tree.map, so
+    every entry must share the exact AEParams structure."""
+    params, mu, sd = entry[0], entry[1], entry[2]
+    if isinstance(params, AEParams):
+        return entry if isinstance(entry, tuple) else (params, float(mu), float(sd))
+
+    def lstm(d) -> LSTMParams:
+        return LSTMParams(
+            w_x=jnp.asarray(d["w_x"]), w_h=jnp.asarray(d["w_h"]), b=jnp.asarray(d["b"])
+        )
+
+    params = AEParams(
+        enc=lstm(params["enc"]),
+        dec=lstm(params["dec"]),
+        w_out=jnp.asarray(params["w_out"]),
+        b_out=jnp.asarray(params["b_out"]),
+    )
+    return (params, float(mu), float(sd))
 
 
 @dataclasses.dataclass
@@ -306,7 +336,10 @@ class MultivariateJudge:
             if cached is None:
                 to_train.append(j)
             else:
-                entries[id(j)] = cached
+                entry = _coerce_entry(cached)
+                if entry is not cached:  # orbax-restored form: fix once
+                    self.cache.put(self._key(j, tc), entry)
+                entries[id(j)] = entry
 
         if to_train:
             # chop each history into tc-length windows (newest-aligned);

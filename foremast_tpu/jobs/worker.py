@@ -204,9 +204,22 @@ class BrainWorker:
                     log.exception("on_verdict hook failed for %s", doc.id)
         return len(docs)
 
-    def run(self, poll_seconds: float = 5.0, stop: Callable[[], bool] | None = None):
-        """Poll forever (the shared-nothing worker loop, design.md:35-43)."""
+    def run(
+        self,
+        poll_seconds: float = 5.0,
+        stop: Callable[[], bool] | None = None,
+        after_tick: Callable[[int], None] | None = None,
+    ):
+        """Poll forever (the shared-nothing worker loop, design.md:35-43).
+
+        `after_tick(n_processed)` runs after every cycle — the hook for
+        periodic model-cache checkpointing and similar housekeeping."""
         while not (stop and stop()):
             n = self.tick()
+            if after_tick:
+                try:
+                    after_tick(n)
+                except Exception:
+                    log.exception("after_tick hook failed")
             if n == 0:
                 time.sleep(poll_seconds)

@@ -2,7 +2,8 @@
 
 Registers the seasonal (Prophet-substitute) model into the engine's
 AI_MODEL registry; the LSTM-AE and bivariate detectors have train/fit
-interfaces of their own and are dispatched explicitly by the worker.
+interfaces of their own and are dispatched by metric count in
+`engine/multivariate.MultivariateJudge` (the worker's default judge).
 """
 
 from functools import partial

@@ -202,6 +202,40 @@ def test_lstm_mid_batch_cache_eviction_does_not_crash():
     assert len(verdicts) == 6
 
 
+def test_lstm_cache_warm_restart_via_checkpoint(tmp_path):
+    """save -> load in a fresh judge must score WITHOUT retraining, even
+    though orbax restores NamedTuples as dicts."""
+    import ast
+
+    from foremast_tpu.models.cache import ModelCache
+
+    rng = np.random.default_rng(7)
+    hist = rng.normal(0.5, 0.05, size=(3, 240)).astype(np.float32)
+    cur = rng.normal(0.5, 0.05, size=(3, 12)).astype(np.float32)
+    cfg = BrainConfig(algorithm=ALGO_LSTM)
+
+    judge = MultivariateJudge(cfg)
+    judge.lstm_steps = 20
+    tasks = [_task("j1", f"m{i}", hist[i], cur[i]) for i in range(3)]
+    ref = judge.judge(tasks)  # trains + scores with the in-memory model
+    path = str(tmp_path / "ck")
+    judge.cache.save(path)
+
+    cache2 = ModelCache()
+    assert cache2.load(path, key_parser=ast.literal_eval) == 1
+    judge2 = MultivariateJudge(cfg, cache=cache2)
+    judge2.lstm_steps = 10**9  # would hang if training ran
+    verdicts = judge2.judge(
+        [_task("j2", f"m{i}", hist[i], cur[i]) for i in range(3)]
+    )
+    assert len(verdicts) == 3
+    # the restored model must reproduce the in-memory model's judgment
+    # (same data, same params round-tripped through orbax)
+    for a, b in zip(ref, verdicts):
+        assert a.verdict == b.verdict
+        assert a.anomaly_pairs == b.anomaly_pairs
+
+
 def test_worker_uses_multivariate_judge_by_default():
     from foremast_tpu.jobs.store import InMemoryStore
     from foremast_tpu.jobs.worker import BrainWorker
