@@ -150,13 +150,47 @@ class FileErrorGenerator:
         return total
 
 
-def main() -> None:  # pragma: no cover - manual demo entry point
+def main(argv: list[str] | None = None) -> None:  # pragma: no cover - manual entry
+    """Serve the demo app, optionally with a fault injector.
+
+    Flag parity with the reference demo's JVM flags
+    (`examples/demo/*/demo_v2.yaml`): `-DerrorType=5xx -Dfrequency=6`
+    becomes `--error-type 5xx --frequency 6`; `-Dfilename=data2.txt`
+    becomes `--trace <csv>`.
+    """
+    import argparse
     from wsgiref.simple_server import make_server
 
+    ap = argparse.ArgumentParser(description="instrumented demo workload")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument(
+        "--error-type", choices=["none", "4xx", "5xx"], default="none",
+        help="background error generator target",
+    )
+    ap.add_argument(
+        "--frequency", type=float, default=6.0, help="error requests/sec"
+    )
+    ap.add_argument(
+        "--trace", default=None,
+        help="CSV trace to replay instead of the fixed-rate generator",
+    )
+    args = ap.parse_args(argv)
+
     app, _metrics = make_demo_app()
-    port = 8080
-    print(f"demo app on :{port} (/, /error4xx, /error5xx, /metrics)")
-    make_server("0.0.0.0", port, app).serve_forever()
+    if args.trace:
+        gen = FileErrorGenerator(DemoClient(app), args.trace)
+        threading.Thread(target=gen.replay, daemon=True).start()
+    elif args.error_type != "none":
+        gen = ErrorGenerator(
+            DemoClient(app),
+            error_type=args.error_type,
+            frequency=args.frequency,
+        )
+        threading.Thread(
+            target=gen.run_for, args=(float("inf"),), daemon=True
+        ).start()
+    print(f"demo app on :{args.port} (/, /error4xx, /error5xx, /metrics)")
+    make_server("0.0.0.0", args.port, app).serve_forever()
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -615,6 +615,86 @@ def scrape_config_secret() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Demo workload (reference examples/demo/{rollingUpdate,continuous})
+# ---------------------------------------------------------------------------
+
+DEMO_NAMESPACE = "foremast-examples"
+
+
+def demo_deployment(version: str, args: list[str], continuous: bool = False) -> list[dict]:
+    """demo_v1 (healthy) / demo_v2 (error-injecting) manifests.
+
+    v2's args are the fault injector (reference: `-DerrorType=5xx
+    -Dfrequency=6` in demo_v2.yaml; here the demo module's flags). The
+    rolling-update pair shares one Deployment name so `kubectl apply`ing
+    v2 over v1 IS the canary event; the continuous variant carries the
+    kubectl-watch toggle instead.
+    """
+    name = "demo"
+    c = {
+        "name": name,
+        "image": IMAGE,
+        "imagePullPolicy": "IfNotPresent",
+        "command": ["python", "-m", "foremast_tpu.demo"],
+        "args": args,
+        "ports": [{"containerPort": 8080, "name": "http"}],
+        "resources": {
+            "requests": {"cpu": "100m", "memory": "128Mi"},
+            "limits": {"cpu": "200m", "memory": "256Mi"},
+        },
+    }
+    dep = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": name,
+            "namespace": DEMO_NAMESPACE,
+            "labels": {"app": name, "version": version},
+        },
+        "spec": {
+            "replicas": 2,
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {
+                    "labels": {"app": name, "version": version},
+                    "annotations": {
+                        "prometheus.io/scrape": "true",
+                        "prometheus.io/port": "8080",
+                        "prometheus.io/path": "/metrics",
+                    },
+                },
+                "spec": {"containers": [c]},
+            },
+        },
+    }
+    docs: list[dict] = [
+        {
+            "apiVersion": "v1",
+            "kind": "Namespace",
+            "metadata": {"name": DEMO_NAMESPACE},
+        },
+        dep,
+    ]
+    if continuous:
+        docs.append(
+            {
+                "apiVersion": API_VERSION,
+                "kind": "DeploymentMonitor",
+                "metadata": {"name": name, "namespace": DEMO_NAMESPACE},
+                "spec": {
+                    "selector": {"app": name},
+                    "analyst": {
+                        "endpoint": f"http://foremast-service.{NAMESPACE}.svc:8099/v1/healthcheck/"
+                    },
+                    "continuous": True,
+                    "remediation": {"option": "AutoRollback"},
+                },
+            }
+        )
+    return docs
+
+
+# ---------------------------------------------------------------------------
 # Shell helpers
 # ---------------------------------------------------------------------------
 
@@ -666,6 +746,14 @@ The engine Deployment requests a TPU host (GKE v5e 2x4 node selector); edit
 `engine_deployment()` for other topologies, or drop the TPU request to score
 on CPU. `minikube.sh` bootstraps a local demo cluster; `export/*.sh`
 port-forward the service (:8099), Prometheus (:9090), and the UI (:8080).
+
+Demo runbook (the reference's de-facto integration test,
+docs/guides/installation.md:84-143): apply `examples/demo/rollingUpdate/
+demo_v1.yaml`, wait >= 5 min so history accumulates, apply `demo_v2.yaml`
+(error injector) and watch `kubectl -n foremast-examples get
+deploymentmonitor demo -w` reach phase Unhealthy followed by automatic
+rollback to v1. The `continuous/` variants carry a DeploymentMonitor with
+`continuous: true` (what `kubectl watch demo` toggles).
 """
 
 
@@ -695,6 +783,18 @@ def tree(cfg: BrainConfig | None = None) -> dict[str, object]:
         "foremast/3_engine/foremast-service.yaml": service_deployment(),
         "foremast/3_engine/foremast-engine.yaml": engine_deployment(cfg),
         "foremast/3_engine/foremast-ui.yaml": ui_deployment(),
+        "examples/demo/rollingUpdate/demo_v1.yaml": demo_deployment("v1", []),
+        "examples/demo/rollingUpdate/demo_v2.yaml": demo_deployment(
+            "v2", ["--error-type", "5xx", "--frequency", "6"]
+        ),
+        "examples/demo/continuous/demo_v1.yaml": demo_deployment(
+            "v1", [], continuous=True
+        ),
+        "examples/demo/continuous/demo_v2.yaml": demo_deployment(
+            "v2",
+            ["--trace", "/app/tests/data/demo_canary_spike.csv"],
+            continuous=True,
+        ),
     }
 
 
