@@ -191,50 +191,36 @@ _STATIC = (
 )
 
 
-@partial(jax.jit, static_argnames=_STATIC)
-def _score_xla(
+def _judgment_tail(
     batch: ScoreBatch,
-    algorithm: str = "moving_average_all",
-    pairwise_algorithm: str = PAIRWISE_ALL,
-    p_threshold: float = 0.05,
-    min_mw: int = 20,
-    min_wilcoxon: int = 20,
-    min_kruskal: int = 5,
+    pred: jax.Array,
+    scale: jax.Array,
+    n_hist: jax.Array,
+    pairwise_algorithm: str,
+    p_threshold: float,
+    min_mw: int,
+    min_wilcoxon: int,
+    min_kruskal: int,
 ) -> ScoreResult:
-    """The pure-XLA scoring program (partitions under GSPMD for the
-    sharded path — no custom calls, so the mesh slices it freely)."""
-    hist = batch.historical
+    """Everything after the model fit: pairwise -> threshold lowering ->
+    bounds -> flags -> measurability gate -> verdict. Shared by the XLA
+    program and the context-parallel path (parallel/seqparallel.py) so the
+    judgment semantics can never diverge."""
     cur = batch.current
-    base = batch.baseline
-
     p, differs = pairwise_decision(
         cur,
-        base,
+        batch.baseline,
         pairwise_algorithm,
         p_threshold,
         min_mw,
         min_wilcoxon,
         min_kruskal,
     )
-
     eff_threshold = jnp.where(
         differs, batch.threshold * DIFF_THRESHOLD_FACTOR, batch.threshold
     )
-
-    fit = AI_MODEL.get(algorithm)
-    if fit is None:
-        # models/ registers its detectors (seasonal/prophet/...) on import;
-        # resolve lazily so the registry works without callers importing it
-        import foremast_tpu.models  # noqa: F401
-
-        fit = AI_MODEL[algorithm]
-    fc: Forecast = fit(hist.values, hist.mask)
-    pred = horizon(fc, cur.length)  # [B, Tc] forecast over current window
-
-    upper, lower = compute_bounds(pred, fc.scale, eff_threshold, batch.min_lower_bound)
+    upper, lower = compute_bounds(pred, scale, eff_threshold, batch.min_lower_bound)
     anomalies = detect_anomalies(cur.values, cur.mask, upper, lower, batch.bound)
-
-    n_hist = hist.count()
     n_cur = cur.count()
     measurable = (n_hist >= batch.min_points) & (n_cur > 0)
     any_anom = jnp.any(anomalies, axis=-1)
@@ -252,6 +238,57 @@ def _score_xla(
         lower=lower,
         p_value=p,
         dist_differs=differs,
+    )
+
+
+# jitted form for callers outside an enclosing jit (the context-parallel
+# path); static args match the dispatcher's
+judgment_tail = partial(
+    jax.jit,
+    static_argnames=(
+        "pairwise_algorithm",
+        "p_threshold",
+        "min_mw",
+        "min_wilcoxon",
+        "min_kruskal",
+    ),
+)(_judgment_tail)
+
+
+@partial(jax.jit, static_argnames=_STATIC)
+def _score_xla(
+    batch: ScoreBatch,
+    algorithm: str = "moving_average_all",
+    pairwise_algorithm: str = PAIRWISE_ALL,
+    p_threshold: float = 0.05,
+    min_mw: int = 20,
+    min_wilcoxon: int = 20,
+    min_kruskal: int = 5,
+) -> ScoreResult:
+    """The pure-XLA scoring program (partitions under GSPMD for the
+    sharded path — no custom calls, so the mesh slices it freely)."""
+    hist = batch.historical
+
+    fit = AI_MODEL.get(algorithm)
+    if fit is None:
+        # models/ registers its detectors (seasonal/prophet/...) on import;
+        # resolve lazily so the registry works without callers importing it
+        import foremast_tpu.models  # noqa: F401
+
+        fit = AI_MODEL[algorithm]
+    fc: Forecast = fit(hist.values, hist.mask)
+    pred = horizon(fc, batch.current.length)  # [B, Tc] forecast
+
+    return _judgment_tail(
+        batch,
+        pred,
+        fc.scale,
+        hist.count(),
+        pairwise_algorithm,
+        p_threshold,
+        min_mw,
+        min_wilcoxon,
+        min_kruskal,
     )
 
 

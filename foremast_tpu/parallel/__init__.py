@@ -18,9 +18,11 @@ from foremast_tpu.parallel.batch import (
     throughput_batch,
 )
 from foremast_tpu.parallel.seqparallel import (
+    score_time_sharded,
     sharded_ewma,
     sharded_linear_scan,
     sharded_masked_moments,
+    sharded_masked_stats,
 )
 
 __all__ = [
@@ -37,7 +39,9 @@ __all__ = [
     "pad_batch",
     "shard_batch",
     "throughput_batch",
+    "score_time_sharded",
     "sharded_ewma",
     "sharded_linear_scan",
     "sharded_masked_moments",
+    "sharded_masked_stats",
 ]

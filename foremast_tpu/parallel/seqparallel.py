@@ -102,11 +102,11 @@ def sharded_ewma(
     return sharded_linear_scan(1.0 - a_eff, a_eff * values, mesh)
 
 
-def sharded_masked_moments(
+def sharded_masked_stats(
     values: jax.Array, mask: jax.Array, mesh: Mesh
-) -> tuple[jax.Array, jax.Array]:
-    """Global masked (mean, var) over a time-sharded window -> two [B] arrays
-    replicated along `model`. One psum over ICI."""
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Global masked (count, mean, var) over a time-sharded window ->
+    three [B] arrays replicated along `model`. One psum over ICI."""
 
     def local(v, m):
         mf = m.astype(v.dtype)
@@ -115,14 +115,59 @@ def sharded_masked_moments(
         n = jax.lax.psum(jnp.sum(mf, axis=-1), MODEL_AXIS)
         mean = jnp.where(n > 0, s1 / jnp.maximum(n, 1.0), 0.0)
         var = jnp.where(n > 0, s2 / jnp.maximum(n, 1.0) - mean * mean, 0.0)
-        return jnp.maximum(var, 0.0), mean
+        return n, mean, jnp.maximum(var, 0.0)
 
     fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS, MODEL_AXIS)),
-        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
         check_vma=False,
     )
-    var, mean = fn(values, mask)
+    return fn(values, mask)
+
+
+def sharded_masked_moments(
+    values: jax.Array, mask: jax.Array, mesh: Mesh
+) -> tuple[jax.Array, jax.Array]:
+    """(mean, var) view of `sharded_masked_stats` (kept for callers that
+    don't need the count)."""
+    _, mean, var = sharded_masked_stats(values, mask, mesh)
     return mean, var
+
+
+def score_time_sharded(batch, mesh: Mesh, config=None):
+    """Full moving_average_all judgment with the HISTORY time axis sharded
+    over `model` — context parallelism end-to-end.
+
+    For histories no single chip holds (year-long windows, 1 s steps):
+    place `batch.historical` as [B over data, Th over model]; the model
+    statistics reduce with one psum over ICI, and everything downstream
+    (pairwise tests, bounds, flags, verdict) runs on the short
+    data-sharded current/baseline windows. Semantics match
+    `engine.scoring.score(algorithm="moving_average_all")`.
+
+    `config`: a BrainConfig for pairwise/threshold parameters (defaults).
+    """
+    from foremast_tpu.config import BrainConfig
+    from foremast_tpu.engine import scoring
+
+    cfg = config or BrainConfig()
+
+    n, mean, var = sharded_masked_stats(
+        batch.historical.values, batch.historical.mask, mesh
+    )
+    pred = jnp.broadcast_to(mean[:, None], batch.current.values.shape)
+    # the jitted shared tail: judgment semantics are defined once, in
+    # engine/scoring — this path can never diverge from _score_xla
+    return scoring.judgment_tail(
+        batch,
+        pred,
+        jnp.sqrt(var),
+        n,
+        pairwise_algorithm=cfg.pairwise.algorithm,
+        p_threshold=cfg.pairwise.threshold,
+        min_mw=cfg.pairwise.min_mann_white_points,
+        min_wilcoxon=cfg.pairwise.min_wilcoxon_points,
+        min_kruskal=cfg.pairwise.min_kruskal_points,
+    )

@@ -61,6 +61,7 @@ from foremast_tpu.watch.kubeapi import (
     deployment_containers,
     deployment_revision,
     owner_uids,
+    record_event,
 )
 
 log = logging.getLogger("foremast_tpu.watch")
@@ -329,6 +330,18 @@ class Barrelman:
         if reason:
             monitor.status.anomaly = {"reason": reason}
         self.kube.upsert_monitor(monitor)
+        record_event(
+            self.kube,
+            namespace,
+            name,
+            reason="MonitoringStarted" if job_id else "AnalystUnavailable",
+            message=(
+                f"health analysis job {job_id} started ({strategy})"
+                if job_id
+                else "could not create analysis job"
+            ),
+            event_type="Normal" if job_id else "Warning",
+        )
 
     def _start_job(self, endpoint: str, req: AnalyzeRequest) -> str | None:
         """StartAnalyzing with the reference's retry-once

@@ -40,6 +40,7 @@ from foremast_tpu.watch.kubeapi import (
     NotFound,
     deployment_revision,
     owner_uids,
+    record_event,
 )
 
 log = logging.getLogger("foremast_tpu.watch")
@@ -141,6 +142,14 @@ class MonitorController:
         if monitor.status.phase != MonitorPhase.UNHEALTHY:
             return
         self._unhealthy_since[(monitor.namespace, monitor.name)] = self.clock()
+        record_event(
+            self.kube,
+            monitor.namespace,
+            monitor.name,
+            reason="Unhealthy",
+            message=f"health analysis job {monitor.status.job_id} detected anomalies",
+            event_type="Warning",
+        )
         if monitor.status.remediation_taken:
             return
         option = monitor.remediation.option
@@ -196,6 +205,14 @@ class MonitorController:
             },
         }
         self.kube.patch_deployment(monitor.namespace, monitor.name, patch)
+        record_event(
+            self.kube,
+            monitor.namespace,
+            monitor.name,
+            reason="AutoRollback",
+            message=f"rolled back to revision {deployment_revision(rs)} "
+            "after unhealthy analysis",
+        )
         log.info(
             "rolled back %s/%s to revision %s",
             monitor.namespace, monitor.name, deployment_revision(rs),
@@ -206,6 +223,13 @@ class MonitorController:
         try:
             self.kube.patch_deployment(
                 monitor.namespace, monitor.name, {"spec": {"paused": True}}
+            )
+            record_event(
+                self.kube,
+                monitor.namespace,
+                monitor.name,
+                reason="AutoPause",
+                message="paused rollout after unhealthy analysis",
             )
         except NotFound:
             log.warning("pause target %s/%s gone", monitor.namespace, monitor.name)

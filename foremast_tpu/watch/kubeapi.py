@@ -48,6 +48,7 @@ class KubeClient(Protocol):
     def patch_deployment(self, namespace: str, name: str, patch: dict) -> dict: ...
     def list_replicasets(self, namespace: str) -> list[dict]: ...
     def list_pods(self, namespace: str) -> list[dict]: ...
+    def create_event(self, namespace: str, event: dict) -> dict: ...
 
     # foremast CRDs -------------------------------------------------------
     def get_metadata(self, namespace: str, name: str) -> DeploymentMetadata: ...
@@ -118,6 +119,11 @@ class InMemoryKube:
         ] = []
         # audit trail of (verb, kind, namespace, name, detail) for asserts
         self.actions: list[tuple[str, str, str, str, Any]] = []
+        self.events: list[dict] = []
+
+    def create_event(self, namespace: str, event: dict) -> dict:
+        self.events.append(event)
+        return event
 
     # --- seeding / events ------------------------------------------------
 
@@ -256,6 +262,51 @@ class InMemoryKube:
                 fn("delete", m, None)
 
 
+def record_event(
+    kube: "KubeClient",
+    namespace: str,
+    name: str,
+    reason: str,
+    message: str,
+    event_type: str = "Normal",
+    kind: str = "Deployment",
+) -> None:
+    """Emit a corev1 Event against an object (the reference does this via
+    an EventBroadcaster, Barrelman.go:272-276 / MonitorController.go:59-63).
+    Best-effort: event failures must never affect the control loop."""
+    import time
+
+    event = {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {
+            # client-go convention: unique per emission (a counter would
+            # repeat names after restart -> silent 409 drops)
+            "name": f"{name}.{time.time_ns():x}",
+            "namespace": namespace,
+        },
+        "involvedObject": {
+            "kind": kind,
+            "namespace": namespace,
+            "name": name,
+            "apiVersion": "apps/v1" if kind == "Deployment" else API_VERSION,
+        },
+        "reason": reason,
+        "message": message,
+        "type": event_type,
+        "source": {"component": "foremast-watch"},
+        "count": 1,
+    }
+    try:
+        kube.create_event(namespace, event)
+    except Exception:  # noqa: BLE001 - never let event plumbing break control
+        import logging
+
+        logging.getLogger("foremast_tpu.watch").debug(
+            "event emit failed for %s/%s %s", namespace, name, reason
+        )
+
+
 def _deep_merge(dst: dict, patch: dict) -> None:
     for k, v in patch.items():
         if v is None:
@@ -350,6 +401,9 @@ class HttpKube:
 
     def list_pods(self, namespace: str) -> list[dict]:
         return self._req("GET", f"/api/v1/namespaces/{namespace}/pods").get("items", [])
+
+    def create_event(self, namespace: str, event: dict) -> dict:
+        return self._req("POST", f"/api/v1/namespaces/{namespace}/events", event)
 
     # --- foremast CRDs ---------------------------------------------------
 

@@ -447,3 +447,22 @@ def test_e2e_deploy_score_rollback(world, demo_traces):
     assert mon.status.anomaly.get("error5xx", {}).get("values")
     dep = kube.get_deployment("demo", "demo")
     assert dep["spec"]["template"]["spec"]["containers"][0]["image"] == "demo:v1"
+
+
+def test_events_emitted_on_monitoring_and_remediation(world):
+    """K8s Events parity (EventBroadcaster role): monitoring start emits
+    Normal/MonitoringStarted; unhealthy emits Warning/Unhealthy."""
+    kube, store, bman, clock = world
+    seed_pods(kube)
+    kube.apply_deployment(make_deployment(image="demo:v1", revision=1))
+    kube.apply_deployment(make_deployment(image="demo:v2", revision=2))
+    reasons = [e["reason"] for e in kube.events]
+    assert "MonitoringStarted" in reasons
+
+    mon = kube.get_monitor("demo", "demo")
+    mon.remediation.option = "AutoRollback"
+    mon.status.phase = MonitorPhase.UNHEALTHY
+    MonitorController(kube, bman, clock=clock).handle_transition(mon)
+    types = {e["reason"]: e["type"] for e in kube.events}
+    assert types.get("Unhealthy") == "Warning"
+    assert types["MonitoringStarted"] == "Normal"
