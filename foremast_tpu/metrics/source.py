@@ -17,6 +17,7 @@ All return (times: int64[N], values: float32[N]) numpy arrays.
 from __future__ import annotations
 
 import csv
+import math
 from datetime import datetime, timezone
 from typing import Callable, Mapping
 
@@ -55,9 +56,16 @@ class PrometheusSource(MetricSource):
         for series in result:
             for t, v in series.get("values", []):
                 try:
-                    acc[int(float(t))] = acc.get(int(float(t)), 0.0) + float(v)
+                    fv = float(v)
+                    ft = int(float(t))
                 except (TypeError, ValueError):
-                    continue  # NaN/"+Inf" samples are dropped, not fatal
+                    continue
+                if not math.isfinite(fv):
+                    # Prometheus emits "NaN"/"+Inf" strings (e.g. 0/0 in a
+                    # recording rule); float() parses them fine, so they
+                    # must be dropped explicitly or they poison the window
+                    continue
+                acc[ft] = acc.get(ft, 0.0) + fv
         if not acc:
             return _empty()
         ts = np.asarray(sorted(acc), np.int64)

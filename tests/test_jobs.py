@@ -273,3 +273,73 @@ def test_infer_metric_type():
     assert infer_metric_type("http_error5xx_rate", cfg) == "error5xx"
     assert infer_metric_type("p99Latency", cfg) == "latency"
     assert infer_metric_type("tps", cfg) is None
+
+
+class _FakeResp:
+    def __init__(self, body, status=200):
+        self._body = body
+        self.status_code = status
+
+    def raise_for_status(self):
+        if self.status_code >= 400:
+            raise RuntimeError(f"http {self.status_code}")
+
+    def json(self):
+        return self._body
+
+
+class _FakeSession:
+    def __init__(self, body):
+        self.body = body
+        self.urls = []
+
+    def get(self, url, timeout=None):
+        self.urls.append(url)
+        return _FakeResp(self.body)
+
+
+def test_prometheus_source_parses_and_merges():
+    from foremast_tpu.metrics.source import PrometheusSource
+
+    body = {
+        "status": "success",
+        "data": {
+            "result": [
+                {"values": [[100, "1.5"], [160, "2.0"]]},
+                {"values": [[100, "0.5"]]},  # second series sums per ts
+            ]
+        },
+    }
+    src = PrometheusSource(session=_FakeSession(body))
+    ts, vs = src.fetch("http://prom/q")
+    assert ts.tolist() == [100, 160]
+    assert vs.tolist() == [2.0, 2.0]
+
+
+def test_prometheus_source_drops_nan_and_inf():
+    """Prometheus emits "NaN"/"+Inf" strings (0/0 recording rules);
+    float() parses them, so they must be dropped explicitly."""
+    from foremast_tpu.metrics.source import PrometheusSource
+
+    body = {
+        "status": "success",
+        "data": {
+            "result": [
+                {"values": [[100, "NaN"], [160, "+Inf"], [220, "3.0"]]}
+            ]
+        },
+    }
+    ts, vs = PrometheusSource(session=_FakeSession(body)).fetch("http://p/q")
+    assert ts.tolist() == [220]
+    assert vs.tolist() == [3.0]
+
+
+def test_prometheus_source_error_status_raises():
+    from foremast_tpu.metrics.source import PrometheusSource
+
+    body = {"status": "error", "error": "bad query"}
+    try:
+        PrometheusSource(session=_FakeSession(body)).fetch("http://p/q")
+        raise AssertionError("should have raised")
+    except RuntimeError as e:
+        assert "bad query" in str(e)
