@@ -39,11 +39,15 @@ def main():
     res = run(batch)
     jax.block_until_ready(res.verdict)
 
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        res = run(batch)
-    jax.block_until_ready(res.verdict)
-    dt = time.perf_counter() - t0
+    from foremast_tpu.observe.profile import trace_scoring
+
+    # FOREMAST_PROFILE=<dir> dumps a jax.profiler trace of the timed loop
+    with trace_scoring():
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            res = run(batch)
+        jax.block_until_ready(res.verdict)
+        dt = time.perf_counter() - t0
 
     windows_per_sec = B * ITERS / dt
     print(

@@ -172,12 +172,22 @@ class BrainWorker:
         if not docs:
             return 0
 
+        # Fetch every claimed doc's windows concurrently: the fetches are
+        # HTTP round trips to Prometheus (latency-bound), and a tick may
+        # claim hundreds of jobs; serial fetching would make wall-clock
+        # scale with claim count instead of the slowest single fetch.
         all_tasks: list[MetricTask] = []
         failed: list[Document] = []
         ok_docs: list[Document] = []
-        for doc in docs:
+        if len(docs) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(16, len(docs))) as pool:
+                fetched = list(pool.map(self._fetch_tasks, docs))
+        else:
+            fetched = [self._fetch_tasks(doc) for doc in docs]
+        for doc, tasks in zip(docs, fetched):
             # claim() already flipped + persisted preprocess_inprogress
-            tasks = self._fetch_tasks(doc)
             if tasks is None:
                 doc.status = STATUS_PREPROCESS_FAILED
                 doc.status_code = "500"

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import csv
 import math
+import threading
 from datetime import datetime, timezone
 from typing import Callable, Mapping
 
@@ -37,13 +38,29 @@ class MetricSource:
 
 class PrometheusSource(MetricSource):
     """Fetches query_range URLs; merges a multi-series result by summing
-    values per timestamp (recording rules normally return one series)."""
+    values per timestamp (recording rules normally return one series).
+
+    Thread-safe: the worker fetches a claimed batch from a thread pool,
+    and requests.Session is not safe for concurrent use (cookie jar /
+    redirect state), so each thread gets its own Session. An explicitly
+    injected `session` (tests) is used as-is.
+    """
 
     def __init__(self, session=None, timeout: float = 10.0):
-        import requests
-
-        self._session = session or requests.Session()
+        self._injected = session
+        self._local = threading.local()
         self.timeout = timeout
+
+    @property
+    def _session(self):
+        if self._injected is not None:
+            return self._injected
+        sess = getattr(self._local, "session", None)
+        if sess is None:
+            import requests
+
+            sess = self._local.session = requests.Session()
+        return sess
 
     def fetch(self, url: str) -> Series:
         resp = self._session.get(url, timeout=self.timeout)

@@ -343,3 +343,25 @@ def test_prometheus_source_error_status_raises():
         raise AssertionError("should have raised")
     except RuntimeError as e:
         assert "bad query" in str(e)
+
+
+def test_worker_concurrent_fetch_isolates_failures(replay):
+    """Pool-based fetching: one doc whose metrics 404 fails alone; the
+    rest of the claimed batch still scores."""
+
+    class Flaky:
+        def fetch(self, url):
+            if "bad" in url:
+                raise RuntimeError("404")
+            return replay.fetch(url)
+
+    store = InMemoryStore()
+    for i in range(4):
+        store.create(_mk_doc(f"ok{i}", "error4xx", "normal", end_time="100"))
+    store.create(_mk_doc("bad", "error4xx", "bad"))
+    worker = BrainWorker(store, Flaky(), BrainConfig())
+    n = worker.tick(now=1e12)
+    assert n == 5
+    statuses = {d.id: d.status for d in store._docs.values()}
+    assert statuses["job-bad-error4xx-bad"] == STATUS_PREPROCESS_FAILED
+    assert sum(s == STATUS_COMPLETED_HEALTH for s in statuses.values()) == 4
