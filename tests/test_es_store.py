@@ -1,0 +1,185 @@
+"""ElasticsearchStore tests against an in-process fake ES.
+
+The fake implements exactly the REST surface the store uses (root ping,
+_doc GET/PUT with op_type=create and if_seq_no/if_primary_term CAS,
+_search with terms / bool-must_not queries), so the production-critical
+semantics — idempotent creation, optimistic-concurrency claims, stuck-job
+takeover — are covered without a live cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import urllib.parse
+
+from foremast_tpu.jobs.models import (
+    STATUS_COMPLETED_HEALTH,
+    STATUS_INITIAL,
+    STATUS_PREPROCESS_INPROGRESS,
+)
+from foremast_tpu.jobs.store import ElasticsearchStore
+from foremast_tpu.jobs.models import Document
+
+
+class _Resp:
+    def __init__(self, status: int, body: dict):
+        self.status_code = status
+        self._body = body
+        self.ok = status < 400
+
+    def json(self):
+        return self._body
+
+    def raise_for_status(self):
+        if self.status_code >= 400:
+            raise RuntimeError(f"http {self.status_code}: {self._body}")
+
+
+class FakeES:
+    """documents/_doc store with seq_no/primary_term versioning."""
+
+    def __init__(self):
+        self.docs: dict[str, dict] = {}  # id -> {"_source":…, "_seq_no":int}
+        self._seq = 0
+
+    # requests.Session surface -----------------------------------------
+
+    def get(self, url, timeout=None, **kw):
+        path = urllib.parse.urlparse(url).path
+        if path in ("", "/"):
+            return _Resp(200, {"cluster_name": "fake"})
+        m = re.fullmatch(r"/documents/_doc/([^/]+)", path)
+        if m:
+            rec = self.docs.get(urllib.parse.unquote(m.group(1)))
+            if rec is None:
+                return _Resp(404, {"found": False})
+            return _Resp(200, {"found": True, "_source": rec["_source"]})
+        return _Resp(404, {})
+
+    def put(self, url, json=None, timeout=None, **kw):
+        u = urllib.parse.urlparse(url)
+        q = urllib.parse.parse_qs(u.query)
+        m = re.fullmatch(r"/documents/_doc/([^/]+)", u.path)
+        assert m, u.path
+        doc_id = urllib.parse.unquote(m.group(1))
+        rec = self.docs.get(doc_id)
+        if q.get("op_type") == ["create"] and rec is not None:
+            return _Resp(409, {"error": "version_conflict_engine_exception"})
+        if "if_seq_no" in q:
+            if rec is None or rec["_seq_no"] != int(q["if_seq_no"][0]):
+                return _Resp(409, {"error": "version_conflict_engine_exception"})
+        self._seq += 1
+        self.docs[doc_id] = {"_source": json, "_seq_no": self._seq}
+        return _Resp(200, {"result": "updated"})
+
+    def post(self, url, json=None, timeout=None, **kw):
+        path = urllib.parse.urlparse(url).path
+        assert path == "/documents/_search", path
+        hits = []
+        for doc_id, rec in self.docs.items():
+            if self._matches(json.get("query", {}), rec["_source"]):
+                hits.append(
+                    {
+                        "_id": doc_id,
+                        "_source": rec["_source"],
+                        "_seq_no": rec["_seq_no"],
+                        "_primary_term": 1,
+                    }
+                )
+        size = json.get("size", 10)
+        return _Resp(200, {"hits": {"hits": hits[:size]}})
+
+    @staticmethod
+    def _matches(query: dict, source: dict) -> bool:
+        if "terms" in query:
+            (field, values), = query["terms"].items()
+            return source.get(field) in values
+        if "bool" in query and "must_not" in query["bool"]:
+            return not FakeES._matches(query["bool"]["must_not"], source)
+        return True
+
+
+def _store(fake=None):
+    fake = fake or FakeES()
+    return ElasticsearchStore("http://fake:9200", session=fake), fake
+
+
+def test_create_is_idempotent():
+    store, fake = _store()
+    d1, created1 = store.create(Document(id="j1", app_name="a"))
+    d2, created2 = store.create(Document(id="j1", app_name="a"))
+    assert created1 and not created2
+    assert d2.id == "j1"
+    assert len(fake.docs) == 1
+
+
+def test_get_roundtrip_and_missing():
+    store, _ = _store()
+    store.create(Document(id="j1", app_name="a", strategy="canary"))
+    doc = store.get("j1")
+    assert doc is not None and doc.strategy == "canary"
+    assert store.get("nope") is None
+
+
+def test_claim_flips_status_and_is_exclusive():
+    fake = FakeES()
+    a, _ = _store(fake)
+    b, _ = _store(fake)
+    a.create(Document(id="j1", app_name="x"))
+    got_a = a.claim("worker-a", max_stuck_seconds=90)
+    got_b = b.claim("worker-b", max_stuck_seconds=90)
+    assert [d.id for d in got_a] == ["j1"]
+    assert got_b == []  # already in-progress, not claimable
+    assert fake.docs["j1"]["_source"]["status"] == STATUS_PREPROCESS_INPROGRESS
+    assert fake.docs["j1"]["_source"]["processingContent"] == "worker-a"
+
+
+def test_claim_cas_race_single_winner():
+    """Two workers fetch the same search hit; the CAS must let exactly one
+    win (the reference gets this from ES versioned writes)."""
+    fake = FakeES()
+    a, _ = _store(fake)
+    a.create(Document(id="j1", app_name="x"))
+
+    hit_seq = fake.docs["j1"]["_seq_no"]
+    # simulate B writing first with the same seq_no A saw
+    fake.put(
+        "http://fake:9200/documents/_doc/j1"
+        f"?if_seq_no={hit_seq}&if_primary_term=1",
+        json={**fake.docs["j1"]["_source"], "status": STATUS_PREPROCESS_INPROGRESS},
+    )
+    # A's claim now sees a stale seq_no on its own CAS write -> 409 -> skip
+    got = a.claim("worker-a", max_stuck_seconds=90)
+    assert got == []
+
+
+def test_stuck_job_takeover():
+    """A doc stuck in preprocess_inprogress past MAX_STUCK_IN_SECONDS is
+    claimable again (work stealing, design.md:39)."""
+    fake = FakeES()
+    store, _ = _store(fake)
+    store.create(Document(id="j1", app_name="x"))
+    (claimed,) = store.claim("worker-a", max_stuck_seconds=90)
+    # age the claim far past the stuck threshold
+    src = fake.docs["j1"]["_source"]
+    src["modifiedAt"] = "2000-01-01T00:00:00Z"
+    got = store.claim("worker-b", max_stuck_seconds=90)
+    assert [d.id for d in got] == ["j1"]
+    assert fake.docs["j1"]["_source"]["processingContent"] == "worker-b"
+
+
+def test_update_and_list_open():
+    store, fake = _store()
+    store.create(Document(id="j1", app_name="a"))
+    store.create(Document(id="j2", app_name="b"))
+    doc = store.get("j1")
+    doc.status = STATUS_COMPLETED_HEALTH
+    store.update(doc)
+    open_ids = {d.id for d in store.list_open()}
+    assert open_ids == {"j2"}
+
+
+def test_wait_ready_returns_when_reachable():
+    store, _ = _store()
+    assert store.wait_ready(retry_seconds=0.01, max_wait=1.0)

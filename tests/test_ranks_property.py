@@ -1,0 +1,97 @@
+"""Property tests: rank statistics vs scipy on random masked inputs.
+
+The golden tests in test_ranks.py pin fixed vectors; these drive the
+masked, batched TPU implementations across hypothesis-generated data —
+ties, constant runs, tiny samples — against scipy's asymptotic paths.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.stats as ss
+from hypothesis import given, settings, strategies as st
+
+from foremast_tpu.ops.ranks import (
+    kruskal_wallis,
+    mann_whitney_u,
+    wilcoxon_signed_rank,
+)
+
+# values drawn from a small grid to force ties (the hard case for the
+# tie-correction terms); sizes straddle the min-points gates
+_vals = st.lists(
+    st.sampled_from([0.0, 0.25, 0.5, 1.0, 2.0, 3.5]), min_size=21, max_size=40
+)
+
+
+def _call(fn, x, y, **kw):
+    xm = np.ones((1, len(x)), bool)
+    ym = np.ones((1, len(y)), bool)
+    # unequal lengths: pad into one fixed shape with masks (the TPU form)
+    n = max(len(x), len(y))
+    xa = np.zeros((1, n), np.float32)
+    ya = np.zeros((1, n), np.float32)
+    xa[0, : len(x)] = x
+    ya[0, : len(y)] = y
+    xm = np.zeros((1, n), bool)
+    ym = np.zeros((1, n), bool)
+    xm[0, : len(x)] = True
+    ym[0, : len(y)] = True
+    stat, p, ok = fn(xa, xm, ya, ym, **kw)
+    return float(stat[0]), float(p[0]), bool(ok[0])
+
+
+@settings(max_examples=60, deadline=None)
+@given(x=_vals, y=_vals)
+def test_mann_whitney_matches_scipy(x, y):
+    stat, p, ok = _call(mann_whitney_u, x, y, min_points=20)
+    ref = ss.mannwhitneyu(x, y, method="asymptotic", use_continuity=True)
+    if not ok:
+        assert p == 1.0  # degenerate (zero variance): gated out
+        return
+    np.testing.assert_allclose(stat, ref.statistic, rtol=1e-5)
+    np.testing.assert_allclose(p, ref.pvalue, rtol=2e-4, atol=2e-6)
+
+
+@settings(max_examples=60, deadline=None)
+@given(x=_vals, y=_vals)
+def test_kruskal_matches_scipy(x, y):
+    stat, p, ok = _call(kruskal_wallis, x, y, min_points=5)
+    if not ok:
+        assert p == 1.0
+        return
+    ref = ss.kruskal(x, y)
+    # H is a difference of ~1e2-magnitude terms: float32 cancellation
+    # leaves ~1e-4 absolute error when H ~ 0, so atol dominates there
+    np.testing.assert_allclose(stat, ref.statistic, rtol=1e-3, atol=5e-3)
+    np.testing.assert_allclose(p, ref.pvalue, rtol=1e-3, atol=5e-4)
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    pairs=st.lists(
+        st.tuples(
+            st.sampled_from([0.0, 0.25, 0.5, 1.0, 2.0]),
+            st.sampled_from([0.0, 0.25, 0.5, 1.0, 2.0]),
+        ),
+        min_size=21,
+        max_size=40,
+    )
+)
+def test_wilcoxon_matches_scipy(pairs):
+    x = [a for a, _ in pairs]
+    y = [b for _, b in pairs]
+    stat, p, ok = _call(wilcoxon_signed_rank, x, y, min_points=20)
+    d = np.asarray(x) - np.asarray(y)
+    if not ok:
+        # all-zero differences or sub-minimum sample: gated out
+        assert p == 1.0
+        return
+    ref = ss.wilcoxon(
+        x, y, zero_method="wilcox", correction=False, mode="approx"
+    )
+    # ours returns W+; scipy's two-sided statistic is min(W+, W-)
+    nz = int(np.count_nonzero(d))
+    w_min = min(stat, nz * (nz + 1) / 2.0 - stat)
+    np.testing.assert_allclose(w_min, ref.statistic, rtol=1e-5, atol=1e-3)
+    np.testing.assert_allclose(p, ref.pvalue, rtol=1e-3, atol=5e-4)
