@@ -312,6 +312,7 @@ def cmd_ui(args: argparse.Namespace) -> int:
         service_endpoint=args.service_endpoint,
         namespace=args.namespace,
         app_name=args.app,
+        demo=args.demo,
     )
     return 0
 
@@ -411,6 +412,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--namespace", default=None, help="charted namespace label")
     p.add_argument("--app", default=None, help="charted app label")
+    p.add_argument(
+        "--demo",
+        action="store_true",
+        help="serve synthetic series from this process (no Prometheus needed)",
+    )
 
     p = sub.add_parser("rules", help="print recording-rules manifest YAML")
     p.set_defaults(fn=cmd_rules)

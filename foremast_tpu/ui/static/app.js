@@ -17,7 +17,9 @@ let tableMode = false;
 /* ---------------- data ---------------- */
 
 async function queryRange(query, start, end, step) {
-  const u = new URL(CFG.serviceEndpoint + "/api/v1/query_range");
+  // empty serviceEndpoint = same-origin (demo mode); base is ignored
+  // when serviceEndpoint is an absolute URL
+  const u = new URL(CFG.serviceEndpoint + "/api/v1/query_range", location.origin);
   u.searchParams.set("query", query);
   u.searchParams.set("start", start);
   u.searchParams.set("end", end);

@@ -9,17 +9,15 @@ takeover — are covered without a live cluster.
 
 from __future__ import annotations
 
-import json
 import re
 import urllib.parse
 
 from foremast_tpu.jobs.models import (
+    Document,
     STATUS_COMPLETED_HEALTH,
-    STATUS_INITIAL,
     STATUS_PREPROCESS_INPROGRESS,
 )
 from foremast_tpu.jobs.store import ElasticsearchStore
-from foremast_tpu.jobs.models import Document
 
 
 class _Resp:

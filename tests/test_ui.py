@@ -64,3 +64,32 @@ def test_ui_server_serves_index_config_and_static():
             assert (await r.json()) == {"ok": True}
 
     asyncio.run(main())
+
+
+def test_demo_mode_serves_synthetic_query_range():
+    async def main():
+        app = make_app(demo=True)
+        async with TestClient(TestServer(app)) as c:
+            r = await c.get("/config")
+            assert (await r.json())["serviceEndpoint"] == ""  # same-origin
+            r = await c.get(
+                "/api/v1/query_range",
+                params={"query": "namespace_app_per_pod:http_server_requests_latency"
+                        '{namespace="n",app="a"}',
+                        "start": "0", "end": "600", "step": "15"},
+            )
+            body = await r.json()
+            assert body["status"] == "success"
+            values = body["data"]["result"][0]["values"]
+            assert len(values) > 30
+            # anomaly series returns only spike timestamps (sparse)
+            r = await c.get(
+                "/api/v1/query_range",
+                params={"query": "foremastbrain_x_anomaly", "start": "0",
+                        "end": "3600", "step": "15"},
+            )
+            body = await r.json()
+            res = body["data"]["result"]
+            assert res and len(res[0]["values"]) < 10
+
+    asyncio.run(main())
