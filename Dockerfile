@@ -1,0 +1,35 @@
+# foremast-tpu runtime image (the IMAGE the deploy/ manifests reference).
+#
+# One image serves every role — the container args select it:
+#   foremast serve | worker | watch-plane | ui    (see deploy/foremast/)
+#   python -m foremast_tpu.demo                    (examples/demo/)
+#
+# The TPU engine pods additionally need the TPU-enabled jax wheel for the
+# target accelerator; swap the base/pip line per your fleet (e.g.
+# `pip install 'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html`).
+
+FROM python:3.12-slim
+
+# native toolchain for the C++ data loader (built at image build time so
+# worker startup never compiles)
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && \
+    rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY foremast_tpu ./foremast_tpu
+COPY native ./native
+COPY bin ./bin
+COPY tests/data ./tests/data
+
+RUN pip install --no-cache-dir . && \
+    make -C native && \
+    ln -s /app/bin/kubectl-watch /usr/local/bin/kubectl-watch && \
+    ln -s /app/bin/kubectl-unwatch /usr/local/bin/kubectl-unwatch
+
+# service :8099, ui :8080, gauges :8000
+EXPOSE 8099 8080 8000
+
+ENTRYPOINT ["foremast"]
+CMD ["serve"]

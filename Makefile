@@ -1,0 +1,28 @@
+# Developer entry points (role parity with the reference's per-component
+# Makefiles: test / build / docker-build).
+
+PY ?= python
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+bench-suite:
+	$(PY) -m benchmarks.suite
+
+native:
+	$(MAKE) -C native
+
+deploy-render:
+	$(PY) -m foremast_tpu.deploy deploy
+
+docker-build:
+	docker build -t foremast/foremast-tpu:0.1.0 .
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +
+
+.PHONY: test bench bench-suite native deploy-render docker-build clean
