@@ -46,13 +46,13 @@ LANE = 128
 def use_pallas() -> bool:
     """Kernel dispatch gate: FOREMAST_PALLAS=1 opts in.
 
-    Default OFF: measured on a v5e chip at the bench.py shapes
-    (B=4096, Th=10080, Tc=30), XLA's own fusion of the scoring program is
-    slightly faster than this kernel (379k vs 363k windows/s) — the rank
-    tests dominate and the MA-stats pass is already memory-bound either
-    way. The kernel remains the building block for shapes/fusions XLA
-    handles poorly (e.g. much longer histories that blow VMEM-friendly
-    fusion, or future multi-stat one-pass variants)."""
+    Default OFF: measured on a v5e chip at the bench.py shapes, XLA's own
+    fusion of the scoring program beats this kernel at every batch size
+    (B=4096: 379k vs 363k windows/s; B=32768: 1.89M vs 1.26M) — the rank
+    tests dominate and the MA-stats pass is memory-bound either way. The
+    kernel remains the building block for shapes/fusions XLA handles
+    poorly (e.g. much longer histories that blow VMEM-friendly fusion, or
+    future multi-stat one-pass variants)."""
     return os.environ.get("FOREMAST_PALLAS", "") == "1"
 
 

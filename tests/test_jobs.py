@@ -365,3 +365,44 @@ def test_worker_concurrent_fetch_isolates_failures(replay):
     statuses = {d.id: d.status for d in store._docs.values()}
     assert statuses["job-bad-error4xx-bad"] == STATUS_PREPROCESS_FAILED
     assert sum(s == STATUS_COMPLETED_HEALTH for s in statuses.values()) == 4
+
+
+def test_two_workers_contend_without_double_processing(replay):
+    """Race coverage: two workers ticking concurrently over one store must
+    process every job exactly once (claim flips status inside the lock)."""
+    import threading
+
+    store = InMemoryStore()
+    n_jobs = 24
+    for i in range(n_jobs):
+        store.create(_mk_doc(f"app{i}", "error4xx", "normal", end_time="100"))
+
+    processed: dict[str, list[int]] = {}
+    lock = threading.Lock()
+
+    class CountingWorker(BrainWorker):
+        def _write_back(self, doc, verdicts, now):
+            with lock:
+                processed.setdefault(doc.id, []).append(1)
+            return super()._write_back(doc, verdicts, now)
+
+    workers = [
+        CountingWorker(store, replay, BrainConfig(), worker_id=f"w{i}", claim_limit=8)
+        for i in range(2)
+    ]
+
+    def run(w):
+        for _ in range(6):
+            w.tick(now=1e12)
+
+    threads = [threading.Thread(target=run, args=(w,)) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert len(processed) == n_jobs
+    assert all(len(v) == 1 for v in processed.values()), processed
+    assert all(
+        d.status == STATUS_COMPLETED_HEALTH for d in store._docs.values()
+    )
