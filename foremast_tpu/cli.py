@@ -256,7 +256,29 @@ def cmd_worker(args: argparse.Namespace) -> int:
                 judge.cache.save(ckpt_path)
                 _state["dirty"] = False
 
-    worker.run(poll_seconds=args.poll, after_tick=after_tick)
+    # graceful pod shutdown: k8s sends SIGTERM; finish the in-flight tick
+    # (claimed docs get written back) instead of dying mid-judgment —
+    # abandoned claims would otherwise wait out MAX_STUCK_IN_SECONDS
+    import signal
+
+    stopping = {"flag": False}
+
+    def _term(signum, frame):
+        stopping["flag"] = True
+
+    try:
+        signal.signal(signal.SIGTERM, _term)
+        signal.signal(signal.SIGINT, _term)
+    except ValueError:
+        pass  # not the main thread (embedded use); rely on the caller
+
+    worker.run(
+        poll_seconds=args.poll,
+        stop=lambda: stopping["flag"],
+        after_tick=after_tick,
+    )
+    if ckpt_path and len(judge.cache):
+        judge.cache.save(ckpt_path)  # final checkpoint on the way out
     return 0
 
 

@@ -253,8 +253,9 @@ def watch_rbac() -> list[dict]:
 
 
 def _container(name: str, args: list[str], env: list[dict], ports: list[dict],
-               cpu: str = "100m", memory: str = "128Mi") -> dict:
-    return {
+               cpu: str = "100m", memory: str = "128Mi",
+               probe_path: str | None = None, probe_port: int | None = None) -> dict:
+    c = {
         "name": name,
         "image": IMAGE,
         "imagePullPolicy": "IfNotPresent",
@@ -267,6 +268,15 @@ def _container(name: str, args: list[str], env: list[dict], ports: list[dict],
             "limits": {"cpu": cpu, "memory": memory},
         },
     }
+    if probe_path and probe_port:
+        probe = {
+            "httpGet": {"path": probe_path, "port": probe_port},
+            "initialDelaySeconds": 5,
+            "periodSeconds": 10,
+        }
+        c["readinessProbe"] = probe
+        c["livenessProbe"] = {**probe, "initialDelaySeconds": 30}
+    return c
 
 
 def _deployment(name: str, container: dict, sa: str | None = None,
@@ -433,6 +443,8 @@ def service_deployment() -> list[dict]:
         [{"containerPort": 8099, "name": "http"}],
         cpu="100m",
         memory="64Mi",
+        probe_path="/healthz",
+        probe_port=8099,
     )
     return [
         _deployment("foremast-service", c),
@@ -497,6 +509,9 @@ def engine_deployment(cfg: BrainConfig | None = None) -> list[dict]:
         [{"containerPort": 8000, "name": "gauges"}],
         cpu="4",
         memory="8Gi",
+        # the gauge exposition doubles as the health surface
+        probe_path="/metrics",
+        probe_port=8000,
     )
     # TPU scheduling: one worker per TPU host; the engine shards its batch
     # over the host's chips via jax.sharding (parallel/mesh.py).
@@ -557,6 +572,8 @@ def ui_deployment() -> list[dict]:
         [{"containerPort": 8080, "name": "http"}],
         cpu="100m",
         memory="64Mi",
+        probe_path="/healthz",
+        probe_port=8080,
     )
     return [
         _deployment("foremast-ui", c),
