@@ -87,8 +87,14 @@ class WatchPlane:
         own_namespace: str = "foremast",
         clock: Callable[[], float] = _time.time,
         sleep: Callable[[float], None] = _time.sleep,
+        analyst_factory=None,
     ) -> None:
-        self.barrelman = Barrelman(kube, own_namespace=own_namespace, clock=clock)
+        self.barrelman = Barrelman(
+            kube,
+            own_namespace=own_namespace,
+            clock=clock,
+            analyst_factory=analyst_factory,
+        )
         self.controller = MonitorController(kube, barrelman=self.barrelman, clock=clock)
         self.informer = DeploymentInformer(kube, self.barrelman.handle_deployment)
         self.clock = clock

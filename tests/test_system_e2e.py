@@ -1,0 +1,102 @@
+"""Full-system test: the deployed topology end-to-end.
+
+WatchPlane (list+diff informer + monitor poller + remediation) over the
+kube fake, LocalAnalyst standing in for the REST hop into the job store,
+BrainWorker scoring the golden spike trace — the demo runbook
+(deploy v1 -> roll v2 with errors -> Unhealthy -> auto-rollback) driven
+purely through the plane's own loop, never by calling Barrelman directly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from foremast_tpu.config import BrainConfig
+from foremast_tpu.jobs.models import STATUS_COMPLETED_UNHEALTH
+from foremast_tpu.jobs.store import InMemoryStore
+from foremast_tpu.jobs.worker import BrainWorker
+from foremast_tpu.metrics.source import ReplaySource
+from foremast_tpu.watch.analyst import LocalAnalyst
+from foremast_tpu.watch.crds import (
+    DeploymentMetadata,
+    MonitoredMetric,
+    MonitorPhase,
+    Remediation,
+    RemediationOption,
+)
+from foremast_tpu.watch.kubeapi import InMemoryKube
+from foremast_tpu.watch.plane import WatchPlane
+
+from tests.test_watch import FakeClock, make_deployment, seed_pods
+
+
+def test_plane_driven_demo_runbook(demo_traces):
+    kube = InMemoryKube()
+    kube.add_namespace("demo")
+    kube.add_metadata(
+        DeploymentMetadata(
+            name="demo",
+            namespace="demo",
+            analyst_endpoint="local://",
+            metrics_endpoint="http://prom:9090/",
+            monitoring=[
+                MonitoredMetric(
+                    "error5xx", metric_type="error5xx", metric_alias="error5xx"
+                )
+            ],
+        )
+    )
+    seed_pods(kube)
+
+    store = InMemoryStore()
+    clock = FakeClock()
+    plane = WatchPlane(
+        kube,
+        clock=clock,
+        sleep=lambda s: None,
+        analyst_factory=lambda ep: LocalAnalyst(store),
+    )
+
+    # ---- v1 deployed; first resync primes + creates the monitor CR
+    v1 = make_deployment(image="demo:v1", revision=1)
+    v1["metadata"]["resourceVersion"] = "1"
+    kube.deployments[("demo", "demo")] = v1
+    last = plane.step(last_resync=0.0)
+    mon = kube.get_monitor("demo", "demo")
+    assert mon is not None
+    mon.remediation = Remediation(option=RemediationOption.AUTO_ROLLBACK)
+    kube.upsert_monitor(mon)
+
+    # ---- v2 rolls out (image change seen by the NEXT resync diff)
+    v2 = make_deployment(image="demo:v2", revision=2)
+    v2["metadata"]["resourceVersion"] = "2"
+    kube.deployments[("demo", "demo")] = v2
+    clock.t += 30
+    last = plane.step(last_resync=last)
+    mon = kube.get_monitor("demo", "demo")
+    assert mon.status.phase == MonitorPhase.RUNNING
+    assert mon.status.job_id
+
+    # ---- the engine scores: current (new pods) replays the spike trace
+    ht, hv = demo_traces["normal"]
+    st, sv = demo_traces["spike"]
+    source = ReplaySource()
+    source.register("demo-new-1", (st, sv))
+    source.register("demo-old-1", (ht, hv))
+    source.register("namespace_app_per_pod:error5xx", (ht, hv))
+    worker = BrainWorker(store, source, BrainConfig())
+    assert worker.tick(now=clock.t) >= 1
+    assert store.get(mon.status.job_id).status == STATUS_COMPLETED_UNHEALTH
+
+    # ---- next plane tick polls the job, flips Unhealthy, auto-rolls back
+    clock.t += 10
+    plane.step(last_resync=last)
+    mon = kube.get_monitor("demo", "demo")
+    assert mon.status.phase == MonitorPhase.UNHEALTHY
+    assert mon.status.remediation_taken
+    pairs = mon.status.anomaly.get("error5xx", {}).get("values")
+    assert pairs and any(v > 10 for v in [p["value"] for p in pairs])
+    dep = kube.get_deployment("demo", "demo")
+    assert dep["spec"]["template"]["spec"]["containers"][0]["image"] == "demo:v1"
+    reasons = {e["reason"] for e in kube.events}
+    assert {"MonitoringStarted", "Unhealthy", "AutoRollback"} <= reasons
