@@ -18,3 +18,20 @@ def test_suite_config1_runs_small(capsys):
     line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert line["metric"] == "windows_per_sec"
     assert line["value"] > 0
+
+
+def test_quality_benchmark_structured_beats_flat_on_seasonal(capsys):
+    """Smoke the quality harness: fitted HW must dominate the global-mean
+    default on the seasonal scenario."""
+    import json as _json
+
+    import benchmarks.quality as quality
+
+    quality.main(["--small"])
+    rows = [
+        _json.loads(line) for line in capsys.readouterr().out.strip().splitlines()
+    ]
+    by = {(r["scenario"], r["algorithm"]): r["f1"] for r in rows}
+    assert by[("seasonal", "holt_winters")] > 0.9
+    assert by[("seasonal", "moving_average_all")] < 0.5
+    assert by[("flat", "moving_average_all")] > 0.9
