@@ -1,0 +1,9 @@
+"""Benchmark harnesses (suite = throughput configs, quality = detector F1)."""
+
+
+def prf1(tp: int, fp: int, fn: int) -> tuple[float, float, float]:
+    """(precision, recall, f1); empty flag sets report 0, not undefined."""
+    precision = tp / max(tp + fp, 1)
+    recall = tp / max(tp + fn, 1)
+    f1 = 2 * precision * recall / max(precision + recall, 1e-9)
+    return precision, recall, f1

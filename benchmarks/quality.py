@@ -27,14 +27,9 @@ import numpy as np
 
 from functools import partial
 
+from benchmarks import prf1
 from foremast_tpu.engine import scoring
-from foremast_tpu.models.seasonal import fit_seasonal
 from foremast_tpu.ops.windows import MetricWindows
-
-# The seasonal (Prophet-substitute) model's period is deployment config
-# (default 1440 = daily at the 60 s step); register a variant matched to
-# this benchmark's 24-step cycle the way an operator would configure it.
-scoring.register_model("seasonal_p24", partial(fit_seasonal, period=24))
 
 ALGORITHMS = (
     "moving_average_all",
@@ -43,6 +38,23 @@ ALGORITHMS = (
     "holt_winters",
     "seasonal_p24",
 )
+
+# One cycle of the synthetic season, in time steps. This deliberately
+# matches fit_holt_winters' default season_length=24 (ops/forecasters.py)
+# — scoring.score calls registry entries as fit(values, mask), so HW can
+# only track the cycle its default expects; if that default changes, this
+# constant (and the HW rows of the results table) must move with it.
+PERIOD = 24
+
+
+def _register_models() -> None:
+    """Register the period-matched seasonal variant (deployment config in
+    production — default period is 1440, daily at the 60 s step). Called
+    from entry points, NOT at import: a benchmark module must not mutate
+    the engine's model registry as an import side effect."""
+    from foremast_tpu.models.seasonal import fit_seasonal
+
+    scoring.register_model("seasonal_p24", partial(fit_seasonal, period=PERIOD))
 
 SPIKE_SIGMA = 8.0  # injected spike size in noise-sigmas
 NOISE = 0.05
@@ -60,7 +72,7 @@ def gen(kind: str, b: int, th: int, tc: int, seed: int = 0):
         if kind == "flat":
             return 1.0 + 0.0 * t
         if kind == "seasonal":
-            return 1.0 + SEASON_AMP * np.sin(2 * np.pi * t / 24.0)
+            return 1.0 + SEASON_AMP * np.sin(2 * np.pi * t / PERIOD)
         if kind == "trend":
             return 1.0 + TREND_PER_STEP * t
         raise ValueError(kind)
@@ -75,8 +87,8 @@ def gen(kind: str, b: int, th: int, tc: int, seed: int = 0):
     return hist.astype(np.float32), cur.astype(np.float32), truth
 
 
-def run_scenario(kind: str, algorithm: str, b: int, th: int, tc: int):
-    hist, cur, truth = gen(kind, b, th, tc)
+def make_batch(hist: np.ndarray, cur: np.ndarray) -> scoring.ScoreBatch:
+    b = hist.shape[0]
 
     def win(v):
         return MetricWindows(
@@ -85,7 +97,7 @@ def run_scenario(kind: str, algorithm: str, b: int, th: int, tc: int):
             times=jnp.zeros(v.shape, jnp.int32),
         )
 
-    batch = scoring.ScoreBatch(
+    return scoring.ScoreBatch(
         historical=win(hist),
         current=win(cur),
         baseline=MetricWindows(
@@ -98,14 +110,15 @@ def run_scenario(kind: str, algorithm: str, b: int, th: int, tc: int):
         min_lower_bound=jnp.zeros((b,), jnp.float32),
         min_points=jnp.full((b,), 10, jnp.int32),
     )
+
+
+def score_algorithm(batch, truth: np.ndarray, algorithm: str):
     res = scoring.score(batch, algorithm=algorithm)
     flags = np.asarray(res.anomalies)
     tp = int((flags & truth).sum())
     fp = int((flags & ~truth).sum())
     fn = int((~flags & truth).sum())
-    precision = tp / max(tp + fp, 1)
-    recall = tp / max(tp + fn, 1)
-    f1 = 2 * precision * recall / max(precision + recall, 1e-9)
+    precision, recall, f1 = prf1(tp, fp, fn)
     return f1, precision, recall
 
 
@@ -113,12 +126,17 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--small", action="store_true")
     args = ap.parse_args(argv)
+    _register_models()
     b = 32 if args.small else 256
-    th = 240 if args.small else 1008  # 7 days at 10-min step (24-pt season)
+    th = 240 if args.small else 1008  # ~10-42 cycles of the 24-step season
     tc = 30
     for kind in ("flat", "seasonal", "trend"):
+        # one draw + one batch per scenario: every algorithm judges the
+        # exact same arrays
+        hist, cur, truth = gen(kind, b, th, tc)
+        batch = make_batch(hist, cur)
         for algo in ALGORITHMS:
-            f1, p, r = run_scenario(kind, algo, b, th, tc)
+            f1, p, r = score_algorithm(batch, truth, algo)
             print(
                 json.dumps(
                     {

@@ -208,9 +208,9 @@ def config_f1_golden_trace(small: bool):
     tp = len(flagged & truth)
     fp = len(flagged - truth)
     fn = len(truth - flagged)
-    precision = tp / max(tp + fp, 1)
-    recall = tp / max(tp + fn, 1)
-    f1 = 2 * precision * recall / max(precision + recall, 1e-9)
+    from benchmarks import prf1
+
+    precision, recall, f1 = prf1(tp, fp, fn)
     _emit(
         "f1-golden-trace",
         "anomaly_f1",
