@@ -181,3 +181,38 @@ def test_lower_bound_detection():
     [v] = HealthJudge(cfg).judge([_task("lb", "m", hist, cur, mtype="tps")])
     assert v.verdict == UNHEALTHY
     assert v.anomaly_pairs[1] == pytest.approx(0.5)
+
+
+def test_bucketing_bounds_compiles_for_ragged_tasks():
+    """SURVEY 'hard part' (b): heterogeneous window lengths must compile a
+    handful of programs, not one per job. 60 random-length tasks may
+    produce at most ~log2 distinct (hist, cur) buckets."""
+    import numpy as np
+
+    from foremast_tpu.engine.judge import HealthJudge, MetricTask, bucket_length
+
+    rng = np.random.default_rng(0)
+    tasks = []
+    buckets = set()
+    for i in range(60):
+        nh = int(rng.integers(3, 700))
+        nc = int(rng.integers(1, 40))
+        ht = 1_700_000_000 + 60 * np.arange(nh, dtype=np.int64)
+        ct = ht[-1] + 60 * np.arange(1, nc + 1, dtype=np.int64)
+        tasks.append(
+            MetricTask(
+                job_id=f"j{i}",
+                alias="m",
+                metric_type=None,
+                hist_times=ht,
+                hist_values=rng.normal(1.0, 0.1, nh).astype(np.float32),
+                cur_times=ct,
+                cur_values=rng.normal(1.0, 0.1, nc).astype(np.float32),
+            )
+        )
+        buckets.add((bucket_length(nh), bucket_length(nc)))
+
+    assert len(buckets) <= 24  # powers of two: ~7 hist x ~3 cur at most
+    verdicts = HealthJudge().judge(tasks)
+    assert len(verdicts) == 60
+    assert {v.job_id for v in verdicts} == {t.job_id for t in tasks}
