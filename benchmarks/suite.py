@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from benchmarks import prf1
+
 PER_CHIP_TARGET = 100_000 / 8
 
 
@@ -208,8 +210,6 @@ def config_f1_golden_trace(small: bool):
     tp = len(flagged & truth)
     fp = len(flagged - truth)
     fn = len(truth - flagged)
-    from benchmarks import prf1
-
     precision, recall, f1 = prf1(tp, fp, fn)
     _emit(
         "f1-golden-trace",

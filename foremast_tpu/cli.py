@@ -260,21 +260,18 @@ def cmd_worker(args: argparse.Namespace) -> int:
     # (claimed docs get written back) instead of dying mid-judgment —
     # abandoned claims would otherwise wait out MAX_STUCK_IN_SECONDS
     import signal
+    import threading
 
-    stopping = {"flag": False}
-
-    def _term(signum, frame):
-        stopping["flag"] = True
-
+    stop_event = threading.Event()
     try:
-        signal.signal(signal.SIGTERM, _term)
-        signal.signal(signal.SIGINT, _term)
+        signal.signal(signal.SIGTERM, lambda s, f: stop_event.set())
+        signal.signal(signal.SIGINT, lambda s, f: stop_event.set())
     except ValueError:
         pass  # not the main thread (embedded use); rely on the caller
 
     worker.run(
         poll_seconds=args.poll,
-        stop=lambda: stopping["flag"],
+        stop=stop_event.is_set,
         after_tick=after_tick,
     )
     if ckpt_path and len(judge.cache):

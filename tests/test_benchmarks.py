@@ -23,13 +23,11 @@ def test_suite_config1_runs_small(capsys):
 def test_quality_benchmark_structured_beats_flat_on_seasonal(capsys):
     """Smoke the quality harness: fitted HW must dominate the global-mean
     default on the seasonal scenario."""
-    import json as _json
-
     import benchmarks.quality as quality
 
     quality.main(["--small"])
     rows = [
-        _json.loads(line) for line in capsys.readouterr().out.strip().splitlines()
+        json.loads(line) for line in capsys.readouterr().out.strip().splitlines()
     ]
     by = {(r["scenario"], r["algorithm"]): r["f1"] for r in rows}
     assert by[("seasonal", "holt_winters")] > 0.9
