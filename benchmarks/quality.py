@@ -113,6 +113,7 @@ def make_batch(hist: np.ndarray, cur: np.ndarray) -> scoring.ScoreBatch:
 
 
 def score_algorithm(batch, truth: np.ndarray, algorithm: str):
+    _register_models()  # idempotent: any entry point may call first
     res = scoring.score(batch, algorithm=algorithm)
     flags = np.asarray(res.anomalies)
     tp = int((flags & truth).sum())
