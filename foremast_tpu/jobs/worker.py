@@ -85,6 +85,15 @@ class BrainWorker:
         self.worker_id = worker_id or f"brain-{uuid.uuid4().hex[:8]}"
         self.claim_limit = claim_limit
         self.on_verdict = on_verdict  # gauge-export hook (observe/)
+        # Historical-window cache for the incremental re-check loop
+        # (SURVEY "hard part" (d)): a job's historical query_range URL is
+        # FIXED for the job's lifetime (a closed 7-day range), so a job
+        # re-checked every tick until endTime need not re-fetch ~10k-point
+        # histories each time. Keyed by URL; bounded LRU shared with the
+        # brain's MAX_CACHE_SIZE sizing.
+        from foremast_tpu.models.cache import ModelCache
+
+        self._hist_cache = ModelCache(self.config.max_cache_size)
 
     # -- preprocess: document -> MetricTasks ----------------------------
 
@@ -99,11 +108,10 @@ class BrainWorker:
         try:
             for alias, cur_url in cur.items():
                 ct, cv = self.source.fetch(cur_url)
-                ht, hv = (
-                    self.source.fetch(hist[alias])
-                    if alias in hist
-                    else (ct[:0], cv[:0])
-                )
+                if alias in hist:
+                    ht, hv = self._fetch_hist_cached(hist[alias])
+                else:
+                    ht, hv = ct[:0], cv[:0]
                 kw = {}
                 if alias in base:
                     bt, bv = self.source.fetch(base[alias])
@@ -125,6 +133,15 @@ class BrainWorker:
             log.warning("preprocess failed for %s: %s", doc.id, e)
             return None
         return tasks
+
+    def _fetch_hist_cached(self, url: str):
+        """Fetch a historical window, memoized by URL (immutable range)."""
+        cached = self._hist_cache.get(url)
+        if cached is not None:
+            return cached
+        series = self.source.fetch(url)
+        self._hist_cache.put(url, series)
+        return series
 
     # -- postprocess: verdicts -> document status -----------------------
 

@@ -406,3 +406,31 @@ def test_two_workers_contend_without_double_processing(replay):
     assert all(
         d.status == STATUS_COMPLETED_HEALTH for d in store._docs.values()
     )
+
+
+def test_recheck_reuses_cached_history(replay):
+    """Incremental re-check (SURVEY hard part (d)): the immutable 7-day
+    history is fetched once per job, not once per tick."""
+
+    class Counting:
+        def __init__(self, inner):
+            self.inner = inner
+            self.urls = []
+
+        def fetch(self, url):
+            self.urls.append(url)
+            return self.inner.fetch(url)
+
+    src = Counting(replay)
+    store = InMemoryStore()
+    # endTime far in the future -> stays in the re-check loop
+    doc = _mk_doc("demo", "error4xx", "normal", end_time=str(2**31))
+    store.create(doc)
+    worker = BrainWorker(store, src, BrainConfig())
+
+    worker.tick(now=100.0)
+    worker.tick(now=200.0)  # re-claim + re-check the same open job
+    hist_fetches = [u for u in src.urls if "hist" in u]
+    cur_fetches = [u for u in src.urls if "normal" in u]
+    assert len(hist_fetches) == 1  # cached after the first tick
+    assert len(cur_fetches) == 2  # current window re-fetched each tick
