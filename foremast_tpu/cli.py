@@ -235,12 +235,21 @@ def cmd_worker(args: argparse.Namespace) -> int:
                     )
 
     on_verdict = None
+    worker_metrics = None
     if args.gauge_port:
+        from foremast_tpu.observe.gauges import WorkerMetrics
+
         gauges = BrainGauges()
+        worker_metrics = WorkerMetrics()
         start_metrics_server(args.gauge_port)
         on_verdict = make_verdict_hook(gauges)
     worker = BrainWorker(
-        store, PrometheusSource(), config=config, judge=judge, on_verdict=on_verdict
+        store,
+        PrometheusSource(),
+        config=config,
+        judge=judge,
+        on_verdict=on_verdict,
+        metrics=worker_metrics,
     )
 
     after_tick = None

@@ -70,6 +70,7 @@ class BrainWorker:
         worker_id: str | None = None,
         claim_limit: int = 256,
         on_verdict: Callable[[Document, list[MetricVerdict]], None] | None = None,
+        metrics=None,  # observe.gauges.WorkerMetrics (optional)
     ):
         self.store = store
         self.source = source
@@ -94,6 +95,7 @@ class BrainWorker:
         from foremast_tpu.models.cache import ModelCache
 
         self._hist_cache = ModelCache(self.config.max_cache_size)
+        self.metrics = metrics
 
     # -- preprocess: document -> MetricTasks ----------------------------
 
@@ -182,6 +184,7 @@ class BrainWorker:
 
     def tick(self, now: float | None = None) -> int:
         """One claim-fetch-judge-write cycle. Returns #docs processed."""
+        t0 = time.perf_counter()
         now = time.time() if now is None else now
         docs = self.store.claim(
             self.worker_id, self.config.max_stuck_seconds, self.claim_limit
@@ -224,11 +227,17 @@ class BrainWorker:
         for doc in ok_docs:
             vs = by_job.get(doc.id, [])
             self._write_back(doc, vs, now)
+            if self.metrics:
+                self.metrics.observe_doc(doc.status, len(vs))
             if self.on_verdict:
                 try:
                     self.on_verdict(doc, vs)
                 except Exception:
                     log.exception("on_verdict hook failed for %s", doc.id)
+        if self.metrics:
+            for doc in failed:
+                self.metrics.observe_doc(doc.status, 0)
+            self.metrics.tick_seconds.observe(time.perf_counter() - t0)
         return len(docs)
 
     def run(

@@ -108,6 +108,43 @@ def make_verdict_hook(gauges: BrainGauges, namespace: str | None = None):
     return hook
 
 
+class WorkerMetrics:
+    """Engine self-telemetry counters (alongside the foremastbrain gauges):
+
+        foremast_worker_jobs_total{status}   — documents finalized/updated
+        foremast_worker_windows_total        — metric windows judged
+        foremast_worker_tick_seconds         — claim-fetch-judge-write time
+
+    The reference exposes only model outputs; the engine's own throughput
+    is this framework's headline property, so it is first-class here.
+    """
+
+    def __init__(self, registry=None):
+        from prometheus_client import REGISTRY, Counter, Histogram
+
+        reg = registry if registry is not None else REGISTRY
+        self.jobs = Counter(
+            "foremast_worker_jobs_total",
+            "documents processed, by resulting status",
+            ["status"],
+            registry=reg,
+        )
+        self.windows = Counter(
+            "foremast_worker_windows_total",
+            "metric windows judged",
+            registry=reg,
+        )
+        self.tick_seconds = Histogram(
+            "foremast_worker_tick_seconds",
+            "duration of one claim-fetch-judge-write cycle",
+            registry=reg,
+        )
+
+    def observe_doc(self, status: str, n_windows: int) -> None:
+        self.jobs.labels(status=status).inc()
+        self.windows.inc(n_windows)
+
+
 def start_metrics_server(port: int = 8000, registry=None):
     """Serve /metrics on :8000 (the reference brain's scrape port)."""
     from prometheus_client import REGISTRY, start_http_server

@@ -104,3 +104,31 @@ def test_trace_scoring_noop(monkeypatch):
     monkeypatch.delenv("FOREMAST_PROFILE", raising=False)
     with trace_scoring():
         pass  # must not start a trace or raise
+
+
+def test_worker_metrics_counters(demo_traces):
+    from foremast_tpu.observe.gauges import WorkerMetrics
+
+    nt, nv = demo_traces["normal"]
+    st, sv = demo_traces["spike"]
+    hist = np.tile(nv, 6).astype(np.float32)
+    ht = 1700000000 + 60 * np.arange(len(hist), dtype=np.int64)
+    src = ReplaySource()
+    src.register("hist", (ht, hist))
+    src.register("cur", (st, sv))
+    store = InMemoryStore()
+    store.create(
+        Document(
+            id="wm1",
+            app_name="demo",
+            current_config="error4xx== http://x/cur",
+            historical_config="error4xx== http://x/hist",
+        )
+    )
+    reg = CollectorRegistry()
+    metrics = WorkerMetrics(registry=reg)
+    BrainWorker(store, src, BrainConfig(), metrics=metrics).tick(now=1e12)
+    text = generate_latest(reg).decode()
+    assert 'foremast_worker_jobs_total{status="completed_unhealth"} 1.0' in text
+    assert "foremast_worker_windows_total 1.0" in text
+    assert "foremast_worker_tick_seconds_count 1.0" in text
