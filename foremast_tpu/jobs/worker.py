@@ -45,6 +45,25 @@ from foremast_tpu.metrics.source import MetricSource
 
 log = logging.getLogger("foremast_tpu.worker")
 
+# History-cache sizing and admission: entries are whole ~10k-point series
+# (~120 KB), so the cap is independent of MAX_CACHE_SIZE (model params);
+# a range's `end` must be at least this far in the past before its series
+# is treated as immutable (covers the reference's 1-min Prometheus
+# ingestion latency with margin, metricsquery.go:53-55).
+HIST_CACHE_ENTRIES = 256
+HIST_SETTLED_SECONDS = 120.0
+
+
+def _query_param_float(url: str, name: str) -> float | None:
+    """Numeric query parameter from a URL, or None."""
+    import urllib.parse
+
+    try:
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(url).query)
+        return float(q[name][0])
+    except (KeyError, ValueError, IndexError):
+        return None
+
 
 def infer_metric_type(alias: str, config: BrainConfig) -> str | None:
     """Map a metric alias onto a per-type threshold row by substring match
@@ -88,13 +107,14 @@ class BrainWorker:
         self.on_verdict = on_verdict  # gauge-export hook (observe/)
         # Historical-window cache for the incremental re-check loop
         # (SURVEY "hard part" (d)): a job's historical query_range URL is
-        # FIXED for the job's lifetime (a closed 7-day range), so a job
-        # re-checked every tick until endTime need not re-fetch ~10k-point
-        # histories each time. Keyed by URL; bounded LRU shared with the
-        # brain's MAX_CACHE_SIZE sizing.
+        # fixed for the job's lifetime, so a job re-checked every tick
+        # until endTime need not re-fetch ~10k-point histories each time.
+        # Only ranges whose `end` is safely in the past are cached (see
+        # _fetch_hist_cached); sized independently of MAX_CACHE_SIZE —
+        # entries are ~120 KB series, not model params.
         from foremast_tpu.models.cache import ModelCache
 
-        self._hist_cache = ModelCache(self.config.max_cache_size)
+        self._hist_cache = ModelCache(HIST_CACHE_ENTRIES)
         self.metrics = metrics
 
     # -- preprocess: document -> MetricTasks ----------------------------
@@ -137,12 +157,22 @@ class BrainWorker:
         return tasks
 
     def _fetch_hist_cached(self, url: str):
-        """Fetch a historical window, memoized by URL (immutable range)."""
+        """Fetch a historical window, memoized by URL when the range is
+        provably immutable.
+
+        The watcher builds historical ranges ending at deploy start, but
+        REST clients may supply arbitrary params — a range whose `end`
+        lies in the future (or too close to now for Prometheus ingestion
+        to have settled) would freeze a truncated series for the job's
+        lifetime. Such URLs are fetched fresh every tick.
+        """
         cached = self._hist_cache.get(url)
         if cached is not None:
             return cached
         series = self.source.fetch(url)
-        self._hist_cache.put(url, series)
+        end = _query_param_float(url, "end")
+        if end is not None and end <= time.time() - HIST_SETTLED_SECONDS:
+            self._hist_cache.put(url, series)
         return series
 
     # -- postprocess: verdicts -> document status -----------------------
@@ -190,6 +220,10 @@ class BrainWorker:
             self.worker_id, self.config.max_stuck_seconds, self.claim_limit
         )
         if not docs:
+            # idle cycles still did the claim round-trip (real store I/O)
+            # and must be visible on the tick histogram
+            if self.metrics:
+                self.metrics.tick_seconds.observe(time.perf_counter() - t0)
             return 0
 
         # Fetch every claimed doc's windows concurrently: the fetches are

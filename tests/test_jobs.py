@@ -423,8 +423,11 @@ def test_recheck_reuses_cached_history(replay):
 
     src = Counting(replay)
     store = InMemoryStore()
-    # endTime far in the future -> stays in the re-check loop
+    # endTime far in the future -> stays in the re-check loop; the hist
+    # URL carries an `end` safely in the past, making the range provably
+    # immutable (the cache-admission rule)
     doc = _mk_doc("demo", "error4xx", "normal", end_time=str(2**31))
+    doc.historical_config = "error4xx== http://replay/hist?end=1700000000"
     store.create(doc)
     worker = BrainWorker(store, src, BrainConfig())
 
@@ -434,3 +437,28 @@ def test_recheck_reuses_cached_history(replay):
     cur_fetches = [u for u in src.urls if "normal" in u]
     assert len(hist_fetches) == 1  # cached after the first tick
     assert len(cur_fetches) == 2  # current window re-fetched each tick
+
+
+def test_recheck_refetches_unsettled_history(replay):
+    """A historical range without a provably-past `end` must NOT be
+    cached: REST clients can submit arbitrary params, and freezing an
+    in-progress range would judge against truncated data forever."""
+
+    class Counting:
+        def __init__(self, inner):
+            self.inner = inner
+            self.urls = []
+
+        def fetch(self, url):
+            self.urls.append(url)
+            return self.inner.fetch(url)
+
+    src = Counting(replay)
+    store = InMemoryStore()
+    # no `end` param on the hist URL -> not provably immutable
+    doc = _mk_doc("demo", "error4xx", "normal", end_time=str(2**31))
+    store.create(doc)
+    worker = BrainWorker(store, src, BrainConfig())
+    worker.tick(now=100.0)
+    worker.tick(now=200.0)
+    assert len([u for u in src.urls if "hist" in u]) == 2
