@@ -103,19 +103,21 @@ def init_distributed(
     )
     if coordinator_address is None and num_processes is None:
         return False  # single-host: nothing to coordinate
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-    except RuntimeError as e:
-        # idempotent ONLY for the already-initialized case; a connect or
-        # barrier failure must surface — swallowing it would leave this
-        # process on a local-only "global" mesh while its peers hang at
-        # the init barrier
-        if "already initialized" not in str(e).lower():
-            raise
+    if jax.distributed.is_initialized():
+        # idempotent: a prior initialize (ours, the runtime's TPU-pod
+        # auto-init, or an embedding application's) wins. Re-calling
+        # jax.distributed.initialize here would raise the generic
+        # "must be called before any JAX calls" error, not a clean
+        # already-initialized signal.
+        return True
+    # a connect or barrier failure surfaces to the caller — swallowing it
+    # would leave this process on a local-only "global" mesh while its
+    # peers hang at the init barrier
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
     return True
 
 
