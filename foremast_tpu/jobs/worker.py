@@ -54,15 +54,32 @@ HIST_CACHE_ENTRIES = 256
 HIST_SETTLED_SECONDS = 120.0
 
 
-def _query_param_float(url: str, name: str) -> float | None:
-    """Numeric query parameter from a URL, or None."""
+def _hist_end_epoch(url: str) -> float | None:
+    """The historical range's end as unix seconds, or None if unknown.
+
+    Handles both datasource URL shapes: Prometheus query_range's `?end=`
+    parameter (epoch float or RFC3339 — Prometheus accepts either,
+    prometheushelper.go:12-27) and the wavefront stub's
+    `<query>&&<start>&&<unit>&&<end>` encoding (wavefronthelper.go:20-29).
+    """
     import urllib.parse
 
+    raw: str | None = None
     try:
         q = urllib.parse.parse_qs(urllib.parse.urlparse(url).query)
-        return float(q[name][0])
-    except (KeyError, ValueError, IndexError):
+        raw = q["end"][0]
+    except (KeyError, IndexError):
+        if "&&" in url:
+            parts = url.split("&&")
+            if len(parts) >= 4:
+                raw = parts[3]
+    if raw is None:
         return None
+    try:
+        return float(raw)
+    except ValueError:
+        ts = parse_time(raw)  # RFC3339 fallback; 0.0 when unparseable
+        return ts if ts > 0 else None
 
 
 def infer_metric_type(alias: str, config: BrainConfig) -> str | None:
@@ -119,7 +136,7 @@ class BrainWorker:
 
     # -- preprocess: document -> MetricTasks ----------------------------
 
-    def _fetch_tasks(self, doc: Document) -> list[MetricTask] | None:
+    def _fetch_tasks(self, doc: Document, now: float) -> list[MetricTask] | None:
         """Fetch every window of every alias; None => preprocess failure."""
         cur = decode_config(doc.current_config)
         base = decode_config(doc.baseline_config)
@@ -131,7 +148,7 @@ class BrainWorker:
             for alias, cur_url in cur.items():
                 ct, cv = self.source.fetch(cur_url)
                 if alias in hist:
-                    ht, hv = self._fetch_hist_cached(hist[alias])
+                    ht, hv = self._fetch_hist_cached(hist[alias], now)
                 else:
                     ht, hv = ct[:0], cv[:0]
                 kw = {}
@@ -156,22 +173,23 @@ class BrainWorker:
             return None
         return tasks
 
-    def _fetch_hist_cached(self, url: str):
+    def _fetch_hist_cached(self, url: str, now: float):
         """Fetch a historical window, memoized by URL when the range is
         provably immutable.
 
         The watcher builds historical ranges ending at deploy start, but
-        REST clients may supply arbitrary params — a range whose `end`
-        lies in the future (or too close to now for Prometheus ingestion
+        REST clients may supply arbitrary params — a range whose end
+        lies in the future (or too close to `now` for datastore ingestion
         to have settled) would freeze a truncated series for the job's
-        lifetime. Such URLs are fetched fresh every tick.
+        lifetime. Such URLs are fetched fresh every tick. `now` is the
+        tick's injectable clock so admission is deterministic in tests.
         """
         cached = self._hist_cache.get(url)
         if cached is not None:
             return cached
         series = self.source.fetch(url)
-        end = _query_param_float(url, "end")
-        if end is not None and end <= time.time() - HIST_SETTLED_SECONDS:
+        end = _hist_end_epoch(url)
+        if end is not None and end <= now - HIST_SETTLED_SECONDS:
             self._hist_cache.put(url, series)
         return series
 
@@ -235,11 +253,12 @@ class BrainWorker:
         ok_docs: list[Document] = []
         if len(docs) > 1:
             from concurrent.futures import ThreadPoolExecutor
+            from functools import partial as _partial
 
             with ThreadPoolExecutor(max_workers=min(16, len(docs))) as pool:
-                fetched = list(pool.map(self._fetch_tasks, docs))
+                fetched = list(pool.map(_partial(self._fetch_tasks, now=now), docs))
         else:
-            fetched = [self._fetch_tasks(doc) for doc in docs]
+            fetched = [self._fetch_tasks(doc, now) for doc in docs]
         for doc, tasks in zip(docs, fetched):
             # claim() already flipped + persisted preprocess_inprogress
             if tasks is None:

@@ -431,8 +431,9 @@ def test_recheck_reuses_cached_history(replay):
     store.create(doc)
     worker = BrainWorker(store, src, BrainConfig())
 
-    worker.tick(now=100.0)
-    worker.tick(now=200.0)  # re-claim + re-check the same open job
+    # injected clock well past the range end + settle margin
+    worker.tick(now=1700000000 + 300.0)
+    worker.tick(now=1700000000 + 400.0)  # re-claim + re-check the same job
     hist_fetches = [u for u in src.urls if "hist" in u]
     cur_fetches = [u for u in src.urls if "normal" in u]
     assert len(hist_fetches) == 1  # cached after the first tick
@@ -462,3 +463,17 @@ def test_recheck_refetches_unsettled_history(replay):
     worker.tick(now=100.0)
     worker.tick(now=200.0)
     assert len([u for u in src.urls if "hist" in u]) == 2
+
+
+def test_hist_end_epoch_parses_all_url_shapes():
+    from foremast_tpu.jobs.worker import _hist_end_epoch
+
+    assert _hist_end_epoch("http://p/api/v1/query_range?q=x&end=1700000000") == 1700000000.0
+    # RFC3339 end (Prometheus accepts it)
+    assert _hist_end_epoch(
+        "http://p/api/v1/query_range?end=2023-11-14T22:13:20Z"
+    ) == 1700000000.0
+    # wavefront stub shape: <query>&&<start>&&<unit>&&<end>
+    assert _hist_end_epoch("ts(x)&&1699990000&&m&&1700000000") == 1700000000.0
+    assert _hist_end_epoch("http://p/api/v1/query_range?q=x") is None
+    assert _hist_end_epoch("http://p/api/v1/query_range?end=garbage") is None
