@@ -340,6 +340,33 @@ async function refresh() {
     "updated " + new Date().toLocaleTimeString();
 }
 
+/* ---------------- time-range presets ---------------- */
+
+const RANGES = [
+  { label: "1h", seconds: 3600, step: 15 },
+  { label: "6h", seconds: 6 * 3600, step: 60 },
+  { label: "24h", seconds: 24 * 3600, step: 300 },
+];
+
+function buildRanges() {
+  const nav = document.getElementById("ranges");
+  for (const r of RANGES) {
+    const b = document.createElement("button");
+    b.textContent = r.label;
+    b.setAttribute(
+      "aria-pressed", String(r.seconds === CFG.windowSeconds)
+    );
+    b.addEventListener("click", () => {
+      CFG.windowSeconds = r.seconds;
+      CFG.stepSeconds = r.step; // coarser step keeps point counts bounded
+      for (const other of nav.children)
+        other.setAttribute("aria-pressed", String(other === b));
+      refresh();
+    });
+    nav.appendChild(b);
+  }
+}
+
 document.getElementById("scope").textContent = `${CFG.namespace} / ${CFG.app}`;
 document.getElementById("tableToggle").addEventListener("change", (e) => {
   tableMode = e.target.checked;
@@ -348,5 +375,6 @@ document.getElementById("tableToggle").addEventListener("change", (e) => {
 addEventListener("resize", () => { for (const p of panels) renderPanel(p); });
 
 buildPanels();
+buildRanges();
 refresh();
 setInterval(refresh, CFG.pollSeconds * 1000);
