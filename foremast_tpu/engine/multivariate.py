@@ -16,6 +16,16 @@ attribute flagged timestamps back to every alias in the job (the wire
 format is per-alias anomaly pairs, `Barrelman.go:593-620`). Per-alias
 gauge bounds stay meaningful via marginal mean +/- threshold * sigma.
 
+Canary pairwise semantics (`docs/guides/design.md:31-33`,
+`foremast-brain/README.md:5-11`) apply to joint jobs exactly as to
+univariate ones: every metric's current window is tested against its
+baseline window (Mann-Whitney / Wilcoxon / Kruskal per
+ML_PAIRWISE_ALGORITHM), and if ANY metric's distributions differ the
+job's joint detection threshold is lowered by
+`scoring.DIFF_THRESHOLD_FACTOR` — a suspicious canary gets tighter
+bounds. Per-alias p-values and differ flags ride the verdicts so the
+wire format carries the same evidence as the univariate path.
+
 LSTM-AE fleets are trained per (app, alias-set) with a bounded
 `ModelCache` (`MAX_CACHE_SIZE`, `foremast-brain/README.md:30`) so repeat
 judgments of the same service skip training.
@@ -43,6 +53,7 @@ from foremast_tpu.models.lstm_ae import (
     fit_many,
     score_many,
 )
+from foremast_tpu.ops.windows import MetricWindows
 
 log = logging.getLogger("foremast_tpu.engine.multivariate")
 
@@ -218,7 +229,70 @@ class MultivariateJudge:
         ct, cv = _align(job_tasks, "cur")
         return _JointJob(job_tasks, ht, hv, ct, cv)
 
-    def _unknown(self, job_tasks: list[MetricTask]) -> list[MetricVerdict]:
+    def _pairwise(
+        self, joints: list[_JointJob]
+    ) -> list[tuple[np.ndarray, np.ndarray]]:
+        """Per-job (p [F], differs [F]) — each alias's raw current window
+        tested against its own baseline window, exactly the univariate
+        canary check (`design.md:31-33`). Metrics without a baseline (the
+        rollingUpdate strategy) fail every min-points gate and report
+        (1.0, False)."""
+        cfg = self.config
+        tasks = [t for j in joints for t in j.tasks]
+        if all(t.base_values is None for t in tasks):
+            # baseline-less batch (rollingUpdate): provably (1.0, False)
+            # everywhere — skip the packing + kernel dispatch entirely
+            return [
+                (np.ones(len(j.tasks)), np.zeros(len(j.tasks), bool))
+                for j in joints
+            ]
+        tc = bucket_length(
+            max(
+                max(
+                    len(t.cur_values),
+                    0 if t.base_values is None else len(t.base_values),
+                )
+                for t in tasks
+            )
+        )
+        empty = (np.zeros(0, np.int64), np.zeros(0, np.float32))
+        cur = MetricWindows.from_ragged(
+            [(t.cur_times, t.cur_values) for t in tasks], tc
+        )
+        base = MetricWindows.from_ragged(
+            [
+                (t.base_times, t.base_values)
+                if t.base_values is not None
+                else empty
+                for t in tasks
+            ],
+            tc,
+        )
+        p, differs = scoring.pairwise(
+            cur,
+            base,
+            algorithm=cfg.pairwise.algorithm,
+            p_threshold=cfg.pairwise.threshold,
+            min_mw=cfg.pairwise.min_mann_white_points,
+            min_wilcoxon=cfg.pairwise.min_wilcoxon_points,
+            min_kruskal=cfg.pairwise.min_kruskal_points,
+        )
+        p, differs = np.asarray(p), np.asarray(differs)
+        out, i = [], 0
+        for j in joints:
+            f = len(j.tasks)
+            out.append((p[i : i + f], differs[i : i + f]))
+            i += f
+        return out
+
+    def _unknown(
+        self,
+        job_tasks: list[MetricTask],
+        pairwise: tuple[np.ndarray, np.ndarray] | None = None,
+    ) -> list[MetricVerdict]:
+        """UNKNOWN verdicts still carry real pairwise evidence when it was
+        computed (parity with the univariate ScoreResult, which always
+        publishes p/differs regardless of measurability)."""
         return [
             MetricVerdict(
                 job_id=t.job_id,
@@ -227,17 +301,38 @@ class MultivariateJudge:
                 anomaly_pairs=[],
                 upper=np.zeros(len(t.cur_values), np.float32),
                 lower=np.zeros(len(t.cur_values), np.float32),
-                p_value=1.0,
-                dist_differs=False,
+                p_value=1.0 if pairwise is None else float(pairwise[0][f]),
+                dist_differs=False
+                if pairwise is None
+                else bool(pairwise[1][f]),
             )
-            for t in job_tasks
+            for f, t in enumerate(job_tasks)
         ]
+
+    def _effective_thresholds(
+        self,
+        pw: list[tuple[np.ndarray, np.ndarray]],
+        threshold: float,
+    ) -> np.ndarray:
+        """Per-job joint threshold: lowered by DIFF_THRESHOLD_FACTOR when
+        ANY alias's distributions differ (design.md:33) — the one rule both
+        joint paths share."""
+        return np.asarray(
+            [
+                threshold * scoring.DIFF_THRESHOLD_FACTOR
+                if bool(d.any())
+                else threshold
+                for _, d in pw
+            ],
+            np.float32,
+        )
 
     def _emit(
         self,
         job: _JointJob,
         flags: np.ndarray,  # [nc] bool over the aligned current points
         threshold: float,
+        pairwise: tuple[np.ndarray, np.ndarray] | None = None,  # (p[F], differs[F])
     ) -> list[MetricVerdict]:
         """Joint flags -> per-alias verdicts in the reference wire form."""
         flagged_times = job.cur_t[flags]
@@ -259,8 +354,10 @@ class MultivariateJudge:
                     anomaly_pairs=pairs,
                     upper=up[f],
                     lower=lo[f],
-                    p_value=1.0,  # pairwise tests are a univariate concept
-                    dist_differs=False,
+                    p_value=1.0 if pairwise is None else float(pairwise[0][f]),
+                    dist_differs=False
+                    if pairwise is None
+                    else bool(pairwise[1][f]),
                 )
             )
         return out
@@ -270,13 +367,17 @@ class MultivariateJudge:
     def _judge_bivariate(self, jobs: list[list[MetricTask]]) -> list[MetricVerdict]:
         threshold = self.config.anomaly.rule_for(None).threshold
         min_pts = self.config.min_historical_points
-        joints, out = [], []
-        for job_tasks in jobs:
-            j = self._joint(job_tasks)
+        # pairwise evidence is computed for EVERY job — even ones that end
+        # up UNKNOWN — so the wire always carries it (univariate parity)
+        all_joints = [self._joint(job_tasks) for job_tasks in jobs]
+        all_pw = self._pairwise(all_joints)
+        joints, pw, out = [], [], []
+        for j, p in zip(all_joints, all_pw):
             if len(j.hist_t) < min_pts or len(j.cur_t) == 0:
-                out.extend(self._unknown(job_tasks))
+                out.extend(self._unknown(j.tasks, p))
             else:
                 joints.append(j)
+                pw.append(p)
         if not joints:
             return out
 
@@ -287,14 +388,19 @@ class MultivariateJudge:
         cx, cm = _pack([j.cur_v[0] for j in joints], tc)
         cy, _ = _pack([j.cur_v[1] for j in joints], tc)
 
+        eff_thr = self._effective_thresholds(pw, threshold)
         fit = fit_bivariate(hx, hy, hm, min_points=min_pts)
-        flags = np.asarray(detect_bivariate(fit, cx, cy, cm, threshold))
+        flags = np.asarray(detect_bivariate(fit, cx, cy, cm, jnp.asarray(eff_thr)))
         valid = np.asarray(fit.valid)
         for i, j in enumerate(joints):
             if not valid[i]:
-                out.extend(self._unknown(j.tasks))
+                out.extend(self._unknown(j.tasks, pw[i]))
             else:
-                out.extend(self._emit(j, flags[i, : len(j.cur_t)], threshold))
+                out.extend(
+                    self._emit(
+                        j, flags[i, : len(j.cur_t)], float(eff_thr[i]), pw[i]
+                    )
+                )
         return out
 
     # -- LSTM autoencoder ------------------------------------------------
@@ -315,7 +421,7 @@ class MultivariateJudge:
             # the history must fill at least one training window of this
             # job's own bucket, and clear the configured minimum
             if len(j.cur_t) == 0 or len(j.hist_t) < max(min_pts, tc):
-                out.extend(self._unknown(job_tasks))
+                out.extend(self._unknown(job_tasks, self._pairwise([j])[0]))
             else:
                 groups.setdefault((f, tc), []).append(j)
 
@@ -389,10 +495,16 @@ class MultivariateJudge:
             cur_masks.append(m[None])
         xq = jnp.asarray(np.stack(cur_rows))  # [S, 1, tc, F]
         mq = jnp.asarray(np.stack(cur_masks))
-        flags, _err = score_many(stacked, xq, mq, mu, sd, threshold)
+        # canary check: a differing alias lowers the job's joint recon-error
+        # threshold (design.md:33), same rule as the bivariate path
+        pw = self._pairwise(joints)
+        eff_thr = self._effective_thresholds(pw, threshold)
+        flags, _err = score_many(stacked, xq, mq, mu, sd, jnp.asarray(eff_thr))
         flags = np.asarray(flags)[:, 0, :]  # [S, tc]
         for i, j in enumerate(joints):
-            out.extend(self._emit(j, flags[i, : len(j.cur_t)], threshold))
+            out.extend(
+                self._emit(j, flags[i, : len(j.cur_t)], float(eff_thr[i]), pw[i])
+            )
         return out
 
     def _key(self, j: _JointJob, tc: int) -> tuple:

@@ -175,6 +175,20 @@ def pairwise_decision(
     return p, differs
 
 
+# jitted form of pairwise_decision for callers outside an enclosing jit
+# (the multivariate judge runs it stand-alone per joint-job batch)
+pairwise = partial(
+    jax.jit,
+    static_argnames=(
+        "algorithm",
+        "p_threshold",
+        "min_mw",
+        "min_wilcoxon",
+        "min_kruskal",
+    ),
+)(pairwise_decision)
+
+
 # Threshold multiplier applied when baseline and current distributions
 # differ ("lower the threshold", design.md:33): tighter bounds => more
 # sensitive detection during a suspicious canary.

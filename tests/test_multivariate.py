@@ -17,9 +17,15 @@ from foremast_tpu.engine.multivariate import (
 )
 
 
-def _task(job, alias, hist_v, cur_v, t0=1_700_000_000, step=60):
+def _task(job, alias, hist_v, cur_v, base_v=None, t0=1_700_000_000, step=60):
     hist_t = t0 + step * np.arange(len(hist_v), dtype=np.int64)
     cur_t = t0 + step * (len(hist_v) + np.arange(len(cur_v), dtype=np.int64))
+    base = {}
+    if base_v is not None:
+        base = dict(
+            base_times=t0 - step * np.arange(len(base_v), 0, -1, dtype=np.int64),
+            base_values=np.asarray(base_v, np.float32),
+        )
     return MetricTask(
         job_id=job,
         alias=alias,
@@ -28,6 +34,7 @@ def _task(job, alias, hist_v, cur_v, t0=1_700_000_000, step=60):
         hist_values=np.asarray(hist_v, np.float32),
         cur_times=cur_t,
         cur_values=np.asarray(cur_v, np.float32),
+        **base,
     )
 
 
@@ -234,6 +241,98 @@ def test_lstm_cache_warm_restart_via_checkpoint(tmp_path):
     for a, b in zip(ref, verdicts):
         assert a.verdict == b.verdict
         assert a.anomaly_pairs == b.anomaly_pairs
+
+
+def _indep_pair(rng, n):
+    """Two independent metrics so joint Mahalanobis ~ zx^2 + zy^2."""
+    x = rng.normal(1.0, 0.2, n).astype(np.float32)
+    y = rng.normal(2.0, 0.3, n).astype(np.float32)
+    return x, y
+
+
+def test_bivariate_canary_shifted_baseline_lowers_threshold_and_flags():
+    """The reference's canary flow (design.md:31-33) on a 2-metric job: a
+    current window ~1 sigma off-center is healthy at the global threshold
+    (2.0), but a baseline that proves the distributions shifted lowers the
+    joint threshold and the same window flags."""
+    rng = np.random.default_rng(10)
+    hx, hy = _indep_pair(rng, 400)
+    # current: both metrics pinned ~1 sigma above their historical means
+    # -> d^2 ~ 2: inside the 2.0-sigma ellipse, outside the lowered 1.0
+    cx = np.full(24, 1.0 + 0.2, np.float32) + rng.normal(0, 0.01, 24).astype(
+        np.float32
+    )
+    cy = np.full(24, 2.0 + 0.3, np.float32) + rng.normal(0, 0.01, 24).astype(
+        np.float32
+    )
+    # baseline drawn from the historical distribution: clearly different
+    # from the pinned current -> Mann-Whitney rejects
+    bx, by = _indep_pair(rng, 24)
+
+    cfg = BrainConfig(algorithm=ALGO_BIVARIATE)
+    judge = MultivariateJudge(cfg)
+
+    # without a baseline: healthy at threshold 2.0
+    plain = judge.judge(
+        [_task("j1", "a", hx, cx), _task("j1", "b", hy, cy)]
+    )
+    assert all(v.verdict == scoring.HEALTHY for v in plain)
+    assert all(v.p_value == 1.0 and not v.dist_differs for v in plain)
+
+    # with a shifted baseline: threshold lowered -> unhealthy, and the
+    # verdicts carry real per-alias pairwise evidence
+    canary = judge.judge(
+        [_task("j2", "a", hx, cx, base_v=bx), _task("j2", "b", hy, cy, base_v=by)]
+    )
+    assert all(v.verdict == scoring.UNHEALTHY for v in canary)
+    assert all(v.dist_differs for v in canary)
+    assert all(v.p_value < 0.05 for v in canary)
+    assert all(len(v.anomaly_pairs) > 0 for v in canary)
+
+
+def test_bivariate_same_distribution_baseline_keeps_threshold():
+    """A baseline matching the current distribution must NOT lower the
+    threshold (no false canary sensitivity)."""
+    rng = np.random.default_rng(11)
+    hx, hy = _indep_pair(rng, 400)
+    cx, cy = _indep_pair(rng, 24)
+    bx, by = _indep_pair(rng, 24)
+    cfg = BrainConfig(algorithm=ALGO_BIVARIATE)
+    # threshold above sampling noise: chi^2(2) puts ~13.5% of clean points
+    # outside the 2-sigma ellipse, so 24 draws almost surely breach it
+    cfg = dataclasses.replace(
+        cfg, anomaly=dataclasses.replace(cfg.anomaly, threshold=6.0, rules=())
+    )
+    verdicts = MultivariateJudge(cfg).judge(
+        [_task("j1", "a", hx, cx, base_v=bx), _task("j1", "b", hy, cy, base_v=by)]
+    )
+    assert all(not v.dist_differs for v in verdicts)
+    assert all(v.verdict == scoring.HEALTHY for v in verdicts)
+
+
+def test_lstm_canary_reports_pairwise_evidence_per_alias():
+    """3-metric LSTM job: per-alias p/differs ride the verdicts, and a
+    shifted baseline lowers the joint recon threshold."""
+    rng = np.random.default_rng(12)
+    f = 3
+    hist = rng.normal(0.5, 0.05, size=(f, 240)).astype(np.float32)
+    cur = rng.normal(0.5, 0.05, size=(f, 24)).astype(np.float32)
+    # baseline far from current on metric 0 only
+    base = rng.normal(0.5, 0.05, size=(f, 24)).astype(np.float32)
+    base[0] += 5.0
+
+    cfg = BrainConfig(algorithm=ALGO_LSTM)
+    judge = MultivariateJudge(cfg)
+    judge.lstm_steps = 20
+    tasks = [
+        _task("jl", f"m{i}", hist[i], cur[i], base_v=base[i]) for i in range(f)
+    ]
+    verdicts = judge.judge(tasks)
+    assert len(verdicts) == f
+    by_alias = {v.alias: v for v in verdicts}
+    assert by_alias["m0"].dist_differs and by_alias["m0"].p_value < 0.05
+    assert not by_alias["m1"].dist_differs
+    assert not by_alias["m2"].dist_differs
 
 
 def test_worker_uses_multivariate_judge_by_default():
