@@ -409,28 +409,40 @@ class MultivariateJudge:
         threshold = self.config.anomaly.rule_for(None).threshold
         min_pts = self.config.min_historical_points
         out: list[MetricVerdict] = []
+        # one batched pairwise call for ALL jobs (gated-out ones included)
+        # — same shape discipline as the bivariate path
+        all_joints = [self._joint(job_tasks) for job_tasks in jobs]
+        all_pw = self._pairwise(all_joints)
         # group by (feature count, per-JOB window bucket): fit_many needs
         # uniform [S, W, T, F], and using a group-wide max tc would let one
         # long-current job starve a short-history job into all-masked
         # training windows (mu=sd=0 -> everything flags)
-        groups: dict[tuple[int, int], list[_JointJob]] = {}
-        for job_tasks in jobs:
-            j = self._joint(job_tasks)
+        groups: dict[tuple[int, int], list[tuple[_JointJob, tuple]]] = {}
+        for j, p in zip(all_joints, all_pw):
             f = j.hist_v.shape[0]
             tc = bucket_length(max(len(j.cur_t), 1))
             # the history must fill at least one training window of this
             # job's own bucket, and clear the configured minimum
             if len(j.cur_t) == 0 or len(j.hist_t) < max(min_pts, tc):
-                out.extend(self._unknown(job_tasks, self._pairwise([j])[0]))
+                out.extend(self._unknown(j.tasks, p))
             else:
-                groups.setdefault((f, tc), []).append(j)
+                groups.setdefault((f, tc), []).append((j, p))
 
-        for (f, tc), joints in groups.items():
-            out.extend(self._judge_lstm_group(joints, f, tc, threshold))
+        for (f, tc), pairs in groups.items():
+            out.extend(
+                self._judge_lstm_group(
+                    [j for j, _ in pairs], [p for _, p in pairs], f, tc, threshold
+                )
+            )
         return out
 
     def _judge_lstm_group(
-        self, joints: list[_JointJob], f: int, tc: int, threshold: float
+        self,
+        joints: list[_JointJob],
+        pw: list[tuple[np.ndarray, np.ndarray]],
+        f: int,
+        tc: int,
+        threshold: float,
     ) -> list[MetricVerdict]:
         cfg = LSTMAEConfig(features=f)
         # entry per joint job, kept locally — the bounded ModelCache may
@@ -497,7 +509,6 @@ class MultivariateJudge:
         mq = jnp.asarray(np.stack(cur_masks))
         # canary check: a differing alias lowers the job's joint recon-error
         # threshold (design.md:33), same rule as the bivariate path
-        pw = self._pairwise(joints)
         eff_thr = self._effective_thresholds(pw, threshold)
         flags, _err = score_many(stacked, xq, mq, mu, sd, jnp.asarray(eff_thr))
         flags = np.asarray(flags)[:, 0, :]  # [S, tc]

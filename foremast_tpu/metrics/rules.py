@@ -154,8 +154,36 @@ def _per_pod_rules(metrics: Iterable[str]) -> list[RecordingRule]:
     ]
 
 
+#: Gauge suffixes the brain publishes per metric
+#: (`foremast-brain.yaml:109-122`).
+BRAIN_GAUGE_SUFFIXES = ("upper", "lower", "anomaly")
+
+
+def brain_rules() -> list[RecordingRule]:
+    """Restore the reference's `foremastbrain:` colon spelling.
+
+    The scoring worker exposes `foremastbrain_<metric>_{upper,lower,
+    anomaly}` on :8000/metrics — prometheus_client forbids ':' in
+    exposition names (it is reserved for recording rules). The reference
+    contract, which its dashboards and alert rules are written against, is
+    the colon form `foremastbrain:<metric>_{upper,lower,anomaly}`
+    (`deploy/foremast/3_brain/foremast-brain.yaml:109-122`,
+    `foremast-browser/src/config/metrics.js:15-23`). One recording rule per
+    (metric, bound) republishes each exported series under the exact
+    reference name, for every metric in the standard vocabulary
+    (ALL_METRICS — the names DeploymentMetadata monitoring lists use)."""
+    return [
+        RecordingRule(
+            f"foremastbrain:{m}_{suffix}",
+            f"foremastbrain_{m}_{suffix}",
+        )
+        for m in ALL_METRICS
+        for suffix in BRAIN_GAUGE_SUFFIXES
+    ]
+
+
 def all_rules() -> list[RecordingRule]:
-    return core_rules() + request_rules()
+    return core_rules() + request_rules() + brain_rules()
 
 
 @functools.lru_cache(maxsize=1)
@@ -189,6 +217,10 @@ def prometheus_rule_manifest(
                 {
                     "name": "request.metrics.aggregation.rules",
                     "rules": [r.to_dict() for r in request_rules()],
+                },
+                {
+                    "name": "foremastbrain.gauge.spelling.rules",
+                    "rules": [r.to_dict() for r in brain_rules()],
                 },
             ]
         },

@@ -10,9 +10,13 @@ Prometheus series scraped from :8000/metrics
 
 Note: prometheus_client forbids ':' in metric names (it is the PromQL
 recording-rule separator); the reference's names come from recording-style
-gauge registration. We export `foremastbrain_<metric>_upper` and rely on
-relabeling (or the provided recording rules in deploy/) for the exact
-`foremastbrain:` spelling — documented divergence.
+gauge registration. We export `foremastbrain_<metric>_upper` and restore
+the exact `foremastbrain:` spelling via generated recording rules —
+`metrics.rules.brain_rules()`, rendered into
+`deploy/foremast/2_watch/metrics-rules.yaml` — one
+`foremastbrain:<metric>_<suffix> = foremastbrain_<metric>_<suffix>` rule
+per metric in the standard vocabulary (`metrics.rules.ALL_METRICS`), so
+reference-compatible dashboards and alert rules see data unchanged.
 """
 
 from __future__ import annotations

@@ -10,7 +10,9 @@ import yaml
 
 from foremast_tpu.metrics.rules import (
     ALL_METRICS,
+    BRAIN_GAUGE_SUFFIXES,
     all_rules,
+    brain_rules,
     core_rules,
     prometheus_rule_manifest,
     request_rules,
@@ -60,7 +62,9 @@ def test_resource_rules_join_app_label():
 def test_no_duplicate_records():
     records = [r.record for r in all_rules()]
     assert len(records) == len(set(records))
-    assert len(core_rules()) + len(request_rules()) == len(records)
+    assert len(core_rules()) + len(request_rules()) + len(brain_rules()) == len(
+        records
+    )
 
 
 def test_manifest_yaml_roundtrip():
@@ -72,7 +76,33 @@ def test_manifest_yaml_roundtrip():
     assert groups == {
         "core.metrics.aggregation.rules",
         "request.metrics.aggregation.rules",
+        "foremastbrain.gauge.spelling.rules",
     }
+
+
+def test_brain_rules_pin_colon_spelling_for_every_published_metric():
+    """The signature observability contract (`foremast-brain.yaml:109-122`,
+    `metrics.js:15-23`): every metric the engine can publish gauges for
+    must have a recording rule mapping the exported underscore name to the
+    reference's exact colon name, for all three suffixes."""
+    by_record = {r.record: r.expr for r in brain_rules()}
+    for metric in ALL_METRICS:
+        for suffix in BRAIN_GAUGE_SUFFIXES:
+            colon = f"foremastbrain:{metric}_{suffix}"
+            assert by_record[colon] == f"foremastbrain_{metric}_{suffix}"
+    assert set(BRAIN_GAUGE_SUFFIXES) == {"upper", "lower", "anomaly"}
+    # the exported (underscore) names are exactly what BrainGauges creates
+    from prometheus_client import CollectorRegistry
+
+    from foremast_tpu.observe.gauges import BrainGauges
+
+    reg = CollectorRegistry()
+    g = BrainGauges(registry=reg)
+    for metric in ALL_METRICS:
+        g.publish(metric, "ns", "app", upper=1.0, lower=0.0, anomaly_value=2.0)
+    exported = {m.name for m in reg.collect()}
+    for r in brain_rules():
+        assert r.expr in exported
 
 
 def test_unknown_record_resolves_none():
