@@ -98,10 +98,16 @@ def config2_four_metric_joint(small: bool):
 
 
 def config3_holt_winters(small: bool):
-    """Fitted Holt-Winters bounds (grid-search fit per series)."""
+    """Fitted Holt-Winters bounds (grid-search fit per series).
+
+    Fit time tracks the sequential scan chain (T/m season steps) almost
+    independently of batch width, so the fleet batch size is the lever:
+    B=8192 windows amortize one scan the way a worker tick batching
+    thousands of claimed jobs does (B=1024 measured ~60-84k w/s; B=8192
+    ~275k on the same chip)."""
     from foremast_tpu.engine import scoring
 
-    b = 128 if small else 1024
+    b = 128 if small else 8192
     th = 512 if small else 2016  # 7 d at 5-min resample: the scan length
     batch = _score_batch(b, th, 30)
     dt = _bench(lambda x: scoring.score(x, algorithm="holt_winters"), batch)
@@ -112,6 +118,51 @@ def config3_holt_winters(small: bool):
         wps,
         "windows/s",
         scan_length=th,
+        batch=b,
+    )
+
+    # re-check tick (SURVEY hard part (d)): warm fit cache -> no history
+    # packing/upload/scan, only the judgment tail on the current window.
+    # Measured through the SHIPPED path (HealthJudge.judge over MetricTasks,
+    # host packing + decode included), not a device-resident shortcut.
+    from foremast_tpu.config import BrainConfig
+    from foremast_tpu.engine.judge import HealthJudge, MetricTask
+    from foremast_tpu.models.cache import ModelCache
+
+    rng = np.random.default_rng(0)
+    hist_v = np.asarray(rng.normal(1.0, 0.2, (b, th)), np.float32)
+    cur_v = np.asarray(rng.normal(1.0, 0.2, (b, 30)), np.float32)
+    ht = 1_700_000_000 + 60 * np.arange(th, dtype=np.int64)
+    ct = ht[-1] + 60 + 60 * np.arange(30, dtype=np.int64)
+    tasks = [
+        MetricTask(
+            job_id=f"j{i}", alias="m", metric_type=None,
+            hist_times=ht, hist_values=hist_v[i],
+            cur_times=ct, cur_values=cur_v[i],
+            fit_key=f"app{i}|m|u{i}",
+        )
+        for i in range(b)
+    ]
+    judge = HealthJudge(BrainConfig(algorithm="holt_winters"))
+    judge.judge(tasks[:8])  # compile
+    t0 = time.perf_counter()
+    judge.judge(tasks)  # cold shipped tick: pack + upload + fit + decode
+    cold_dt = time.perf_counter() - t0
+    judge.fit_cache = ModelCache(b + 1)
+    judge.judge(tasks)  # fill the cache
+    t0 = time.perf_counter()
+    iters = 2
+    for _ in range(iters):
+        judge.judge(tasks)
+    dt = (time.perf_counter() - t0) / iters
+    _emit(
+        "3-holt-winters-recheck",
+        "windows_per_sec",
+        b / dt,
+        "windows/s",
+        batch=b,
+        cold_shipped_windows_per_sec=round(b / cold_dt, 1),
+        engine_only_windows_per_sec=round(wps, 1),
     )
 
 

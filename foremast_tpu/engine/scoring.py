@@ -352,6 +352,75 @@ def _score_pallas(
     )
 
 
+@partial(jax.jit, static_argnames=("algorithm",))
+def fit_forecast(
+    values: jax.Array, mask: jax.Array, algorithm: str = "moving_average_all"
+) -> Forecast:
+    """Fit the historical model alone (no judgment) — the program behind
+    the univariate fit cache: a re-check tick whose history is unchanged
+    skips this and replays the cached terminal state through
+    `score_from_state`."""
+    fit = AI_MODEL.get(algorithm)
+    if fit is None:
+        import foremast_tpu.models  # noqa: F401
+
+        fit = AI_MODEL[algorithm]
+    return fit(values, mask)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "pairwise_algorithm",
+        "p_threshold",
+        "min_mw",
+        "min_wilcoxon",
+        "min_kruskal",
+    ),
+)
+def score_from_state(
+    batch: ScoreBatch,
+    level: jax.Array,
+    trend: jax.Array,
+    season: jax.Array,
+    season_phase: jax.Array,
+    scale: jax.Array,
+    n_hist: jax.Array,
+    pairwise_algorithm: str = PAIRWISE_ALL,
+    p_threshold: float = 0.05,
+    min_mw: int = 20,
+    min_wilcoxon: int = 20,
+    min_kruskal: int = 5,
+) -> ScoreResult:
+    """Judgment from fitted forecaster terminal state (no history scan).
+
+    Identical semantics to `_score_xla`: the in-sample `pred` is never
+    consumed by the judgment — only `horizon` extrapolation from terminal
+    (level, trend, season, phase), the residual `scale`, and the history
+    point count feed `_judgment_tail` — so a cached fit reproduces the
+    fresh-fit verdict bit for bit."""
+    fc = Forecast(
+        pred=jnp.zeros((level.shape[0], 0), level.dtype),
+        scale=scale,
+        level=level,
+        trend=trend,
+        season=season,
+        season_phase=season_phase,
+    )
+    pred = horizon(fc, batch.current.length)
+    return _judgment_tail(
+        batch,
+        pred,
+        scale,
+        n_hist,
+        pairwise_algorithm,
+        p_threshold,
+        min_mw,
+        min_wilcoxon,
+        min_kruskal,
+    )
+
+
 def _is_multi_device(batch: ScoreBatch) -> bool:
     """True when the batch is placed across >1 device (GSPMD path)."""
     sharding = getattr(batch.current.values, "sharding", None)

@@ -132,6 +132,16 @@ class BrainWorker:
         from foremast_tpu.models.cache import ModelCache
 
         self._hist_cache = ModelCache(HIST_CACHE_ENTRIES)
+        # Fitted-forecast cache (the reference's MAX_CACHE_SIZE model
+        # cache, `foremast-brain/README.md:30`): terminal forecaster state
+        # per (algorithm, app|alias|historical-URL), so a re-check tick on
+        # an unchanged history skips the 7-day scan and re-runs only the
+        # judgment tail. Attached to the univariate judge (the LSTM path
+        # has its own ModelCache in MultivariateJudge).
+        self._fit_cache = ModelCache(self.config.max_cache_size)
+        uni = getattr(self.judge, "univariate", self.judge)
+        if isinstance(uni, HealthJudge):
+            uni.fit_cache = self._fit_cache
         self.metrics = metrics
 
     # -- preprocess: document -> MetricTasks ----------------------------
@@ -147,8 +157,14 @@ class BrainWorker:
         try:
             for alias, cur_url in cur.items():
                 ct, cv = self.source.fetch(cur_url)
+                fit_key = None
                 if alias in hist:
-                    ht, hv = self._fetch_hist_cached(hist[alias], now)
+                    url = hist[alias]
+                    (ht, hv), settled = self._fetch_hist_cached(url, now)
+                    if settled:
+                        # immutable history => the fitted model is
+                        # immutable too; key it per (app, alias, URL)
+                        fit_key = f"{doc.app_name}|{alias}|{url}"
                 else:
                     ht, hv = ct[:0], cv[:0]
                 kw = {}
@@ -165,6 +181,7 @@ class BrainWorker:
                         cur_times=ct,
                         cur_values=cv,
                         app=doc.app_name,
+                        fit_key=fit_key,
                         **kw,
                     )
                 )
@@ -175,23 +192,25 @@ class BrainWorker:
 
     def _fetch_hist_cached(self, url: str, now: float):
         """Fetch a historical window, memoized by URL when the range is
-        provably immutable.
+        provably immutable. Returns ((times, values), settled).
 
         The watcher builds historical ranges ending at deploy start, but
         REST clients may supply arbitrary params — a range whose end
         lies in the future (or too close to `now` for datastore ingestion
         to have settled) would freeze a truncated series for the job's
-        lifetime. Such URLs are fetched fresh every tick. `now` is the
+        lifetime. Such URLs are fetched fresh every tick, and their fits
+        are never cached either (`settled` gates both). `now` is the
         tick's injectable clock so admission is deterministic in tests.
         """
         cached = self._hist_cache.get(url)
         if cached is not None:
-            return cached
+            return cached, True
         series = self.source.fetch(url)
         end = _hist_end_epoch(url)
-        if end is not None and end <= now - HIST_SETTLED_SECONDS:
+        settled = end is not None and end <= now - HIST_SETTLED_SECONDS
+        if settled:
             self._hist_cache.put(url, series)
-        return series
+        return series, settled
 
     # -- postprocess: verdicts -> document status -----------------------
 

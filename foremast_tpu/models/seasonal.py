@@ -68,12 +68,18 @@ def fit_seasonal(
     pred = jnp.einsum("tk,bk->bt", x, w)
     scale = masked_std((values - pred) * m, mask)
 
-    # Materialize one full future seasonal cycle so Forecast.horizon() can
-    # extrapolate: phase p corresponds to absolute step t_len + p.
-    future = jnp.arange(period) + t_len
-    xf = _design(future, period, order, dtype)  # [P, K]
-    # split trend (first two cols) from seasonality (harmonics)
-    level = w[:, 0] + w[:, 1] * (t_len - 1)  # value of trend line at last step
+    # Materialize one full seasonal cycle over ABSOLUTE phases (season[:, j]
+    # = seasonal value at any step ≡ j mod P) so `horizon` can start at
+    # each series' own continuation point: the forecast resumes right after
+    # the last VALID step (n_valid), not after the bucket-padded array end
+    # — a [288]-valid history in a [512] bucket must not shift the cycle.
+    xf = _design(jnp.arange(period), period, order, dtype)  # [P, K]
+    # last valid absolute index per series (consistent with the absolute
+    # positions the regression itself uses, including interior gaps)
+    last_valid = jnp.max(
+        jnp.where(mask, jnp.arange(t_len)[None, :], -1), axis=-1
+    )
+    level = w[:, 0] + w[:, 1] * last_valid.astype(dtype)  # trend at last step
     trend = w[:, 1]
     seas_f = jnp.einsum("pk,bk->bp", xf[:, 2:], w[:, 2:])  # [B, P]
     return Forecast(
@@ -82,5 +88,5 @@ def fit_seasonal(
         level=level,
         trend=trend,
         season=seas_f,
-        season_phase=jnp.zeros((b,), jnp.int32),
+        season_phase=((last_valid + 1) % period).astype(jnp.int32),
     )

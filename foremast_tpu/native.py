@@ -167,7 +167,12 @@ def pack_windows(
 def anomaly_pairs(
     flags: np.ndarray, times: np.ndarray, values: np.ndarray
 ) -> list[float] | None:
-    """Native flat [t1, v1, ...] pair encoding; None when unavailable."""
+    """Native flat [t1, v1, ...] pair encoding; None when unavailable.
+
+    Not on the engine's hot path anymore: the judge decodes a whole batch
+    with one `np.nonzero` pass (judge.py), which beats a per-row ctypes
+    call (~30 us fixed overhead each) at fleet batch sizes. Kept for
+    single-series callers on very long windows."""
     lib = load()
     if lib is None:
         return None

@@ -216,3 +216,141 @@ def test_bucketing_bounds_compiles_for_ragged_tasks():
     verdicts = HealthJudge().judge(tasks)
     assert len(verdicts) == 60
     assert {v.job_id for v in verdicts} == {t.job_id for t in tasks}
+
+
+# -- univariate fit cache ----------------------------------------------------
+
+
+def _hw_task(job, rng, spike=False, fit_key=None):
+    import dataclasses
+
+    t = np.arange(24 * 12, dtype=np.float32)
+    hist = (5 + 2 * np.sin(2 * np.pi * t / 24) + rng.normal(0, 0.1, len(t))).astype(
+        np.float32
+    )
+    cur = (5 + 2 * np.sin(2 * np.pi * (len(t) + np.arange(10)) / 24)).astype(
+        np.float32
+    )
+    if spike:
+        cur = cur.copy()
+        cur[4] = 40.0
+    task = _task(job, "latency", hist, cur)
+    return dataclasses.replace(task, fit_key=fit_key)
+
+
+def test_fit_cache_reuses_fit_and_matches_fresh_results():
+    """Two judgments with the same fit_key: the second must not re-fit,
+    and cached verdicts must equal fresh-fit verdicts exactly."""
+    from foremast_tpu.engine import scoring
+    from foremast_tpu.models.cache import ModelCache
+
+    rng = np.random.default_rng(0)
+    cfg = BrainConfig(algorithm="holt_winters")
+    plain = HealthJudge(cfg)
+    cached = HealthJudge(cfg)
+    cached.fit_cache = ModelCache(8)
+
+    tasks = [
+        _hw_task("j1", rng, fit_key="app|latency|u1"),
+        _hw_task("j2", rng, spike=True, fit_key="app2|latency|u2"),
+    ]
+    ref = plain.judge(tasks)
+    got1 = cached.judge(tasks)
+    assert len(cached.fit_cache) == 2
+
+    # second tick: same histories, new job ids -> no fitting at all
+    import dataclasses
+
+    tasks2 = [dataclasses.replace(t, job_id=t.job_id + "b") for t in tasks]
+    orig = scoring.fit_forecast
+
+    def boom(*a, **k):  # pragma: no cover - failure path
+        raise AssertionError("fit ran despite warm cache")
+
+    scoring.fit_forecast = boom
+    try:
+        got2 = cached.judge(tasks2)
+    finally:
+        scoring.fit_forecast = orig
+
+    for a, b in zip(ref, got1):
+        assert a.verdict == b.verdict
+        assert a.anomaly_pairs == b.anomaly_pairs
+        np.testing.assert_allclose(a.upper, b.upper, rtol=1e-6)
+        assert a.p_value == pytest.approx(b.p_value)
+    for a, b in zip(got1, got2):
+        assert a.verdict == b.verdict
+        assert a.anomaly_pairs == b.anomaly_pairs
+
+
+def test_fit_cache_mixed_keyed_and_unkeyed_batch():
+    """Tasks without fit_key ride the same batch (fitted fresh each time)
+    and never pollute the cache."""
+    from foremast_tpu.models.cache import ModelCache
+
+    rng = np.random.default_rng(1)
+    cfg = BrainConfig(algorithm="holt_winters")
+    judge = HealthJudge(cfg)
+    judge.fit_cache = ModelCache(8)
+    tasks = [
+        _hw_task("k", rng, fit_key="app|latency|u1"),
+        _hw_task("n", rng, spike=True),  # no key
+    ]
+    ref = HealthJudge(cfg).judge(tasks)
+    got = judge.judge(tasks)
+    assert len(judge.fit_cache) == 1
+    for a, b in zip(ref, got):
+        assert a.verdict == b.verdict
+        assert a.anomaly_pairs == b.anomaly_pairs
+
+
+def test_fit_cache_not_used_for_cheap_fits():
+    """moving_average_all is cheaper than the cache round trip: the cache
+    stays empty even when keys are present."""
+    from foremast_tpu.models.cache import ModelCache
+
+    rng = np.random.default_rng(2)
+    judge = HealthJudge(BrainConfig())  # default moving_average_all
+    judge.fit_cache = ModelCache(8)
+    judge.judge([_hw_task("j", rng, fit_key="app|latency|u1")])
+    assert len(judge.fit_cache) == 0
+
+
+def test_worker_sets_fit_key_only_for_settled_histories():
+    """The worker keys fits by (app, alias, URL) only when the historical
+    range's end is safely in the past (same admission as the history
+    cache) — mutable ranges must be re-fit every tick."""
+    from foremast_tpu.jobs.store import InMemoryStore
+    from foremast_tpu.jobs.worker import BrainWorker
+    from foremast_tpu.metrics.source import ReplaySource
+    from foremast_tpu.jobs.models import Document
+
+    now = 1_700_000_000.0
+    src = ReplaySource()
+    t = np.arange(64, dtype=np.int64) * 60 + int(now) - 864000
+    v = np.ones(64, np.float32)
+    src.register("q", (t, v))
+    w = BrainWorker(InMemoryStore(), src, BrainConfig(algorithm="holt_winters"))
+    doc = Document(
+        id="d1", app_name="demo", status="initial",
+        current_config="m== http://p/q?query=x&start=1&end=2&step=60",
+        historical_config=(
+            f"m== http://p/q?query=x&start=1&end={int(now)-86400}&step=60"
+        ),
+    )
+    tasks = w._fetch_tasks(doc, now)
+    assert tasks[0].fit_key == (
+        f"demo|m|http://p/q?query=x&start=1&end={int(now)-86400}&step=60"
+    )
+    # future-ending history: no fit key
+    doc2 = Document(
+        id="d2", app_name="demo", status="initial",
+        current_config="m== http://p/q?query=x&start=1&end=2&step=60",
+        historical_config=(
+            f"m== http://p/q?query=x&start=1&end={int(now)+600}&step=60"
+        ),
+    )
+    tasks2 = w._fetch_tasks(doc2, now)
+    assert tasks2[0].fit_key is None
+    # the worker attaches its fit cache to the univariate judge
+    assert w.judge.univariate.fit_cache is w._fit_cache

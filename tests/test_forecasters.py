@@ -132,6 +132,44 @@ def test_fit_holt_winters_beats_default_on_noisy_seasonal():
     np.testing.assert_allclose(np.asarray(fit.pred)[0], np.asarray(fit.pred)[1])
 
 
+def test_holt_winters_per_series_params_match_scalar_runs():
+    """alpha/beta/gamma may be [B] arrays (one smoothing set per series);
+    each row must equal the scalar-parameter run of that row alone."""
+    m_len = 12
+    rng = np.random.default_rng(42)
+    lens = [m_len * 10, m_len * 7 + 5, m_len - 3]
+    rows = []
+    for i, n in enumerate(lens):
+        t = np.arange(n, dtype=np.float32)
+        rows.append(
+            (3.0 + i + 2 * np.sin(2 * np.pi * t / m_len)
+             + 0.01 * t + rng.normal(0, 0.1, n)).astype(np.float32)
+        )
+    v, m = _mk(rows, n=max(lens))
+    params = [(0.3, 0.05, 0.1), (0.7, 0.1, 0.1), (0.1, 0.01, 0.05)]
+    batched = holt_winters(
+        v, m, m_len,
+        jnp.asarray([p[0] for p in params], jnp.float32),
+        jnp.asarray([p[1] for p in params], jnp.float32),
+        jnp.asarray([p[2] for p in params], jnp.float32),
+    )
+    for i, (a, b_, g) in enumerate(params):
+        solo = holt_winters(v[i : i + 1], m[i : i + 1], m_len, a, b_, g)
+        np.testing.assert_allclose(
+            np.asarray(batched.pred)[i] * np.asarray(m)[i],
+            np.asarray(solo.pred)[0] * np.asarray(m)[i],
+            rtol=2e-4, atol=2e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(batched.level)[i], np.asarray(solo.level)[0],
+            rtol=2e-4, atol=2e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(batched.season)[i], np.asarray(solo.season)[0],
+            rtol=2e-4, atol=2e-4,
+        )
+
+
 def test_moving_average_rolling_window():
     x = np.arange(20, dtype=np.float32)
     v, m = _mk([x], n=20)
@@ -187,3 +225,36 @@ def test_min_lower_bound_floors_lower():
     pred = jnp.broadcast_to(fc.level[:, None], hv.shape)
     _, lower = compute_bounds(pred, fc.scale, threshold=5.0, min_lower_bound=0.0)
     assert float(jnp.min(lower)) >= 0.0
+
+
+def test_holt_winters_horizon_phase_ignores_bucket_padding():
+    """A 288-valid-point series packed into a 512 bucket must forecast the
+    SAME seasonal continuation as the exact-length series: the horizon
+    phase comes from the valid count, not the padded array length
+    (regression: 512 % 24 = 8 used to shift the cycle)."""
+    m_len = 24
+    t = np.arange(288, dtype=np.float32)
+    x = (5 + 2 * np.sin(2 * np.pi * t / m_len)).astype(np.float32)
+    exact = holt_winters(*_mk([x], n=288), season_length=m_len)
+    padded = holt_winters(*_mk([x], n=512), season_length=m_len)
+    h_exact = np.asarray(horizon(exact, m_len))[0]
+    h_padded = np.asarray(horizon(padded, m_len))[0]
+    np.testing.assert_allclose(h_padded, h_exact, rtol=1e-5, atol=1e-5)
+    # continuation actually follows the sine
+    expected = 5 + 2 * np.sin(2 * np.pi * (288 + np.arange(m_len)) / m_len)
+    np.testing.assert_allclose(h_padded, expected, atol=0.3)
+
+
+def test_seasonal_horizon_phase_ignores_bucket_padding():
+    from foremast_tpu.models.seasonal import fit_seasonal
+
+    period = 24
+    t = np.arange(288, dtype=np.float32)
+    x = (5 + 2 * np.sin(2 * np.pi * t / period)).astype(np.float32)
+    exact = fit_seasonal(*_mk([x], n=288), period=period, order=2)
+    padded = fit_seasonal(*_mk([x], n=512), period=period, order=2)
+    np.testing.assert_allclose(
+        np.asarray(horizon(padded, period))[0],
+        np.asarray(horizon(exact, period))[0],
+        rtol=1e-3, atol=1e-3,
+    )
