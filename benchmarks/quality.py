@@ -37,6 +37,7 @@ ALGORITHMS = (
     "double_exponential_smoothing",
     "holt_winters",
     "seasonal_p24",
+    "auto_univariate",
 )
 
 # One cycle of the synthetic season, in time steps. This deliberately
@@ -123,6 +124,140 @@ def score_algorithm(batch, truth: np.ndarray, algorithm: str):
     return f1, precision, recall
 
 
+# -- joint (multivariate) scenarios -----------------------------------------
+
+
+def _joint_tasks(metrics: np.ndarray, cur: np.ndarray, job_prefix: str):
+    """[B, F, Th] hist + [B, F, Tc] cur -> flat MetricTask list."""
+    from foremast_tpu.engine.judge import MetricTask
+
+    b, f, th = metrics.shape
+    tc = cur.shape[-1]
+    t0 = 1_700_000_000
+    ht = t0 + 60 * np.arange(th, dtype=np.int64)
+    ct = t0 + 60 * (th + np.arange(tc, dtype=np.int64))
+    tasks = []
+    for i in range(b):
+        for j in range(f):
+            tasks.append(
+                MetricTask(
+                    job_id=f"{job_prefix}{i}",
+                    alias=f"m{j}",
+                    metric_type=None,
+                    hist_times=ht,
+                    hist_values=metrics[i, j],
+                    cur_times=ct,
+                    cur_values=cur[i, j],
+                    app=f"{job_prefix}{i}",
+                )
+            )
+    return tasks, ct
+
+
+def draw_comoving(rng, b: int, f: int, n: int, t_start: int, period: int = PERIOD):
+    """[B, F, n] co-moving seasonal metrics: shared latent (sine + noise)
+    plus per-metric offset and idiosyncratic noise. Shared between the
+    joint benchmark scenarios and the residual-MVN unit tests so both
+    always validate the same data family."""
+    t = (t_start + np.arange(n))[None, :]
+    latent = 0.3 * np.sin(2 * np.pi * t / period) + rng.normal(0, 0.05, (b, n))
+    return np.stack(
+        [1.0 + 0.5 * j + latent + rng.normal(0, 0.05, (b, n)) for j in range(f)],
+        axis=1,
+    ).astype(np.float32)
+
+
+def gen_correlated_pair(b: int, th: int, tc: int, seed: int = 0):
+    """2 tightly-correlated metrics; anomalies are OFF-RIDGE points whose
+    marginals stay in range — only a joint model can see them."""
+    rng = np.random.default_rng(seed)
+    rho = 0.95
+
+    def draw(n):
+        x = rng.normal(0, 1, (b, n))
+        y = rho * x + np.sqrt(1 - rho * rho) * rng.normal(0, 1, (b, n))
+        return 1.0 + 0.2 * x, 2.0 + 0.3 * y
+
+    hx, hy = draw(th)
+    cx, cy = draw(tc)
+    truth = np.zeros((b, tc), bool)
+    for i in range(b):
+        idx = rng.choice(tc, size=2, replace=False)
+        # break the ridge: push x up, y down by ~2.5 marginal sigmas
+        cx[i, idx] += 2.5 * 0.2
+        cy[i, idx] -= 2.5 * 0.3
+        truth[i, idx] = True
+    hist = np.stack([hx, hy], axis=1).astype(np.float32)
+    cur = np.stack([cx, cy], axis=1).astype(np.float32)
+    return hist, cur, truth
+
+
+def gen_joint_lstm(b: int, f: int, th: int, tc: int, seed: int = 0, kind="all"):
+    """f co-moving seasonal metrics (shared latent + idiosyncratic noise).
+
+    kind="all":   simultaneous all-metric spikes (+0.6, ~8 idio-sigmas) at
+                  random positions INCLUDING seasonal troughs — there the
+                  spiked value lands near the marginal mean, so only a
+                  phase-aware (contextual) model can see it.
+    kind="break": ONE metric deviates +/-0.6 while the others follow the
+                  shared latent — a correlation break that is invisible
+                  marginally and to per-metric models.
+    """
+    rng = np.random.default_rng(seed)
+    hist = draw_comoving(rng, b, f, th, 0)
+    cur = draw_comoving(rng, b, f, tc, th)
+    truth = np.zeros((b, tc), bool)
+    for i in range(b):
+        idx = rng.choice(tc, size=2, replace=False)
+        if kind == "all":
+            cur[i, :, idx] += 0.6
+        else:
+            for pos in idx:
+                j = rng.integers(0, f)
+                cur[i, j, pos] += rng.choice([-1.0, 1.0]) * 0.6
+        truth[i, idx] = True
+    return hist.astype(np.float32), cur.astype(np.float32), truth
+
+
+def score_joint(kind: str, b: int, th: int, tc: int):
+    """F1 for the joint detectors through MultivariateJudge (the shipped
+    dispatch), point-level over aligned current timestamps."""
+    import dataclasses
+
+    from foremast_tpu.config import BrainConfig
+    from foremast_tpu.engine.multivariate import MultivariateJudge
+
+    if kind == "bivariate":
+        hist, cur, truth = gen_correlated_pair(b, th, tc)
+        algo = "bivariate_normal"
+    else:
+        hist, cur, truth = gen_joint_lstm(
+            b, 4, th, tc, kind="break" if kind == "lstm-break" else "all"
+        )
+        algo = "lstm_autoencoder"
+    tasks, ct = _joint_tasks(hist, cur, kind)
+    cfg = BrainConfig(algorithm=algo)
+    cfg = dataclasses.replace(
+        cfg, anomaly=dataclasses.replace(cfg.anomaly, threshold=4.0, rules=())
+    )
+    judge = MultivariateJudge(cfg)
+    verdicts = judge.judge(tasks)
+    flagged: dict[str, set] = {}
+    for v in verdicts:
+        flagged.setdefault(v.job_id, set()).update(v.anomaly_pairs[0::2])
+    tp = fp = fn = 0
+    for i in range(b):
+        got = flagged.get(f"{kind}{i}", set())
+        want = {float(t) for t, is_a in zip(ct, truth[i]) if is_a}
+        tp += len(got & want)
+        fp += len(got - want)
+        fn += len(want - got)
+    return prf1(tp, fp, fn)
+
+
+JOINT_SCENARIOS = ("bivariate", "lstm", "lstm-break")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--small", action="store_true")
@@ -150,6 +285,24 @@ def main(argv=None):
                 ),
                 flush=True,
             )
+    for kind in JOINT_SCENARIOS:
+        jb = 16 if args.small else 64  # LSTM trains one model per job
+        p, r, f1 = score_joint(kind, jb, th, tc)
+        print(
+            json.dumps(
+                {
+                    "scenario": f"joint-{kind}",
+                    "algorithm": (
+                        "bivariate_normal" if kind == "bivariate"
+                        else "lstm_autoencoder"
+                    ),
+                    "f1": round(f1, 3),
+                    "precision": round(p, 3),
+                    "recall": round(r, 3),
+                }
+            ),
+            flush=True,
+        )
     return 0
 
 

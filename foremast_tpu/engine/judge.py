@@ -88,6 +88,7 @@ EXPENSIVE_FITS = frozenset(
         "double_exponential_smoothing",
         "holtwinters",
         "holt_winters",
+        "auto_univariate",
         "seasonal",
         "prophet",
         "seasonal_hourly",

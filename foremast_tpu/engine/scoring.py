@@ -43,6 +43,7 @@ from foremast_tpu.ops.forecasters import (
     Forecast,
     double_exponential,
     ewma,
+    fit_auto_univariate,
     fit_holt_winters,
     horizon,
     moving_average,
@@ -70,6 +71,9 @@ AI_MODEL = {
     "double_exponential_smoothing": double_exponential,
     "holtwinters": fit_holt_winters,
     "holt_winters": fit_holt_winters,
+    # structure-screened per-series selection (MA vs fitted Holt-Winters):
+    # the recommended default where metric shapes are unknown
+    "auto_univariate": fit_auto_univariate,
 }
 
 

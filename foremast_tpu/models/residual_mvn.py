@@ -1,0 +1,139 @@
+"""Seasonal-residual multivariate Gaussian — the joint contextual detector.
+
+Companion to the learned LSTM detector for 3+ metric jobs (reference model
+zoo: "3+ metrics: Deep Learning (LSTM)", `docs/guides/design.md:84`). Pure
+reconstruction scoring has a structural blind spot: an autoencoder that
+*sees* an in-window anomaly can reproduce ("copy") it, and a plain
+marginal check misses contextual anomalies (a spike at a seasonal trough
+lands near the marginal mean). This detector closes both gaps with two
+closed-form, TPU-native pieces:
+
+  1. per-metric causal Holt-Winters residuals — `hw_continue` predictions
+     never see the point they score, so an anomaly cannot be copied, and
+     the seasonal state removes the cycle, so trough-masked spikes stand
+     out;
+  2. a full-covariance Gaussian over the F-dimensional residual vector —
+     co-movement between metrics is learned from historical residuals, so
+     a single metric deviating from the pack (correlation break) scores a
+     large Mahalanobis distance even when its marginal z-score is modest.
+
+Threshold calibration: the reference's thresholds are "number of sigmas"
+(`foremast-brain.yaml:26-27`). A fixed d^2 > thr^2 rule would get tighter
+with F (chi^2_F mass grows with F), so the cutoff is the chi^2_F quantile
+whose tail mass equals the two-sided normal tail P(|z| > thr) — the same
+false-positive rate as the univariate detectors at the same configured
+threshold, at any metric count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from foremast_tpu.ops.forecasters import Forecast, holt_winters, hw_continue
+
+# Holt-Winters smoothing used for residual extraction (fixed, not
+# grid-fit: residual covariance absorbs model error, and fixed params keep
+# the fit cacheable per job without a per-metric grid search).
+HW_PARAMS = (0.3, 0.05, 0.1)
+SEASON_LENGTH = 24
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class MVNState:
+    """Fitted residual model for a batch of F-metric jobs.
+
+    hw:    Forecast with [B*F]-flattened leaves (terminal HW state per
+           (job, metric) series; season [B*F, m])
+    mu:    [B, F]    historical residual means
+    cov:   [B, F, F] historical residual covariance (ridge-regularized)
+    valid: [B]       enough history + well-conditioned covariance
+    """
+
+    hw: Forecast
+    mu: jax.Array
+    cov: jax.Array
+    valid: jax.Array
+
+
+@functools.lru_cache(maxsize=256)
+def chi2_quantile(threshold: float, dof: int) -> float:
+    """chi^2_dof cutoff with the same tail mass as P(|z| > threshold).
+
+    Host-side (scipy), called once per judgment batch with static dof."""
+    from scipy import stats
+
+    p_tail = 2.0 * stats.norm.sf(threshold)
+    p_tail = min(max(p_tail, 1e-300), 1.0)
+    return float(stats.chi2.ppf(1.0 - p_tail, dof))
+
+
+def fit_residual_mvn(
+    hist: jax.Array,
+    mask: jax.Array | None = None,
+    season_length: int = SEASON_LENGTH,
+    min_points: int = 10,
+    ridge: float = 1e-6,
+) -> MVNState:
+    """Fit per-metric HW + residual covariance.
+
+    hist: [B, F, Th] aligned joint histories (joint observations are
+    intersected upstream, `multivariate._align`, so every metric of a job
+    shares one validity pattern); mask: [B, Th] valid-prefix mask for
+    bucket-padded batches (None = all valid)."""
+    b, f, th = hist.shape
+    a, bt, g = HW_PARAMS
+    if mask is None:
+        mask = jnp.ones((b, th), bool)
+    flat = hist.reshape(b * f, th)
+    mflat = jnp.repeat(mask, f, axis=0)
+    fc = holt_winters(flat, mflat, season_length, a, bt, g)
+    resid = (flat - fc.pred).reshape(b, f, th)
+    # drop the first season: those predictions come from init state
+    warm_mask = mask & (jnp.arange(th)[None, :] >= season_length)  # [B, Th]
+    n = jnp.maximum(jnp.sum(warm_mask, axis=-1), 1)  # [B]
+    w = warm_mask[:, None, :].astype(resid.dtype)  # [B, 1, Th]
+    mu = jnp.sum(resid * w, axis=-1) / n[:, None]  # [B, F]
+    rc = (resid - mu[:, :, None]) * w
+    cov = jnp.einsum("bft,bgt->bfg", rc, rc) / n[:, None, None]
+    # scale-aware ridge keeps tiny-magnitude metrics invertible without
+    # distorting their geometry
+    tr = jnp.trace(cov, axis1=-2, axis2=-1) / f  # [B]
+    eye = jnp.eye(f, dtype=cov.dtype)
+    cov = cov + (ridge * tr + 1e-12)[:, None, None] * eye
+    # conditioning: det of the ridged cov must be positive and finite
+    sign, logdet = jnp.linalg.slogdet(cov)
+    valid = (n >= min_points) & (sign > 0) & jnp.isfinite(logdet)
+    return MVNState(hw=fc, mu=mu, cov=cov, valid=valid)
+
+
+def score_residual_mvn(
+    state: MVNState,
+    cur: jax.Array,
+    d2_cutoff: jax.Array | float,
+    season_length: int = SEASON_LENGTH,
+) -> jax.Array:
+    """Anomaly flags [B, Tc] for aligned joint current windows [B, F, Tc].
+
+    Causal HW residual per metric -> Mahalanobis d^2 against the
+    historical residual Gaussian -> flag where d^2 exceeds the calibrated
+    cutoff (see `chi2_quantile`). Invalid fits flag nothing."""
+    b, f, tc = cur.shape
+    a, bt, g = HW_PARAMS
+    flat = cur.reshape(b * f, tc)
+    pred, _ = hw_continue(
+        state.hw, flat, jnp.ones(flat.shape, bool), season_length, a, bt, g
+    )
+    resid = (flat - pred).reshape(b, f, tc)
+    d = resid - state.mu[:, :, None]  # [B, F, Tc]
+    # solve per job: cov [B,F,F] x X = d  -> d^T cov^-1 d per time step
+    sol = jnp.linalg.solve(state.cov, d)  # [B, F, Tc]
+    d2 = jnp.sum(d * sol, axis=1)  # [B, Tc]
+    cutoff = jnp.asarray(d2_cutoff, d2.dtype)
+    if cutoff.ndim == 1:
+        cutoff = cutoff[:, None]
+    return (d2 > cutoff) & state.valid[:, None]

@@ -12,6 +12,7 @@ from foremast_tpu.ops.forecasters import (
     ewma,
     double_exponential,
     holt_winters,
+    fit_auto_univariate,
     fit_holt_winters,
 )
 from foremast_tpu.ops.ranks import (
@@ -39,6 +40,7 @@ __all__ = [
     "ewma",
     "double_exponential",
     "holt_winters",
+    "fit_auto_univariate",
     "fit_holt_winters",
     "masked_ranks",
     "mann_whitney_u",

@@ -327,6 +327,117 @@ def holt_winters(
     )
 
 
+# auto_univariate: a series must beat the global-mean model's in-sample
+# SSE by at least this factor for the structured (Holt-Winters) fit to be
+# selected — in-sample SSE alone always favors the flexible model, so the
+# margin screens for REAL seasonality/trend instead of soaked-up noise.
+AUTO_SSE_RATIO = 0.5
+
+
+@partial(jax.jit, static_argnames=("season_length",))
+def fit_auto_univariate(
+    values: jax.Array, mask: jax.Array, season_length: int = 24
+) -> Forecast:
+    """Structure-screened model selection, per series.
+
+    The deployed default `moving_average_all` is blind to seasonality and
+    trend (its band must widen to cover the cycle), while a fitted
+    Holt-Winters on a genuinely flat series merely soaks up noise. This
+    fit runs both and picks per series: the structured model wins only
+    where it explains at least half the global-mean model's in-sample
+    variance (AUTO_SSE_RATIO) — flat series keep the mean model, seasonal
+    and trending series route to the fitted Holt-Winters. One jitted
+    program; the screen is two masked SSE reductions on fits already
+    computed."""
+    ma = moving_average_all(values, mask)
+    hw = fit_holt_winters(values, mask, season_length)
+    m = mask.astype(values.dtype)
+
+    def sse(fc):
+        r = (values - fc.pred) * m
+        return jnp.sum(r * r, axis=-1)  # [B]
+
+    use_hw = sse(hw) < AUTO_SSE_RATIO * sse(ma)  # [B]
+
+    def pick(hw_leaf, ma_leaf):
+        sel = use_hw.reshape((-1,) + (1,) * (hw_leaf.ndim - 1))
+        return jnp.where(sel, hw_leaf, ma_leaf)
+
+    # ma's seasonal buffer is [B, 1] zeros; expand to hw's [B, m] so the
+    # two Forecasts share one structure
+    ma = Forecast(
+        pred=ma.pred,
+        scale=ma.scale,
+        level=ma.level,
+        trend=ma.trend,
+        season=jnp.zeros_like(hw.season),
+        season_phase=hw.season_phase,
+    )
+    return jax.tree_util.tree_map(pick, hw, ma)
+
+
+def hw_continue(
+    fc: Forecast,
+    values: jax.Array,
+    mask: jax.Array,
+    season_length: int = 24,
+    alpha: float = 0.3,
+    beta: float = 0.05,
+    gamma: float = 0.1,
+) -> tuple[jax.Array, Forecast]:
+    """Continue a fitted Holt-Winters recurrence over new points, causally.
+
+    pred[:, t] is the one-step-ahead forecast made from state updated
+    through values[:, :t] — the prediction never sees the point it scores,
+    so residuals are contamination-free anomaly evidence (unlike
+    autoencoder reconstruction, which can copy an in-window anomaly).
+    Starts from `fc`'s terminal (level, trend, season, phase); masked
+    steps carry state through but still advance the phase (gaps keep
+    their place in the cycle). Returns (pred [B, T], updated Forecast).
+
+    T here is a current window (tens of points), so a plain per-step scan
+    is cheap; the heavy 7-day fit stays in `fit_holt_winters`/`holt_winters`.
+    """
+    m_len = int(season_length)
+    b, t_len = values.shape
+    dtype = values.dtype
+    alpha = jnp.asarray(alpha, dtype)
+    beta = jnp.asarray(beta, dtype)
+    gamma = jnp.asarray(gamma, dtype)
+    season = fc.season
+    if season.shape[-1] != m_len:  # non-seasonal fit: zero offsets
+        season = jnp.zeros((b, m_len), dtype)
+
+    def step(carry, xs):
+        level, trend, season, phase = carry
+        x, m = xs
+        onehot = jax.nn.one_hot(phase, m_len, dtype=dtype)  # [B, m]
+        s_t = jnp.sum(season * onehot, axis=-1)  # [B]
+        pred = level + trend + s_t
+        new_level = alpha * (x - s_t) + (1.0 - alpha) * (level + trend)
+        new_trend = beta * (new_level - level) + (1.0 - beta) * trend
+        new_s = gamma * (x - new_level) + (1.0 - gamma) * s_t
+        upd = m.astype(dtype)
+        season_out = season + (upd * (new_s - s_t))[:, None] * onehot
+        level_out = jnp.where(m, new_level, level)
+        trend_out = jnp.where(m, new_trend, trend)
+        return (level_out, trend_out, season_out, (phase + 1) % m_len), pred
+
+    init = (fc.level, fc.trend, season, fc.season_phase)
+    (level, trend, season, phase), preds = jax.lax.scan(
+        step, init, (values.T, mask.T)
+    )
+    out = Forecast(
+        pred=preds.T,
+        scale=fc.scale,
+        level=level,
+        trend=trend,
+        season=season,
+        season_phase=phase,
+    )
+    return preds.T, out
+
+
 _HW_GRID = (
     (0.1, 0.01, 0.05),
     (0.1, 0.05, 0.1),

@@ -22,7 +22,14 @@ def test_suite_config1_runs_small(capsys):
 
 def test_quality_benchmark_structured_beats_flat_on_seasonal(capsys):
     """Smoke the quality harness: fitted HW must dominate the global-mean
-    default on the seasonal scenario."""
+    default on the seasonal scenario, and the joint detectors must hold
+    F1 >= 0.9 on their scenarios (VERDICT r1 item 5):
+
+      * joint-bivariate   — off-ridge points, marginally in-range
+      * joint-lstm        — all-metric spikes incl. seasonal troughs
+                            (contextual: near the marginal mean there)
+      * joint-lstm-break  — one metric deviating from the co-moving pack
+    """
     import benchmarks.quality as quality
 
     quality.main(["--small"])
@@ -33,3 +40,11 @@ def test_quality_benchmark_structured_beats_flat_on_seasonal(capsys):
     assert by[("seasonal", "holt_winters")] > 0.9
     assert by[("seasonal", "moving_average_all")] < 0.5
     assert by[("flat", "moving_average_all")] > 0.9
+    assert by[("joint-bivariate", "bivariate_normal")] >= 0.9
+    assert by[("joint-lstm", "lstm_autoencoder")] >= 0.9
+    assert by[("joint-lstm-break", "lstm_autoencoder")] >= 0.9
+    # auto_univariate (VERDICT r1 item 6): structure screen routes
+    # seasonal/trend series to the fitted model without regressing flat
+    assert by[("seasonal", "auto_univariate")] >= 0.95
+    assert by[("trend", "auto_univariate")] >= 0.95
+    assert by[("flat", "auto_univariate")] >= 0.95

@@ -176,3 +176,97 @@ def test_model_cache_checkpoint_roundtrip(tmp_path):
     n = c2.load(str(tmp_path / "ckpt"))
     assert n == 1
     np.testing.assert_allclose(c2.get("svc1/latency")["w"], [0.0, 1.0, 2.0])
+
+
+# -- seasonal-residual multivariate Gaussian ---------------------------------
+
+
+def _comoving(rng, b, f, th, tc, period=24):
+    from benchmarks.quality import draw_comoving
+
+    return (
+        draw_comoving(rng, b, f, th, 0, period),
+        draw_comoving(rng, b, f, tc, th, period),
+    )
+
+
+def test_residual_mvn_catches_trough_masked_spike():
+    """An all-metric spike at a seasonal trough lands near the MARGINAL
+    mean — only the causal seasonal residual makes it visible."""
+    from foremast_tpu.models.residual_mvn import (
+        chi2_quantile,
+        fit_residual_mvn,
+        score_residual_mvn,
+    )
+
+    rng = np.random.default_rng(0)
+    b, f, th, tc = 8, 4, 240, 30
+    hist, cur = _comoving(rng, b, f, th, tc)
+    # spike at phase 18 of the 24-cycle (trough: sin = -1 region)
+    pos = (18 - (th + 0) % 24) % 24
+    cur[:, :, pos] += 0.6
+    state = fit_residual_mvn(jnp.asarray(hist))
+    cut = chi2_quantile(4.0, f)
+    flags = np.asarray(score_residual_mvn(state, jnp.asarray(cur), cut))
+    assert flags[:, pos].all(), "trough spike must flag on every job"
+    fp = flags.sum() - flags[:, pos].sum()
+    assert fp <= 2, f"too many false positives: {fp}"
+
+
+def test_residual_mvn_catches_correlation_break():
+    """One metric leaving the co-moving pack is invisible marginally but
+    huge in Mahalanobis distance."""
+    from foremast_tpu.models.residual_mvn import (
+        chi2_quantile,
+        fit_residual_mvn,
+        score_residual_mvn,
+    )
+
+    rng = np.random.default_rng(1)
+    b, f, th, tc = 8, 4, 240, 30
+    hist, cur = _comoving(rng, b, f, th, tc)
+    cur[:, 2, 11] -= 0.6  # metric 2 departs downward at t=11
+    state = fit_residual_mvn(jnp.asarray(hist))
+    cut = chi2_quantile(4.0, f)
+    flags = np.asarray(score_residual_mvn(state, jnp.asarray(cur), cut))
+    assert flags[:, 11].all()
+
+
+def test_residual_mvn_short_history_invalid_flags_nothing():
+    from foremast_tpu.models.residual_mvn import (
+        fit_residual_mvn,
+        score_residual_mvn,
+    )
+
+    rng = np.random.default_rng(2)
+    hist, cur = _comoving(rng, 2, 3, 26, 10)  # only 2 warm points
+    state = fit_residual_mvn(jnp.asarray(hist))
+    assert not np.asarray(state.valid).any()
+    cur[:, :, 4] += 100.0
+    flags = np.asarray(score_residual_mvn(state, jnp.asarray(cur), 10.0))
+    assert not flags.any()
+
+
+def test_residual_mvn_prefix_mask_matches_exact_length():
+    """Bucket-padded histories must fit the same model as exact-length
+    ones (the judge packs joint histories into power-of-two buckets)."""
+    from foremast_tpu.models.residual_mvn import fit_residual_mvn
+
+    rng = np.random.default_rng(3)
+    b, f, th, tc = 4, 3, 200, 10
+    hist, _ = _comoving(rng, b, f, th, tc)
+    exact = fit_residual_mvn(jnp.asarray(hist))
+    padded_h = np.zeros((b, f, 256), np.float32)
+    padded_h[:, :, :th] = hist
+    mask = np.zeros((b, 256), bool)
+    mask[:, :th] = True
+    padded = fit_residual_mvn(jnp.asarray(padded_h), jnp.asarray(mask))
+    np.testing.assert_allclose(
+        np.asarray(exact.mu), np.asarray(padded.mu), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(exact.cov), np.asarray(padded.cov), rtol=1e-3, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(exact.hw.level), np.asarray(padded.hw.level), rtol=1e-4
+    )

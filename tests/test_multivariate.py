@@ -343,3 +343,45 @@ def test_worker_uses_multivariate_judge_by_default():
 
     w = BrainWorker(InMemoryStore(), ReplaySource(), BrainConfig())
     assert isinstance(w.judge, MultivariateJudge)
+
+
+def test_lstm_mvn_refits_for_new_deployment_history():
+    """The cached residual-MVN state is time-anchored: a later deployment
+    of the same app (new history, phase-shifted vs the cached fit) must
+    refit instead of replaying a stale seasonal phase — otherwise every
+    clean point flags at anti-phase."""
+    from benchmarks.quality import draw_comoving
+
+    rng = np.random.default_rng(21)
+    f, th, tc = 3, 240, 24
+    cfg = BrainConfig(algorithm=ALGO_LSTM)
+    cfg = dataclasses.replace(
+        cfg, anomaly=dataclasses.replace(cfg.anomaly, threshold=4.0, rules=())
+    )
+    judge = MultivariateJudge(cfg)
+    judge.lstm_steps = 20
+
+    def tasks(job, t0_steps, seed):
+        r = np.random.default_rng(seed)
+        hist = draw_comoving(r, 1, f, th, t0_steps)[0]
+        cur = draw_comoving(r, 1, f, tc, t0_steps + th)[0]
+        t0 = 1_700_000_000 + 60 * t0_steps
+        ht = t0 + 60 * np.arange(th, dtype=np.int64)
+        ct = t0 + 60 * (th + np.arange(tc, dtype=np.int64))
+        return [
+            MetricTask(
+                job_id=job, alias=f"m{i}", metric_type=None,
+                hist_times=ht, hist_values=hist[i],
+                cur_times=ct, cur_values=cur[i], app="svc",
+            )
+            for i in range(f)
+        ]
+
+    first = judge.judge(tasks("d1", 0, seed=5))
+    assert all(v.verdict == scoring.HEALTHY for v in first)
+    # redeploy 12 steps later: anti-phase vs the cached fit's anchor
+    second = judge.judge(tasks("d2", 12, seed=6))
+    assert all(v.verdict == scoring.HEALTHY for v in second), (
+        "stale time-anchored MVN state replayed against a phase-shifted "
+        "deployment"
+    )
