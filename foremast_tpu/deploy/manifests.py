@@ -280,15 +280,16 @@ def _container(name: str, args: list[str], env: list[dict], ports: list[dict],
 
 
 def _deployment(name: str, container: dict, sa: str | None = None,
-                replicas: int = 1) -> dict:
+                replicas: int = 1, namespace: str | None = None,
+                scrape: bool = True) -> dict:
+    meta: dict = {"labels": {"app": name}}
+    if scrape:
+        meta["annotations"] = {"prometheus.io/scrape": "true"}
     spec: dict = {
         "replicas": replicas,
         "selector": {"matchLabels": {"app": name}},
         "template": {
-            "metadata": {
-                "labels": {"app": name},
-                "annotations": {"prometheus.io/scrape": "true"},
-            },
+            "metadata": meta,
             "spec": {"containers": [container]},
         },
     }
@@ -299,7 +300,7 @@ def _deployment(name: str, container: dict, sa: str | None = None,
         "kind": "Deployment",
         "metadata": {
             "name": name,
-            "namespace": NAMESPACE,
+            "namespace": namespace or NAMESPACE,
             "labels": {"app": name},
         },
         "spec": spec,
@@ -632,6 +633,298 @@ def scrape_config_secret() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Standalone monitoring stack (role of the reference's
+# deploy/prometheus-operator/ kube-prometheus bundle)
+# ---------------------------------------------------------------------------
+#
+# The reference vendors the full kube-prometheus manifests; this tree
+# instead GENERATES a minimal self-contained stack — Prometheus with the
+# pod-annotation scrape job and the recording rules as native rule files,
+# kube-state-metrics (the rules' kube_pod_labels join needs it), and
+# Grafana pre-provisioned with the Prometheus datasource — so
+# docs/quickstart.md works on an empty cluster with nothing but
+# `kubectl apply`. Operator users can skip this dir and use
+# `additional-scrape-configs.yaml` + the PrometheusRule CR instead.
+
+MONITORING_NAMESPACE = "monitoring"
+
+
+def monitoring_namespace() -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {"name": MONITORING_NAMESPACE},
+    }
+
+
+def _scrape_job_yaml() -> str:
+    """The pod-annotation scrape job (shared with the operator secret)."""
+    return scrape_config_secret()["stringData"]["prometheus-additional.yaml"]
+
+
+def prometheus_rbac() -> list[dict]:
+    return [
+        {
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": {"name": "prometheus", "namespace": MONITORING_NAMESPACE},
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": {"name": "foremast-prometheus"},
+            "rules": [
+                {
+                    "apiGroups": [""],
+                    "resources": [
+                        "nodes",
+                        "nodes/metrics",
+                        "services",
+                        "endpoints",
+                        "pods",
+                    ],
+                    "verbs": ["get", "list", "watch"],
+                },
+                {"nonResourceURLs": ["/metrics"], "verbs": ["get"]},
+            ],
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": "foremast-prometheus"},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": "foremast-prometheus",
+            },
+            "subjects": [
+                {
+                    "kind": "ServiceAccount",
+                    "name": "prometheus",
+                    "namespace": MONITORING_NAMESPACE,
+                }
+            ],
+        },
+    ]
+
+
+def prometheus_config() -> dict:
+    """prometheus.yml + the recording rules as a native rule file (no
+    operator needed; same groups as the PrometheusRule CR)."""
+    import yaml as _yaml
+
+    rules_spec = prometheus_rule_manifest()["spec"]
+    prometheus_yml = (
+        "global:\n"
+        "  scrape_interval: 30s\n"
+        "  evaluation_interval: 30s\n"
+        "rule_files:\n"
+        "  - /etc/prometheus/rules.yml\n"
+        "scrape_configs:\n"
+        "  - job_name: kube-state-metrics\n"
+        "    static_configs:\n"
+        "      - targets: ['kube-state-metrics.monitoring.svc:8080']\n"
+        + "\n".join("  " + ln for ln in _scrape_job_yaml().splitlines())
+        + "\n"
+    )
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {
+            "name": "prometheus-config",
+            "namespace": MONITORING_NAMESPACE,
+        },
+        "data": {
+            "prometheus.yml": prometheus_yml,
+            "rules.yml": _yaml.safe_dump(rules_spec, sort_keys=False),
+        },
+    }
+
+
+def prometheus_deployment() -> list[dict]:
+    dep = _deployment(
+        "prometheus-k8s",
+        {
+            "name": "prometheus",
+            "image": "prom/prometheus:v2.53.0",
+            "args": [
+                "--config.file=/etc/prometheus/prometheus.yml",
+                "--storage.tsdb.path=/prometheus",
+                "--storage.tsdb.retention.time=7d",
+            ],
+            "ports": [{"containerPort": 9090}],
+            "volumeMounts": [
+                {"name": "config", "mountPath": "/etc/prometheus"},
+                {"name": "data", "mountPath": "/prometheus"},
+            ],
+            "resources": {
+                "requests": {"cpu": "250m", "memory": "512Mi"},
+                "limits": {"memory": "2Gi"},
+            },
+        },
+        sa="prometheus",
+        namespace=MONITORING_NAMESPACE,
+        scrape=False,
+    )
+    dep["spec"]["template"]["spec"]["volumes"] = [
+        {"name": "config", "configMap": {"name": "prometheus-config"}},
+        {"name": "data", "emptyDir": {}},
+    ]
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": "prometheus-k8s",  # the endpoint every foremast
+            "namespace": MONITORING_NAMESPACE,  # component points at
+        },
+        "spec": {
+            "selector": {"app": "prometheus-k8s"},
+            "ports": [{"port": 9090, "targetPort": 9090}],
+        },
+    }
+    return [dep, svc]
+
+
+def kube_state_metrics() -> list[dict]:
+    sa = {
+        "apiVersion": "v1",
+        "kind": "ServiceAccount",
+        "metadata": {
+            "name": "kube-state-metrics",
+            "namespace": MONITORING_NAMESPACE,
+        },
+    }
+    role = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": "foremast-kube-state-metrics"},
+        "rules": [
+            {
+                "apiGroups": [""],
+                "resources": [
+                    "pods",
+                    "nodes",
+                    "namespaces",
+                    "services",
+                    "endpoints",
+                ],
+                "verbs": ["list", "watch"],
+            },
+            {
+                "apiGroups": ["apps"],
+                "resources": ["deployments", "replicasets", "statefulsets"],
+                "verbs": ["list", "watch"],
+            },
+        ],
+    }
+    binding = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": {"name": "foremast-kube-state-metrics"},
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "ClusterRole",
+            "name": "foremast-kube-state-metrics",
+        },
+        "subjects": [
+            {
+                "kind": "ServiceAccount",
+                "name": "kube-state-metrics",
+                "namespace": MONITORING_NAMESPACE,
+            }
+        ],
+    }
+    dep = _deployment(
+        "kube-state-metrics",
+        {
+            "name": "kube-state-metrics",
+            "image": "registry.k8s.io/kube-state-metrics/kube-state-metrics:v2.12.0",
+            "args": ["--metric-labels-allowlist=pods=[app]"],
+            "ports": [{"containerPort": 8080}],
+            "resources": {
+                "requests": {"cpu": "50m", "memory": "64Mi"},
+                "limits": {"memory": "256Mi"},
+            },
+        },
+        sa="kube-state-metrics",
+        namespace=MONITORING_NAMESPACE,
+        scrape=False,
+    )
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": "kube-state-metrics",
+            "namespace": MONITORING_NAMESPACE,
+        },
+        "spec": {
+            "selector": {"app": "kube-state-metrics"},
+            "ports": [{"port": 8080, "targetPort": 8080}],
+        },
+    }
+    return [sa, role, binding, dep, svc]
+
+
+def grafana() -> list[dict]:
+    datasource = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {
+            "name": "grafana-datasources",
+            "namespace": MONITORING_NAMESPACE,
+        },
+        "data": {
+            "datasources.yaml": (
+                "apiVersion: 1\n"
+                "datasources:\n"
+                "  - name: Prometheus\n"
+                "    type: prometheus\n"
+                "    access: proxy\n"
+                "    url: http://prometheus-k8s.monitoring.svc:9090\n"
+                "    isDefault: true\n"
+            )
+        },
+    }
+    dep = _deployment(
+        "grafana",
+        {
+            "name": "grafana",
+            "image": "grafana/grafana:11.1.0",
+            "ports": [{"containerPort": 3000}],
+            "env": [
+                {"name": "GF_AUTH_ANONYMOUS_ENABLED", "value": "true"},
+                {"name": "GF_AUTH_ANONYMOUS_ORG_ROLE", "value": "Admin"},
+            ],
+            "volumeMounts": [
+                {
+                    "name": "datasources",
+                    "mountPath": "/etc/grafana/provisioning/datasources",
+                }
+            ],
+            "resources": {
+                "requests": {"cpu": "50m", "memory": "128Mi"},
+                "limits": {"memory": "512Mi"},
+            },
+        },
+        namespace=MONITORING_NAMESPACE,
+        scrape=False,
+    )
+    dep["spec"]["template"]["spec"]["volumes"] = [
+        {"name": "datasources", "configMap": {"name": "grafana-datasources"}}
+    ]
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": "grafana", "namespace": MONITORING_NAMESPACE},
+        "spec": {
+            "selector": {"app": "grafana"},
+            "ports": [{"port": 3000, "targetPort": 3000}],
+        },
+    }
+    return [datasource, dep, svc]
+
+
+# ---------------------------------------------------------------------------
 # Demo workload (reference examples/demo/{rollingUpdate,continuous})
 # ---------------------------------------------------------------------------
 
@@ -746,18 +1039,27 @@ README = """\
 Generated tree - do not edit by hand; run `python -m foremast_tpu.deploy deploy/`
 after changing `foremast_tpu/deploy/manifests.py`.
 
-Install order (numbered dirs, like the reference's deploy/foremast):
+Install order (numbered dirs, like the reference's deploy/foremast), from
+an EMPTY cluster — no out-of-repo prerequisites:
 
+    kubectl apply -f prometheus/00namespace.yaml
+    kubectl apply -f prometheus/1_rbac/
+    kubectl apply -f prometheus/2_stack/
     kubectl apply -f foremast/00namespace.yaml
     kubectl apply -f foremast/1_crds/
     kubectl apply -f foremast/2_watch/
     kubectl apply -f foremast/3_engine/
 
-Prerequisites: a Prometheus (e.g. prometheus-operator / kube-prometheus) in
-namespace `monitoring`; add `prometheus/additional-scrape-configs.yaml` as an
-additionalScrapeConfigs secret so pod-annotation scraping works, and apply
-`foremast/2_watch/metrics-rules.yaml` (the generated recording rules) to the
-Prometheus rule selector.
+`prometheus/` is a minimal self-contained monitoring stack (role of the
+reference's `deploy/prometheus-operator/` kube-prometheus bundle):
+Prometheus with the pod-annotation scrape job and the generated recording
+rules mounted as native rule files, kube-state-metrics (the rules'
+`kube_pod_labels` join), and Grafana pre-provisioned with the Prometheus
+datasource on :3000. If you already run prometheus-operator instead, skip
+`prometheus/{00namespace.yaml,1_rbac,2_stack}` and use
+`prometheus/additional-scrape-configs.yaml` as an additionalScrapeConfigs
+secret plus `foremast/2_watch/metrics-rules.yaml` (the same rules as a
+PrometheusRule CR).
 
 The engine Deployment requests a TPU host (GKE v5e 2x4 node selector); edit
 `engine_deployment()` for other topologies, or drop the TPU request to score
@@ -789,6 +1091,12 @@ def tree(cfg: BrainConfig | None = None) -> dict[str, object]:
         "export/export-prometheus.sh": EXPORT_PROMETHEUS_SH,
         "export/export-ui.sh": EXPORT_UI_SH,
         "prometheus/additional-scrape-configs.yaml": [scrape_config_secret()],
+        "prometheus/00namespace.yaml": [monitoring_namespace()],
+        "prometheus/1_rbac/prometheus-rbac.yaml": prometheus_rbac(),
+        "prometheus/2_stack/prometheus-config.yaml": [prometheus_config()],
+        "prometheus/2_stack/prometheus.yaml": prometheus_deployment(),
+        "prometheus/2_stack/kube-state-metrics.yaml": kube_state_metrics(),
+        "prometheus/2_stack/grafana.yaml": grafana(),
         "foremast/00namespace.yaml": [namespace()],
         "foremast/1_crds/deploymentmetadata.yaml": [deployment_metadata_crd()],
         "foremast/1_crds/deploymentmonitor.yaml": [deployment_monitor_crd()],

@@ -630,9 +630,12 @@ class MultivariateJudge:
                 else 60.0
             )
             k = int(round((float(j.cur_t[0]) - mvns[i][7]) / max(step, 1.0)))
-            adv = min(max(k - 1, 0), 10 * m_len)  # clamp runaway extrapolation
-            phases[i] = (phases[i] + adv) % m_len
-            levels[i] = levels[i] + trends[i] * adv
+            gap = max(k - 1, 0)
+            # phase advances by the TRUE gap (mod m — clamping here would
+            # corrupt the phase, e.g. 10*m ≡ 0); only the trend
+            # extrapolation is bounded against runaway level drift
+            phases[i] = (phases[i] + gap) % m_len
+            levels[i] = levels[i] + trends[i] * min(gap, 10 * m_len)
         hw = Forecast(
             pred=jnp.zeros((s_count * f, 0), jnp.float32),
             scale=jnp.zeros((s_count * f,), jnp.float32),

@@ -38,11 +38,19 @@ TERMINAL_STATUSES = frozenset(
     }
 )
 
+# in-progress statuses: claimable only via stuck-job takeover
+# (modified_at older than MAX_STUCK_IN_SECONDS, design.md:39)
+INPROGRESS_STATUSES = (
+    STATUS_PREPROCESS_INPROGRESS,
+    STATUS_POSTPROCESS_INPROGRESS,
+)
+
+# one source of truth with the store's server-side claimability query:
+# fresh work + the in-progress family (the latter claimable only when stuck)
 CLAIMABLE_STATUSES = (
     STATUS_INITIAL,
-    STATUS_PREPROCESS_INPROGRESS,
     STATUS_PREPROCESS_COMPLETED,
-    STATUS_POSTPROCESS_INPROGRESS,
+    *INPROGRESS_STATUSES,
 )
 
 # External view (converter.go:11-30): internal -> {new, inprogress,

@@ -28,6 +28,7 @@ from typing import Iterable
 
 from foremast_tpu.jobs.models import (
     CLAIMABLE_STATUSES,
+    INPROGRESS_STATUSES,
     STATUS_INITIAL,
     STATUS_PREPROCESS_COMPLETED,
     STATUS_PREPROCESS_INPROGRESS,
@@ -207,11 +208,52 @@ class ElasticsearchStore(JobStore):
         return Document.from_json(body["_source"])
 
     def claim(self, worker_id: str, max_stuck_seconds: float, limit: int = 64):
+        """Claim up to `limit` docs in exactly TWO round trips.
+
+        (1) a server-side claimability search — fresh work (`initial` /
+        `preprocess_completed`) OR stuck in-progress docs (`modified_at`
+        older than the stuck cutoff), sorted oldest-first so a crowd of
+        recently-touched in-progress docs can never fill the page and
+        starve fresh jobs; (2) one `_bulk` request carrying a
+        seq_no/primary_term CAS per doc — items another worker won come
+        back 409 and are skipped. (The previous shape — match any
+        claimable status, then one CAS PUT per hit — was O(limit) round
+        trips and page-starvation-prone.)
+        """
+        now = time.time()
+        cutoff = datetime.fromtimestamp(
+            now - max_stuck_seconds, timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%SZ")
         query = {
             "size": limit,
-            "seq_no_primary_term": True,  # required for the CAS params below
+            "seq_no_primary_term": True,  # required for the CAS below
+            "sort": [{"modifiedAt": {"order": "asc", "unmapped_type": "date"}}],
             "query": {
-                "terms": {"status": list(CLAIMABLE_STATUSES)}
+                "bool": {
+                    "should": [
+                        {
+                            "terms": {
+                                "status": [
+                                    STATUS_INITIAL,
+                                    STATUS_PREPROCESS_COMPLETED,
+                                ]
+                            }
+                        },
+                        {
+                            "bool": {
+                                "must": [
+                                    {
+                                        "terms": {
+                                            "status": list(INPROGRESS_STATUSES)
+                                        }
+                                    },
+                                    {"range": {"modifiedAt": {"lt": cutoff}}},
+                                ]
+                            }
+                        },
+                    ],
+                    "minimum_should_match": 1,
+                }
             },
         }
         r = self._s.post(
@@ -219,31 +261,50 @@ class ElasticsearchStore(JobStore):
         )
         r.raise_for_status()
         hits = r.json().get("hits", {}).get("hits", [])
-        now = time.time()
-        out = []
+
+        import json as _json
+
+        lines: list[str] = []
+        docs: list[Document] = []
         for h in hits:
             doc = Document.from_json(h["_source"])
+            # defense in depth: the server answered claimability, but a
+            # mapping/clock divergence must never double-claim
             if not _is_claimable(doc, now, max_stuck_seconds):
                 continue
             doc.status = STATUS_PREPROCESS_INPROGRESS
             doc.modified_at = now_rfc3339()
             doc.processing_content = worker_id
-            # optimistic concurrency: seq_no/primary_term CAS
-            params = ""
+            action: dict = {"index": {"_id": doc.id}}
             if "_seq_no" in h:
-                params = (
-                    f"?if_seq_no={h['_seq_no']}"
-                    f"&if_primary_term={h['_primary_term']}"
+                action["index"]["if_seq_no"] = h["_seq_no"]
+                action["index"]["if_primary_term"] = h["_primary_term"]
+            lines.append(_json.dumps(action))
+            lines.append(_json.dumps(doc.to_json()))
+            docs.append(doc)
+        if not docs:
+            return []
+        rr = self._s.post(
+            self._url("_bulk"),
+            data="\n".join(lines) + "\n",
+            headers={"Content-Type": "application/x-ndjson"},
+            timeout=self.timeout,
+        )
+        rr.raise_for_status()
+        items = rr.json().get("items", [])
+        out = []
+        for doc, item in zip(docs, items):
+            status = item.get("index", {}).get("status", 500)
+            if status in (200, 201):
+                out.append(doc)
+            elif status != 409:
+                # 409 = another worker won (expected, skip); anything else
+                # (read-only index block, 429 rejections, mapping errors)
+                # must SURFACE like the old per-doc CAS path did — a
+                # silent [] would stop the claim pipeline with no signal
+                raise RuntimeError(
+                    f"bulk claim item failed for {doc.id}: {item}"
                 )
-            rr = self._s.put(
-                self._url("_doc", doc.id) + params,
-                json=doc.to_json(),
-                timeout=self.timeout,
-            )
-            if rr.status_code == 409:
-                continue  # another worker won this doc
-            rr.raise_for_status()
-            out.append(doc)
         return out
 
     def update(self, doc: Document) -> Document:

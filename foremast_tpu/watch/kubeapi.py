@@ -362,7 +362,7 @@ class HttpKube:
         try:
             with urllib.request.urlopen(req, context=self._ctx, timeout=30) as resp:
                 return json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as e:  # pragma: no cover - live cluster only
+        except urllib.error.HTTPError as e:
             if e.code == 404:
                 raise NotFound(path)
             raise

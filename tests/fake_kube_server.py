@@ -1,0 +1,257 @@
+"""A minimal fake Kubernetes API server for exercising HttpKube over real
+HTTP.
+
+Speaks just enough of the K8s REST surface for the watch plane: GET/PATCH
+on apps/v1 deployments (strategic-merge semantics), GET namespaces /
+replicasets / pods, POST events, and full CRUD on the two foremast CRDs
+(merge-patch, resourceVersion bumping, 404/409/415 error paths). The
+object store is plain dicts keyed (namespace, name); the merge logic is
+implemented here independently of `watch.kubeapi` so the client's
+expectations are validated against a second implementation, not against
+itself.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+GROUP = "deployment.foremast.ai"
+VERSION = "v1alpha1"
+
+_MERGE_TYPES = {
+    "application/strategic-merge-patch+json",
+    "application/merge-patch+json",
+}
+
+
+def _merge(dst: dict, patch: dict) -> None:
+    for k, v in patch.items():
+        if v is None:
+            dst.pop(k, None)
+        elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _merge(dst[k], v)
+        else:
+            dst[k] = v
+
+
+class FakeKubeState:
+    """Shared object store; pre-populate via the typed helpers."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.rv = 0
+        # kind -> {(namespace or "", name): obj}
+        self.objects: dict[str, dict[tuple[str, str], dict]] = {
+            "namespaces": {},
+            "deployments": {},
+            "replicasets": {},
+            "pods": {},
+            "events": {},
+            "deploymentmonitors": {},
+            "deploymentmetadatas": {},
+        }
+        self.requests: list[tuple[str, str, dict]] = []  # (method, path, headers)
+
+    def next_rv(self) -> str:
+        self.rv += 1
+        return str(self.rv)
+
+    def put(self, kind: str, namespace: str, obj: dict) -> dict:
+        name = obj["metadata"]["name"]
+        obj["metadata"].setdefault("namespace", namespace)
+        obj["metadata"]["resourceVersion"] = self.next_rv()
+        self.objects[kind][(namespace, name)] = obj
+        return obj
+
+
+# URL patterns -> (kind, namespaced collection)
+_ROUTES = [
+    (re.compile(r"^/api/v1/namespaces$"), "namespaces", None),
+    (re.compile(r"^/api/v1/namespaces/(?P<name>[^/]+)$"), "namespaces", "item"),
+    (
+        re.compile(r"^/apis/apps/v1(/namespaces/(?P<ns>[^/]+))?/deployments$"),
+        "deployments",
+        None,
+    ),
+    (
+        re.compile(r"^/apis/apps/v1/namespaces/(?P<ns>[^/]+)/deployments/(?P<name>[^/]+)$"),
+        "deployments",
+        "item",
+    ),
+    (
+        re.compile(r"^/apis/apps/v1/namespaces/(?P<ns>[^/]+)/replicasets$"),
+        "replicasets",
+        None,
+    ),
+    (re.compile(r"^/api/v1/namespaces/(?P<ns>[^/]+)/pods$"), "pods", None),
+    (re.compile(r"^/api/v1/namespaces/(?P<ns>[^/]+)/events$"), "events", None),
+    (
+        re.compile(
+            rf"^/apis/{GROUP}/{VERSION}(/namespaces/(?P<ns>[^/]+))?"
+            r"/(?P<kind>deploymentmonitors|deploymentmetadatas)$"
+        ),
+        None,
+        None,
+    ),
+    (
+        re.compile(
+            rf"^/apis/{GROUP}/{VERSION}/namespaces/(?P<ns>[^/]+)"
+            r"/(?P<kind>deploymentmonitors|deploymentmetadatas)/(?P<name>[^/]+)$"
+        ),
+        None,
+        "item",
+    ),
+]
+
+
+def _handler(state: FakeKubeState):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # keep test output clean
+            pass
+
+        def _send(self, code: int, obj: dict | None = None):
+            body = json.dumps(obj or {}).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self) -> dict:
+            n = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(n) or b"{}")
+
+        def _route(self):
+            from urllib.parse import unquote, urlparse
+
+            path = unquote(urlparse(self.path).path)
+            for rx, kind, mode in _ROUTES:
+                m = rx.match(path)
+                if m:
+                    gd = m.groupdict()
+                    kind = kind or gd.get("kind")
+                    ns = gd.get("ns") or ""
+                    name = gd.get("name")
+                    return kind, ns, name, mode
+            return None, None, None, None
+
+        def _record(self):
+            state.requests.append(
+                (self.command, self.path, dict(self.headers.items()))
+            )
+
+        def do_GET(self):
+            self._record()
+            kind, ns, name, mode = self._route()
+            if kind is None:
+                return self._send(404, {"reason": "NotFound"})
+            with state.lock:
+                store = state.objects[kind]
+                if mode == "item" or (kind == "namespaces" and name):
+                    key = (ns, name) if kind != "namespaces" else ("", name)
+                    if key not in store:
+                        return self._send(404, {"reason": "NotFound"})
+                    return self._send(200, store[key])
+                items = [
+                    o
+                    for (o_ns, _), o in sorted(store.items())
+                    if not ns or o_ns == ns
+                ]
+                return self._send(200, {"items": items})
+
+        def do_POST(self):
+            self._record()
+            kind, ns, name, mode = self._route()
+            if kind is None or mode == "item":
+                return self._send(404, {"reason": "NotFound"})
+            obj = self._body()
+            with state.lock:
+                oname = obj.get("metadata", {}).get("name") or f"gen-{state.rv}"
+                obj.setdefault("metadata", {})["name"] = oname
+                key = (ns, oname)
+                if key in state.objects[kind] and kind != "events":
+                    return self._send(409, {"reason": "AlreadyExists"})
+                obj["metadata"]["namespace"] = ns
+                obj["metadata"]["resourceVersion"] = state.next_rv()
+                state.objects[kind][key] = obj
+                return self._send(201, obj)
+
+        def do_PUT(self):
+            self._record()
+            kind, ns, name, mode = self._route()
+            if kind is None or mode != "item":
+                return self._send(404, {"reason": "NotFound"})
+            obj = self._body()
+            with state.lock:
+                key = (ns, name)
+                store = state.objects[kind]
+                if key not in store:
+                    return self._send(404, {"reason": "NotFound"})
+                current = store[key]
+                # optimistic concurrency: stale resourceVersion conflicts
+                sent_rv = obj.get("metadata", {}).get("resourceVersion")
+                if sent_rv and sent_rv != current["metadata"]["resourceVersion"]:
+                    return self._send(409, {"reason": "Conflict"})
+                obj.setdefault("metadata", {})["namespace"] = ns
+                obj["metadata"]["name"] = name
+                obj["metadata"]["resourceVersion"] = state.next_rv()
+                store[key] = obj
+                return self._send(200, obj)
+
+        def do_PATCH(self):
+            self._record()
+            kind, ns, name, mode = self._route()
+            if kind is None or mode != "item":
+                return self._send(404, {"reason": "NotFound"})
+            ctype = self.headers.get("Content-Type", "")
+            if ctype not in _MERGE_TYPES:
+                return self._send(415, {"reason": "UnsupportedMediaType"})
+            patch = self._body()
+            with state.lock:
+                key = (ns, name) if kind != "namespaces" else ("", name)
+                store = state.objects[kind]
+                if key not in store:
+                    return self._send(404, {"reason": "NotFound"})
+                _merge(store[key], patch)
+                store[key]["metadata"]["resourceVersion"] = state.next_rv()
+                return self._send(200, store[key])
+
+        def do_DELETE(self):
+            self._record()
+            kind, ns, name, mode = self._route()
+            if kind is None or mode != "item":
+                return self._send(404, {"reason": "NotFound"})
+            with state.lock:
+                key = (ns, name)
+                if key not in state.objects[kind]:
+                    return self._send(404, {"reason": "NotFound"})
+                del state.objects[kind][key]
+                return self._send(200, {"status": "Success"})
+
+    return Handler
+
+
+class FakeKubeServer:
+    """Context manager: spins up the server on an ephemeral localhost port."""
+
+    def __init__(self):
+        self.state = FakeKubeState()
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), _handler(self.state))
+        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+
+    @property
+    def url(self) -> str:
+        host, port = self._srv.server_address
+        return f"http://{host}:{port}"
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._srv.shutdown()
+        self._srv.server_close()
+        return False

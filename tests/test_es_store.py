@@ -2,9 +2,11 @@
 
 The fake implements exactly the REST surface the store uses (root ping,
 _doc GET/PUT with op_type=create and if_seq_no/if_primary_term CAS,
-_search with terms / bool-must_not queries), so the production-critical
-semantics — idempotent creation, optimistic-concurrency claims, stuck-job
-takeover — are covered without a live cluster.
+_search with terms / bool should+must+range queries and modifiedAt sort,
+_bulk with per-action CAS), so the production-critical semantics —
+idempotent creation, optimistic-concurrency claims, stuck-job takeover,
+starvation-free O(1)-round-trip claiming — are covered without a live
+cluster.
 """
 
 from __future__ import annotations
@@ -40,10 +42,12 @@ class FakeES:
     def __init__(self):
         self.docs: dict[str, dict] = {}  # id -> {"_source":…, "_seq_no":int}
         self._seq = 0
+        self.requests = 0  # HTTP round trips (claim must stay O(1))
 
     # requests.Session surface -----------------------------------------
 
     def get(self, url, timeout=None, **kw):
+        self.requests += 1
         path = urllib.parse.urlparse(url).path
         if path in ("", "/"):
             return _Resp(200, {"cluster_name": "fake"})
@@ -56,6 +60,7 @@ class FakeES:
         return _Resp(404, {})
 
     def put(self, url, json=None, timeout=None, **kw):
+        self.requests += 1
         u = urllib.parse.urlparse(url)
         q = urllib.parse.parse_qs(u.query)
         m = re.fullmatch(r"/documents/_doc/([^/]+)", u.path)
@@ -71,8 +76,11 @@ class FakeES:
         self.docs[doc_id] = {"_source": json, "_seq_no": self._seq}
         return _Resp(200, {"result": "updated"})
 
-    def post(self, url, json=None, timeout=None, **kw):
+    def post(self, url, json=None, data=None, headers=None, timeout=None, **kw):
+        self.requests += 1
         path = urllib.parse.urlparse(url).path
+        if path == "/documents/_bulk":
+            return self._bulk(data, headers or {})
         assert path == "/documents/_search", path
         hits = []
         for doc_id, rec in self.docs.items():
@@ -85,16 +93,58 @@ class FakeES:
                         "_primary_term": 1,
                     }
                 )
+        for spec in json.get("sort", []):
+            ((field, opts),) = spec.items()
+            hits.sort(
+                key=lambda h: h["_source"].get(field, ""),
+                reverse=opts.get("order") == "desc",
+            )
         size = json.get("size", 10)
         return _Resp(200, {"hits": {"hits": hits[:size]}})
+
+    def _bulk(self, data: str, headers: dict) -> _Resp:
+        import json as _json
+
+        assert headers.get("Content-Type") == "application/x-ndjson"
+        lines = [ln for ln in data.split("\n") if ln.strip()]
+        items = []
+        for action_ln, doc_ln in zip(lines[0::2], lines[1::2]):
+            action = _json.loads(action_ln)["index"]
+            doc = _json.loads(doc_ln)
+            doc_id = action["_id"]
+            rec = self.docs.get(doc_id)
+            if "if_seq_no" in action and (
+                rec is None or rec["_seq_no"] != action["if_seq_no"]
+            ):
+                items.append({"index": {"_id": doc_id, "status": 409}})
+                continue
+            self._seq += 1
+            self.docs[doc_id] = {"_source": doc, "_seq_no": self._seq}
+            items.append({"index": {"_id": doc_id, "status": 200}})
+        return _Resp(200, {"items": items, "errors": False})
 
     @staticmethod
     def _matches(query: dict, source: dict) -> bool:
         if "terms" in query:
             (field, values), = query["terms"].items()
             return source.get(field) in values
-        if "bool" in query and "must_not" in query["bool"]:
-            return not FakeES._matches(query["bool"]["must_not"], source)
+        if "range" in query:
+            ((field, cond),) = query["range"].items()
+            value = source.get(field, "")
+            ok = True
+            if "lt" in cond:
+                ok = ok and value < cond["lt"]
+            if "gt" in cond:
+                ok = ok and value > cond["gt"]
+            return ok
+        if "bool" in query:
+            b = query["bool"]
+            if "must_not" in b:
+                return not FakeES._matches(b["must_not"], source)
+            if "must" in b:
+                return all(FakeES._matches(q, source) for q in b["must"])
+            if "should" in b:
+                return any(FakeES._matches(q, source) for q in b["should"])
         return True
 
 
@@ -134,22 +184,64 @@ def test_claim_flips_status_and_is_exclusive():
 
 
 def test_claim_cas_race_single_winner():
-    """Two workers fetch the same search hit; the CAS must let exactly one
-    win (the reference gets this from ES versioned writes)."""
-    fake = FakeES()
+    """Two workers race on the same search hit; the bulk-action CAS must
+    let exactly one win (the reference gets this from ES versioned
+    writes)."""
+
+    class RacingES(FakeES):
+        """Bumps j1's version between A's search and A's bulk write —
+        modelling worker B winning the CAS in that window."""
+
+        def post(self, url, json=None, data=None, headers=None, **kw):
+            resp = super().post(url, json=json, data=data, headers=headers, **kw)
+            if url.endswith("/_search") and "j1" in self.docs:
+                self._seq += 1
+                self.docs["j1"]["_seq_no"] = self._seq
+            return resp
+
+    fake = RacingES()
     a, _ = _store(fake)
     a.create(Document(id="j1", app_name="x"))
-
-    hit_seq = fake.docs["j1"]["_seq_no"]
-    # simulate B writing first with the same seq_no A saw
-    fake.put(
-        "http://fake:9200/documents/_doc/j1"
-        f"?if_seq_no={hit_seq}&if_primary_term=1",
-        json={**fake.docs["j1"]["_source"], "status": STATUS_PREPROCESS_INPROGRESS},
-    )
-    # A's claim now sees a stale seq_no on its own CAS write -> 409 -> skip
     got = a.claim("worker-a", max_stuck_seconds=90)
-    assert got == []
+    assert got == []  # stale seq_no -> per-item 409 -> skipped
+    # and the loser's write did NOT clobber the winner's version
+    assert fake.docs["j1"]["_source"]["status"] == "initial"
+
+
+def test_claim_not_starved_by_inprogress_crowd_and_two_round_trips():
+    """VERDICT r1 item 8: 64 fresh docs must be claimed even when 1,000
+    non-stuck in-progress docs exist (server-side claimability + sort,
+    not client-side filtering of an arbitrary page), in exactly two HTTP
+    round trips (search + _bulk)."""
+    fake = FakeES()
+    store, _ = _store(fake)
+    for i in range(1000):
+        store.create(
+            Document(
+                id=f"busy{i}", app_name="x", status=STATUS_PREPROCESS_INPROGRESS
+            )
+        )
+    for i in range(64):
+        store.create(Document(id=f"fresh{i}", app_name="x"))
+
+    fake.requests = 0
+    got = store.claim("worker-a", max_stuck_seconds=90, limit=64)
+    assert len(got) == 64
+    assert {d.id for d in got} == {f"fresh{i}" for i in range(64)}
+    assert fake.requests == 2  # one _search + one _bulk
+
+
+def test_claim_prefers_oldest_docs():
+    """Oldest-modified first: a stuck doc aged far in the past outranks
+    fresher claimables when the page is smaller than the backlog."""
+    fake = FakeES()
+    store, _ = _store(fake)
+    store.create(Document(id="new1", app_name="x"))
+    store.create(Document(id="stuck1", app_name="x"))
+    fake.docs["stuck1"]["_source"]["status"] = STATUS_PREPROCESS_INPROGRESS
+    fake.docs["stuck1"]["_source"]["modifiedAt"] = "2000-01-01T00:00:00Z"
+    got = store.claim("worker-a", max_stuck_seconds=90, limit=1)
+    assert [d.id for d in got] == ["stuck1"]
 
 
 def test_stuck_job_takeover():

@@ -1,0 +1,262 @@
+"""HttpKube against a real HTTP fake API server (VERDICT r1 item 7).
+
+Every method of the REST client — list/get/patch on builtin workloads,
+event posting, CRD CRUD — exercised over the wire against
+`tests/fake_kube_server.py`, including the error paths (404 -> NotFound,
+409 Conflict, merge-patch content types) the in-memory substrate never
+produces. The `foremast watch`/`unwatch` CLI and a WatchPlane step run
+against the same server, so the plane's one real-cluster dependency has
+real-socket coverage.
+"""
+
+import urllib.error
+
+import pytest
+
+from foremast_tpu.watch.crds import (
+    API_VERSION,
+    DeploymentMonitor,
+    MonitorStatus,
+)
+from foremast_tpu.watch.kubeapi import HttpKube, NotFound
+from tests.fake_kube_server import FakeKubeServer
+
+
+@pytest.fixture()
+def srv():
+    with FakeKubeServer() as s:
+        yield s
+
+
+@pytest.fixture()
+def kube(srv):
+    return HttpKube(base_url=srv.url, token="test-token")
+
+
+def _deployment(ns, name, image="app:v1"):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": ns, "labels": {"app": name}},
+        "spec": {
+            "template": {"spec": {"containers": [{"name": "c", "image": image}]}}
+        },
+    }
+
+
+def test_builtin_workload_reads(srv, kube):
+    st = srv.state
+    st.put("namespaces", "", {"metadata": {"name": "prod"}})
+    st.put("namespaces", "", {"metadata": {"name": "dev"}})
+    st.put("deployments", "prod", _deployment("prod", "shop"))
+    st.put("deployments", "dev", _deployment("dev", "cart"))
+    st.put("replicasets", "prod", {"metadata": {"name": "shop-abc"}})
+    st.put("pods", "prod", {"metadata": {"name": "shop-abc-1"}})
+
+    assert {n["metadata"]["name"] for n in kube.list_namespaces()} == {
+        "prod",
+        "dev",
+    }
+    assert kube.get_namespace("prod")["metadata"]["name"] == "prod"
+    assert len(kube.list_deployments()) == 2  # all namespaces
+    assert [d["metadata"]["name"] for d in kube.list_deployments("prod")] == [
+        "shop"
+    ]
+    assert kube.get_deployment("prod", "shop")["metadata"]["labels"] == {
+        "app": "shop"
+    }
+    assert kube.list_replicasets("prod")[0]["metadata"]["name"] == "shop-abc"
+    assert kube.list_pods("prod")[0]["metadata"]["name"] == "shop-abc-1"
+
+
+def test_get_missing_raises_notfound(kube):
+    with pytest.raises(NotFound):
+        kube.get_deployment("prod", "ghost")
+    with pytest.raises(NotFound):
+        kube.get_namespace("ghost")
+    with pytest.raises(NotFound):
+        kube.get_monitor("prod", "ghost")
+    with pytest.raises(NotFound):
+        kube.get_metadata("prod", "ghost")
+
+
+def test_patch_deployment_strategic_merge(srv, kube):
+    srv.state.put("deployments", "prod", _deployment("prod", "shop"))
+    out = kube.patch_deployment(
+        "prod", "shop", {"spec": {"paused": True, "template": None}}
+    )
+    assert out["spec"]["paused"] is True
+    assert "template" not in out["spec"]  # null deletes the key
+    # the server only accepted it because the right content type was sent
+    patches = [
+        h for m, p, h in srv.state.requests if m == "PATCH" and "shop" in p
+    ]
+    assert patches[0]["Content-Type"] == "application/strategic-merge-patch+json"
+
+
+def test_patch_missing_deployment_raises_notfound(kube):
+    with pytest.raises(NotFound):
+        kube.patch_deployment("prod", "ghost", {"spec": {"paused": True}})
+
+
+def test_create_event(srv, kube):
+    kube.create_event(
+        "prod", {"metadata": {"name": "ev1"}, "reason": "Unhealthy"}
+    )
+    assert ("prod", "ev1") in srv.state.objects["events"]
+
+
+def test_bearer_token_sent(srv, kube):
+    srv.state.put("namespaces", "", {"metadata": {"name": "prod"}})
+    kube.get_namespace("prod")
+    assert all(
+        h.get("Authorization") == "Bearer test-token"
+        for _, _, h in srv.state.requests
+    )
+
+
+def _monitor(ns, name, continuous=False):
+    return DeploymentMonitor(
+        namespace=ns,
+        name=name,
+        continuous=continuous,
+        status=MonitorStatus(job_id="job-1", phase="Running"),
+    )
+
+
+def test_monitor_crud_roundtrip(srv, kube):
+    created = kube.upsert_monitor(_monitor("prod", "shop"))  # POST path
+    assert created.name == "shop"
+    assert kube.get_monitor("prod", "shop").status.job_id == "job-1"
+    assert [m.name for m in kube.list_monitors("prod")] == ["shop"]
+    assert [m.name for m in kube.list_monitors()] == ["shop"]
+
+    updated = kube.upsert_monitor(_monitor("prod", "shop", continuous=True))
+    assert updated.continuous is True  # PUT path with fresh rv
+
+    patched = kube.patch_monitor(
+        "prod", "shop", {"spec": {"continuous": False}}
+    )
+    assert patched.continuous is False
+    assert patched.status.job_id == "job-1"  # merge-patch left status alone
+
+    kube.delete_monitor("prod", "shop")
+    with pytest.raises(NotFound):
+        kube.get_monitor("prod", "shop")
+    kube.delete_monitor("prod", "shop")  # idempotent: swallowed 404
+
+
+def test_upsert_conflict_surfaces_409(srv, kube):
+    kube.upsert_monitor(_monitor("prod", "shop"))
+    # sabotage: the server's object advances between GET and PUT
+    orig = srv.state.objects["deploymentmonitors"][("prod", "shop")]
+    done = {}
+
+    class RacingKube(HttpKube):
+        def _req(self, method, path, body=None, content_type="application/json"):
+            out = super()._req(method, path, body, content_type)
+            if method == "GET" and not done:
+                done["raced"] = True
+                with srv.state.lock:
+                    orig["metadata"]["resourceVersion"] = srv.state.next_rv()
+            return out
+
+    racing = RacingKube(base_url=srv.url)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        racing.upsert_monitor(_monitor("prod", "shop", continuous=True))
+    assert ei.value.code == 409
+
+
+def test_metadata_read(srv, kube):
+    srv.state.put(
+        "deploymentmetadatas",
+        "prod",
+        {
+            "apiVersion": API_VERSION,
+            "kind": "DeploymentMetadata",
+            "metadata": {"name": "shop", "namespace": "prod"},
+            "spec": {
+                "analyst": {"endpoint": "http://svc:8099/v1/healthcheck/"},
+                "metrics": {
+                    "dataSourceType": "prometheus",
+                    "endpoint": "http://prom:9090/",
+                    "monitoring": [
+                        {
+                            "metricName": "namespace_pod:http_server_requests_error_5xx",
+                            "metricType": "error5xx",
+                            "metricAlias": "error5xx",
+                        }
+                    ],
+                },
+            },
+        },
+    )
+    md = kube.get_metadata("prod", "shop")
+    assert md.analyst_endpoint == "http://svc:8099/v1/healthcheck/"
+    assert md.monitoring[0].metric_type == "error5xx"
+
+
+def test_cli_watch_unwatch_against_real_server(srv, capsys):
+    """`foremast watch/unwatch` (kubectl-watch parity) over a real socket."""
+    from foremast_tpu.cli import main
+
+    with FakeKubeServer() as s:
+        HttpKube(base_url=s.url).upsert_monitor(_monitor("prod", "shop"))
+        rc = main(
+            ["watch", "shop", "--namespace", "prod", "--api-server", s.url]
+        )
+        assert rc == 0
+        assert "watching application shop" in capsys.readouterr().out
+        mon = HttpKube(base_url=s.url).get_monitor("prod", "shop")
+        assert mon.continuous is True
+
+        rc = main(
+            ["unwatch", "shop", "--namespace", "prod", "--api-server", s.url]
+        )
+        assert rc == 0
+        assert not HttpKube(base_url=s.url).get_monitor("prod", "shop").continuous
+
+        rc = main(
+            ["watch", "ghost", "--namespace", "prod", "--api-server", s.url]
+        )
+        assert rc == 1  # NotFound -> exit code 1
+
+
+def test_watch_plane_step_against_real_server(srv):
+    """One WatchPlane step over HttpKube: a labeled deployment in a watched
+    namespace gets its DeploymentMonitor created through the real REST
+    path (informer resync -> upsert)."""
+    from foremast_tpu.watch.plane import WatchPlane
+
+    st = srv.state
+    st.put("namespaces", "", {"metadata": {"name": "prod"}})
+    st.put(
+        "deploymentmetadatas",
+        "prod",
+        {
+            "apiVersion": API_VERSION,
+            "kind": "DeploymentMetadata",
+            "metadata": {"name": "shop", "namespace": "prod"},
+            "spec": {
+                "analyst": {"endpoint": "http://svc:8099/v1/healthcheck/"},
+                "metrics": {
+                    "dataSourceType": "prometheus",
+                    "endpoint": "http://prom:9090/",
+                    "monitoring": [
+                        {
+                            "metricName": "namespace_pod:http_server_requests_error_5xx",
+                            "metricType": "error5xx",
+                            "metricAlias": "error5xx",
+                        }
+                    ],
+                },
+            },
+        },
+    )
+    st.put("deployments", "prod", _deployment("prod", "shop"))
+
+    kube = HttpKube(base_url=srv.url)
+    plane = WatchPlane(kube, own_namespace="foremast")
+    plane.step(now=1_700_000_000.0)
+    monitors = kube.list_monitors("prod")
+    assert [m.name for m in monitors] == ["shop"]
