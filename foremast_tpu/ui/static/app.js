@@ -1,11 +1,12 @@
-/* Foremast dashboard.
+/* Foremast dashboard — rendering only.
  *
- * Role parity with the reference UI (foremast-browser/src/App.js): poll the
- * service's query proxy every 15 s for each panel's four series
- * (base / upper / lower / anomaly), join anomaly timestamps onto the base
- * series so anomalies plot as dots on the measured curve (App.js:231-260),
- * render time-series panels with a crosshair synchronized across all panels
+ * Role parity with the reference UI (foremast-browser/src/App.js): poll
+ * every 15 s per panel, chart base / upper / lower / anomaly with anomaly
+ * dots on the measured curve, a crosshair synchronized across all panels
  * (App.js:44-78) plus a scatter chart. No chart library: plain SVG.
+ * Data shaping (series fetch, scaling, anomaly-event join — reference
+ * App.js:231-260) happens server-side in ui/join.py via /api/v1/panel so
+ * the logic is testable in Python; this file only draws the payload.
  */
 "use strict";
 
@@ -16,60 +17,22 @@ let tableMode = false;
 
 /* ---------------- data ---------------- */
 
-async function queryRange(query, start, end, step) {
-  // empty serviceEndpoint = same-origin (demo mode); base is ignored
-  // when serviceEndpoint is an absolute URL
-  const u = new URL(CFG.serviceEndpoint + "/api/v1/query_range", location.origin);
-  u.searchParams.set("query", query);
-  u.searchParams.set("start", start);
-  u.searchParams.set("end", end);
-  u.searchParams.set("step", step);
-  const r = await fetch(u);
-  if (!r.ok) throw new Error(`query_range ${r.status}`);
-  const body = await r.json();
-  const res = body?.data?.result;
-  if (!res || !res.length) return [];
-  // [[unix_ts, "value"], ...] -> [{t, v}]
-  return res[0].values.map(([t, v]) => ({ t: +t, v: +v }));
-}
-
 async function fetchPanel(p) {
-  const end = Math.floor(Date.now() / 1000);
-  const start = end - CFG.windowSeconds;
-  const byType = {};
-  await Promise.all(
-    p.cfg.series.map(async (s) => {
-      try {
-        byType[s.type] = await queryRange(s.query, start, end, CFG.stepSeconds);
-      } catch (e) {
-        byType[s.type] = [];
-      }
-    })
-  );
-  const scale = p.cfg.scale || 1;
-  for (const k of Object.keys(byType))
-    byType[k] = byType[k].map(({ t, v }) => ({ t, v: v * scale }));
-  // join anomalies onto the base curve: an anomaly dot is drawn at the
-  // *measured* value for that timestamp (reference App.js:231-260). The
-  // engine's anomaly gauge is sticky ("last anomalous value", never
-  // cleared), so the raw series repeats the value at every scrape after an
-  // anomaly — an anomaly *event* is where the series starts or its value
-  // changes, not every sample.
-  const baseByT = new Map(byType.base?.map((d) => [d.t, d.v]));
-  const events = [];
-  let prev = undefined;
-  for (const d of byType.anomaly || []) {
-    // a series that already exists at the window's left edge is an old
-    // sticky value, not an event inside this window
-    const atLeftEdge = prev === undefined && d.t <= start + CFG.stepSeconds;
-    if ((prev === undefined && !atLeftEdge) || (prev !== undefined && d.v !== prev))
-      events.push(d);
-    prev = d.v;
+  // the UI server fetches the panel's four series, scales them, and joins
+  // anomaly events onto the base curve (ui/join.py — tested in Python;
+  // reference semantics: App.js:231-260). This client only renders.
+  try {
+    const u = new URL("/api/v1/panel", location.origin);
+    u.searchParams.set("i", p.idx);
+    // the range presets mutate these; the server honors them per request
+    u.searchParams.set("window", CFG.windowSeconds);
+    u.searchParams.set("step", CFG.stepSeconds);
+    const r = await fetch(u);
+    if (!r.ok) throw new Error(`panel ${r.status}`);
+    p.data = await r.json();
+  } catch (e) {
+    p.data = {};
   }
-  byType.anomalyJoined = events
-    .filter((d) => baseByT.has(d.t))
-    .map((d) => ({ t: d.t, v: baseByT.get(d.t) }));
-  p.data = byType;
 }
 
 /* ---------------- scales / svg helpers ---------------- */
@@ -317,7 +280,7 @@ function renderScatter() {
 
 function buildPanels() {
   const root = document.getElementById("panels");
-  for (const cfg of CFG.panels) {
+  CFG.panels.forEach((cfg, idx) => {
     const el = document.createElement("div");
     el.className = "panel";
     el.innerHTML =
@@ -328,8 +291,8 @@ function buildPanels() {
       `<span><span class="dot"></span>anomaly</span>` +
       `</div><div class="chartbox"></div>`;
     root.appendChild(el);
-    panels.push({ cfg, el, data: null });
-  }
+    panels.push({ cfg, idx, el, data: null });
+  });
 }
 
 async function refresh() {

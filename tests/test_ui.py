@@ -82,7 +82,8 @@ def test_demo_mode_serves_synthetic_query_range():
             assert body["status"] == "success"
             values = body["data"]["result"][0]["values"]
             assert len(values) > 30
-            # anomaly series returns only spike timestamps (sparse)
+            # anomaly series models the engine's STICKY gauge: present at
+            # every scrape, its value changing only when a new spike lands
             r = await c.get(
                 "/api/v1/query_range",
                 params={"query": "foremastbrain_x_anomaly", "start": "0",
@@ -90,6 +91,106 @@ def test_demo_mode_serves_synthetic_query_range():
             )
             body = await r.json()
             res = body["data"]["result"]
-            assert res and len(res[0]["values"]) < 10
+            values = res[0]["values"]
+            assert len(values) > 30  # dense (sticky), not event-sparse
+            assert len({v for _, v in values}) <= 4  # few distinct spikes
+
+    asyncio.run(main())
+
+
+# -- anomaly join (VERDICT r1 item 10: the join logic, executed) -------------
+
+
+def test_anomaly_join_golden_trace_dots_land_on_base_points():
+    """Feed the golden spike trace through the join: the sticky anomaly
+    gauge repeats 40.134 after the spike; exactly the event timestamps
+    survive, plotted at the MEASURED base value."""
+    import csv
+    import os
+
+    from foremast_tpu.ui.join import join_anomalies
+
+    data = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    rows = []
+    with open(os.path.join(data, "demo_canary_spike.csv")) as f:
+        for i, row in enumerate(csv.reader(f)):
+            if row:
+                rows.append((1_700_000_000 + 15.0 * i, float(row[1])))
+    base = rows
+    start = base[0][0] - 15.0
+    # sticky gauge: holds the last anomalous value from each spike onward
+    spikes = [(t, v) for t, v in base if v > 10.0]
+    anomaly = []
+    last = None
+    for t, v in base:
+        for st, sv in spikes:
+            if st <= t:
+                last = sv
+        if last is not None:
+            anomaly.append((t, last))
+
+    joined = join_anomalies(base, anomaly, start, 15.0)
+    base_by_t = dict(base)
+    assert [t for t, _ in joined] == [t for t, _ in spikes]
+    for t, v in joined:
+        assert v == base_by_t[t], "dot must land on the measured curve"
+
+
+def test_anomaly_join_left_edge_and_missing_base():
+    from foremast_tpu.ui.join import anomaly_events, join_anomalies
+
+    # a series already present at the window's left edge is an old sticky
+    # value — not an event
+    assert anomaly_events([(100.0, 5.0), (115.0, 5.0)], 100.0, 15.0) == []
+    # value change mid-window IS an event
+    assert anomaly_events(
+        [(100.0, 5.0), (115.0, 5.0), (130.0, 7.0)], 100.0, 15.0
+    ) == [(130.0, 7.0)]
+    # appearance mid-window IS an event
+    assert anomaly_events([(160.0, 5.0)], 100.0, 15.0) == [(160.0, 5.0)]
+    # events without a matching base timestamp are dropped
+    assert join_anomalies([(100.0, 1.0)], [(160.0, 5.0)], 100.0, 15.0) == []
+
+
+def test_panel_endpoint_demo_mode_joins_anomalies_onto_base():
+    """GET /api/v1/panel end-to-end in demo mode: the payload carries all
+    four series plus anomalyJoined, every joined dot lying on the base
+    series."""
+
+    async def main():
+        app = make_app(demo=True)
+        async with TestClient(TestServer(app)) as c:
+            r = await c.get("/api/v1/panel", params={"i": "0", "end": "7200"})
+            assert r.status == 200
+            data = await r.json()
+            assert {"base", "upper", "lower", "anomaly", "anomalyJoined"} <= set(
+                data
+            )
+            assert data["base"], "demo base series must not be empty"
+            assert data["anomalyJoined"], "demo spikes must join"
+            base_by_t = {d["t"]: d["v"] for d in data["base"]}
+            for d in data["anomalyJoined"]:
+                assert d["t"] in base_by_t
+                assert d["v"] == base_by_t[d["t"]]
+            # bad panel index is a 400, not a 500
+            r = await c.get("/api/v1/panel", params={"i": "999"})
+            assert r.status == 400
+
+    asyncio.run(main())
+
+
+def test_panel_endpoint_honors_window_and_rejects_negative_index():
+    async def main():
+        app = make_app(demo=True)
+        async with TestClient(TestServer(app)) as c:
+            r1 = await c.get(
+                "/api/v1/panel",
+                params={"i": "0", "end": "7200", "window": "900", "step": "15"},
+            )
+            d1 = await r1.json()
+            ts = [d["t"] for d in d1["base"]]
+            assert min(ts) >= 7200 - 900 - 15  # the preset window applies
+            r = await c.get("/api/v1/panel", params={"i": "-1"})
+            assert r.status == 400
 
     asyncio.run(main())
