@@ -54,9 +54,12 @@ class Forecast:
     season_phase: jax.Array
 
 
-def _finalize(pred, values, mask, level, trend, season=None, season_phase=None):
-    resid = values - pred
-    scale = masked_std(resid, mask, ddof=0)
+def _finalize(
+    pred, values, mask, level, trend, season=None, season_phase=None, scale=None
+):
+    if scale is None:
+        resid = values - pred
+        scale = masked_std(resid, mask, ddof=0)
     b = values.shape[0]
     if season is None:
         season = jnp.zeros((b, 1), dtype=values.dtype)
@@ -93,11 +96,39 @@ def moving_average_all(values: jax.Array, mask: jax.Array) -> Forecast:
     (`foremast-brain.yaml:24-25`): the "model" is the historical mean, the
     deviation unit is the historical std, and bounds are
     mean +/- threshold * std.
+
+    Single-pass moments: mean and std come from (n, sum d, sum d^2) with
+    d = x - x[0] computed in ONE fused reduction over the [B, 10k]
+    history — the two-pass mean-then-centered-squares form reads the
+    7-day window twice, and this model is pure HBM bandwidth. The
+    first-value shift keeps the E[d^2]-E[d]^2 form well-conditioned: for
+    stationary series d ~ sigma, for trending series the true variance is
+    itself of order the deviation range, so no catastrophic cancellation
+    in either regime (an absolute-offset-heavy series is exactly what the
+    shift removes).
     """
-    mu = masked_mean(values, mask)  # [B]
+    b, t_len = values.shape
+    if t_len == 0:  # empty-history batch: unmeasurable, not a crash
+        zeros = jnp.zeros((b,), values.dtype)
+        return _finalize(values, values, mask, level=zeros, trend=zeros, scale=zeros)
+    m = mask.astype(values.dtype)
+    # shift by each row's FIRST VALID value — slot 0 may be padding
+    # (MetricWindows: "padding arbitrary where invalid"), and an extreme
+    # padding value would otherwise poison d^2 (overflow -> NaN scale)
+    first_idx = jnp.argmax(mask, axis=-1)  # 0 for all-invalid rows (gated)
+    c = jnp.take_along_axis(values, first_idx[:, None], axis=-1)  # [B,1]
+    d = (values - c) * m
+    n = jnp.sum(m, axis=-1)
+    s1 = jnp.sum(d, axis=-1)
+    s2 = jnp.sum(d * d, axis=-1)
+    nn = jnp.maximum(n, 1.0)
+    mean_d = s1 / nn
+    mu = jnp.where(n > 0, c[:, 0] + mean_d, 0.0)
+    var = jnp.maximum(s2 / nn - mean_d * mean_d, 0.0)
+    scale = jnp.where(n > 0, jnp.sqrt(var), 0.0)
     pred = jnp.broadcast_to(mu[:, None], values.shape)
     zeros = jnp.zeros_like(mu)
-    return _finalize(pred, values, mask, level=mu, trend=zeros)
+    return _finalize(pred, values, mask, level=mu, trend=zeros, scale=scale)
 
 
 def moving_average(values: jax.Array, mask: jax.Array, window: int = 10) -> Forecast:

@@ -284,3 +284,22 @@ def test_auto_univariate_routes_by_structure():
     assert float(fc.trend[2]) == pytest.approx(0.002, rel=0.5)
     # scales: structured rows near the noise level, flat row too
     assert all(float(s) < 0.12 for s in np.asarray(fc.scale))
+
+
+def test_moving_average_all_robust_to_padding_and_empty():
+    """Single-pass moments must not read padding: an extreme value in a
+    MASKED slot 0 ('padding arbitrary where invalid') cannot poison the
+    moments, and a zero-length time axis is unmeasurable, not a crash."""
+    v = np.full((1, 8), 1.0, np.float32)
+    v[0, 0] = 3e20  # masked-out garbage
+    m = np.ones((1, 8), bool)
+    m[0, 0] = False
+    fc = moving_average_all(jnp.asarray(v), jnp.asarray(m))
+    assert float(fc.level[0]) == pytest.approx(1.0)
+    assert float(fc.scale[0]) == pytest.approx(0.0, abs=1e-5)
+    empty = moving_average_all(jnp.zeros((2, 0)), jnp.zeros((2, 0), bool))
+    assert empty.pred.shape == (2, 0)
+    assert np.all(np.asarray(empty.scale) == 0.0)
+    # all-invalid rows gate to zeros even next to huge garbage
+    fc2 = moving_average_all(jnp.asarray(v), jnp.zeros((1, 8), bool))
+    assert float(fc2.level[0]) == 0.0 and float(fc2.scale[0]) == 0.0
