@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -248,6 +249,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
         PrometheusSource(),
         config=config,
         judge=judge,
+        claim_limit=args.claim_limit,
         on_verdict=on_verdict,
         metrics=worker_metrics,
     )
@@ -354,6 +356,20 @@ def cmd_rules(args: argparse.Namespace) -> int:
     return 0
 
 
+def _env_int(name: str, default: int) -> int:
+    """Env-var int with a warning (not a crash) on malformed values —
+    build_parser runs for EVERY subcommand, so a bad env var must not
+    break unrelated commands with a raw traceback."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        print(f"ignoring malformed {name}={raw!r}; using {default}", file=sys.stderr)
+        return default
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="foremast", description=__doc__,
@@ -384,6 +400,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_worker)
     p.add_argument("--elastic-url", default=None)
     p.add_argument("--poll", type=float, default=5.0)
+    p.add_argument(
+        "--claim-limit",
+        type=int,
+        default=_env_int("FOREMAST_CLAIM_LIMIT", 256),
+        help="jobs claimed per tick; the whole claim scores as ONE batched "
+        "program, so fleet-scale limits amortize fixed dispatch cost "
+        "(env FOREMAST_CLAIM_LIMIT)",
+    )
     p.add_argument(
         "--sharded",
         action="store_true",

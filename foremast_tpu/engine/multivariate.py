@@ -69,10 +69,15 @@ ALGO_LSTM = "lstm_autoencoder"
 ALGO_AUTO = "auto"
 MULTIVARIATE_ALGOS = frozenset({ALGO_BIVARIATE, ALGO_LSTM, ALGO_AUTO})
 
-# Univariate fallback when a multivariate algorithm is configured but the
-# job's metric count doesn't fit (e.g. a 1-metric job under `auto`) — the
-# reference's deployed default (`foremast-brain.yaml:24-25`).
+# Univariate fallbacks when a multivariate algorithm is configured but the
+# job's metric count doesn't fit. `auto` means "pick the best model for
+# the job's shape", so its univariate branch uses the structure screen
+# (flat -> global mean, seasonal/trend -> fitted Holt-Winters; quality
+# table in BENCHMARKS.md). Explicitly-configured bivariate/lstm keep the
+# reference's deployed default for their misfit jobs — the operator chose
+# a specific algorithm, not "best available" (`foremast-brain.yaml:24-25`).
 FALLBACK_UNIVARIATE = "moving_average_all"
+FALLBACK_AUTO = "auto_univariate"
 
 
 def select_mode(algorithm: str, n_metrics: int) -> str:
@@ -219,7 +224,12 @@ class MultivariateJudge:
         self.config = config or BrainConfig()
         uni_cfg = self.config
         if self.config.algorithm in MULTIVARIATE_ALGOS:
-            uni_cfg = dataclasses.replace(self.config, algorithm=FALLBACK_UNIVARIATE)
+            fallback = (
+                FALLBACK_AUTO
+                if self.config.algorithm == ALGO_AUTO
+                else FALLBACK_UNIVARIATE
+            )
+            uni_cfg = dataclasses.replace(self.config, algorithm=fallback)
         self.univariate = univariate or HealthJudge(uni_cfg)
         if self.univariate.config.algorithm in MULTIVARIATE_ALGOS:
             # an injected judge (e.g. ShardedJudge) built from the raw

@@ -32,6 +32,7 @@ from foremast_tpu.ops.anomaly import (
 __all__ = [
     "MetricWindows",
     "masked_mean",
+    "masked_moments",
     "masked_std",
     "masked_var",
     "Forecast",

@@ -30,7 +30,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from foremast_tpu.ops.windows import masked_mean, masked_std
+from foremast_tpu.ops.windows import masked_mean, masked_moments, masked_std
 
 
 @jax.tree_util.register_dataclass
@@ -97,35 +97,17 @@ def moving_average_all(values: jax.Array, mask: jax.Array) -> Forecast:
     deviation unit is the historical std, and bounds are
     mean +/- threshold * std.
 
-    Single-pass moments: mean and std come from (n, sum d, sum d^2) with
-    d = x - x[0] computed in ONE fused reduction over the [B, 10k]
-    history — the two-pass mean-then-centered-squares form reads the
-    7-day window twice, and this model is pure HBM bandwidth. The
-    first-value shift keeps the E[d^2]-E[d]^2 form well-conditioned: for
-    stationary series d ~ sigma, for trending series the true variance is
-    itself of order the deviation range, so no catastrophic cancellation
-    in either regime (an absolute-offset-heavy series is exactly what the
-    shift removes).
+    Uses `masked_moments` — mean and variance in ONE fused reduction over
+    the [B, 10k] history (the two-pass mean-then-centered-squares form
+    reads the 7-day window twice, and this model is pure HBM bandwidth;
+    headline note in BENCHMARKS.md).
     """
     b, t_len = values.shape
     if t_len == 0:  # empty-history batch: unmeasurable, not a crash
         zeros = jnp.zeros((b,), values.dtype)
         return _finalize(values, values, mask, level=zeros, trend=zeros, scale=zeros)
-    m = mask.astype(values.dtype)
-    # shift by each row's FIRST VALID value — slot 0 may be padding
-    # (MetricWindows: "padding arbitrary where invalid"), and an extreme
-    # padding value would otherwise poison d^2 (overflow -> NaN scale)
-    first_idx = jnp.argmax(mask, axis=-1)  # 0 for all-invalid rows (gated)
-    c = jnp.take_along_axis(values, first_idx[:, None], axis=-1)  # [B,1]
-    d = (values - c) * m
-    n = jnp.sum(m, axis=-1)
-    s1 = jnp.sum(d, axis=-1)
-    s2 = jnp.sum(d * d, axis=-1)
-    nn = jnp.maximum(n, 1.0)
-    mean_d = s1 / nn
-    mu = jnp.where(n > 0, c[:, 0] + mean_d, 0.0)
-    var = jnp.maximum(s2 / nn - mean_d * mean_d, 0.0)
-    scale = jnp.where(n > 0, jnp.sqrt(var), 0.0)
+    _, mu, var = masked_moments(values, mask)
+    scale = jnp.sqrt(var)
     pred = jnp.broadcast_to(mu[:, None], values.shape)
     zeros = jnp.zeros_like(mu)
     return _finalize(pred, values, mask, level=mu, trend=zeros, scale=scale)

@@ -80,6 +80,34 @@ class MetricWindows:
         )
 
 
+def masked_moments(
+    values: jax.Array, mask: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(n, mean, var) over the last axis in ONE fused pass.
+
+    Uses shifted moments — d = x - x[first valid index] — so the
+    E[d^2] - E[d]^2 form stays well-conditioned (the shift point is a
+    member of the sample, so deviations are bounded by the sample range)
+    and arbitrary padding values in masked slots can never poison the
+    result. This is the bandwidth-optimal form for the 7-day histories
+    the deployed-default model reduces (BENCHMARKS.md headline note); the
+    two-pass `masked_mean`/`masked_var` pair remains for callers that
+    need an axis argument or ddof.
+    """
+    m = mask.astype(values.dtype)
+    first_idx = jnp.argmax(mask, axis=-1)  # 0 for all-invalid rows (gated)
+    c = jnp.take_along_axis(values, first_idx[..., None], axis=-1)
+    d = (values - c) * m
+    n = jnp.sum(m, axis=-1)
+    s1 = jnp.sum(d, axis=-1)
+    s2 = jnp.sum(d * d, axis=-1)
+    nn = jnp.maximum(n, 1.0)
+    mean_d = s1 / nn
+    mean = jnp.where(n > 0, c[..., 0] + mean_d, 0.0)
+    var = jnp.where(n > 0, jnp.maximum(s2 / nn - mean_d * mean_d, 0.0), 0.0)
+    return n, mean, var
+
+
 def masked_mean(values: jax.Array, mask: jax.Array, axis: int = -1) -> jax.Array:
     """Mean over valid points; 0.0 where a window has no valid points."""
     m = mask.astype(values.dtype)

@@ -82,6 +82,10 @@ class ShardedJudge(HealthJudge):
                 hist_values=empty,
                 cur_times=et,
                 cur_values=empty,
+                # constant fit-cache key: the empty-history "fit" (n=0 ->
+                # UNKNOWN, dropped below) caches once, so warm re-check
+                # ticks stay fit-free even when the batch needs padding
+                fit_key="__pad__",
             )
             tasks = list(tasks) + [pad_task] * (target - b)
         out = super()._judge_bucket(tasks, th, tc)

@@ -385,3 +385,14 @@ def test_lstm_mvn_refits_for_new_deployment_history():
         "stale time-anchored MVN state replayed against a phase-shifted "
         "deployment"
     )
+
+
+def test_auto_univariate_branch_uses_structure_screen():
+    """`auto`'s univariate branch routes through the structure screen
+    (flat -> mean model, seasonal/trend -> fitted HW), not the blind
+    deployed default; explicitly-configured multivariate algorithms keep
+    the reference default for their misfit jobs."""
+    judge = MultivariateJudge(BrainConfig(algorithm=ALGO_AUTO))
+    assert judge.univariate.config.algorithm == "auto_univariate"
+    judge_bi = MultivariateJudge(BrainConfig(algorithm=ALGO_BIVARIATE))
+    assert judge_bi.univariate.config.algorithm == "moving_average_all"

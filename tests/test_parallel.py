@@ -212,3 +212,52 @@ def test_score_time_sharded_matches_xla(mesh_2d):
     np.testing.assert_array_equal(np.asarray(ref.anomalies), np.asarray(res.anomalies))
     np.testing.assert_allclose(np.asarray(ref.upper), np.asarray(res.upper), rtol=1e-4)
     np.testing.assert_allclose(np.asarray(ref.p_value), np.asarray(res.p_value), rtol=1e-5)
+
+
+def test_sharded_judge_composes_with_fit_cache():
+    """ShardedJudge + HealthJudge.fit_cache on the virtual mesh: identical
+    verdicts cold vs warm, and a warm tick runs NO fit at all — including
+    for the mesh-padding rows (constant '__pad__' cache key)."""
+    import numpy as np
+
+    from foremast_tpu.engine import scoring
+    from foremast_tpu.engine.judge import MetricTask
+    from foremast_tpu.models.cache import ModelCache
+    from foremast_tpu.parallel.batch import ShardedJudge
+
+    rng = np.random.default_rng(0)
+    t = np.arange(24 * 10, dtype=np.float32)
+
+    def task(i, spike=False):
+        hist = (5 + 2 * np.sin(2 * np.pi * t / 24)
+                + rng.normal(0, 0.1, len(t))).astype(np.float32)
+        cur = (5 + 2 * np.sin(2 * np.pi * (len(t) + np.arange(10)) / 24)
+               ).astype(np.float32)
+        if spike:
+            cur = cur.copy()
+            cur[4] = 40.0
+        ht = 1_700_000_000 + 60 * np.arange(len(t), dtype=np.int64)
+        ct = ht[-1] + 60 + 60 * np.arange(10, dtype=np.int64)
+        return MetricTask(
+            job_id=f"j{i}", alias="m", metric_type=None,
+            hist_times=ht, hist_values=hist, cur_times=ct, cur_values=cur,
+            fit_key=f"a{i}|m|u{i}",
+        )
+
+    judge = ShardedJudge(BrainConfig(algorithm="holt_winters"))
+    judge.fit_cache = ModelCache(64)
+    tasks = [task(i, spike=(i == 3)) for i in range(12)]  # 12 % 8 != 0: pads
+    v1 = judge.judge(tasks)
+    orig = scoring.fit_forecast
+
+    def boom(*a, **k):  # pragma: no cover - failure path
+        raise AssertionError("fit ran on a warm sharded tick")
+
+    scoring.fit_forecast = boom
+    try:
+        v2 = judge.judge(tasks)
+    finally:
+        scoring.fit_forecast = orig
+    assert [v.verdict for v in v1] == [v.verdict for v in v2]
+    assert v1[3].verdict == scoring.UNHEALTHY
+    assert all(v.verdict == scoring.HEALTHY for i, v in enumerate(v1) if i != 3)
