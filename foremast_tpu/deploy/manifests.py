@@ -865,7 +865,101 @@ def kube_state_metrics() -> list[dict]:
     return [sa, role, binding, dep, svc]
 
 
+def grafana_dashboard() -> dict:
+    """A Grafana dashboard generated from the SAME panel spec the built-in
+    UI renders (`ui/metrics.DEFAULT_PANELS`) — base series, model band,
+    and anomaly gauge per metric, parameterized by $namespace/$app — so
+    the Grafana view can never drift from what the engine publishes."""
+    import json as _json
+
+    from foremast_tpu.ui.metrics import DEFAULT_PANELS
+
+    panels = []
+    for i, p in enumerate(DEFAULT_PANELS):
+        scale = "" if p.scale == 1.0 else f" * {p.scale}"
+        targets = []
+        for s in p.series("$namespace", "$app"):
+            targets.append(
+                {
+                    "expr": s["query"] + scale,
+                    "legendFormat": s["type"],
+                    "refId": chr(ord("A") + len(targets)),
+                }
+            )
+        panels.append(
+            {
+                "id": i + 1,
+                "type": "timeseries",
+                "title": f"{p.common_name} ({p.unit})",
+                "gridPos": {
+                    "x": (i % 2) * 12,
+                    "y": (i // 2) * 8,
+                    "w": 12,
+                    "h": 8,
+                },
+                "datasource": {"type": "prometheus", "uid": "prometheus"},
+                "targets": targets,
+            }
+        )
+    dashboard = {
+        "uid": "foremast",
+        "title": "Foremast — application health",
+        "tags": ["foremast"],
+        "timezone": "browser",
+        "refresh": "15s",  # the reference UI's poll (App.js:20,78)
+        "time": {"from": "now-1h", "to": "now"},
+        "templating": {
+            "list": [
+                {
+                    "name": "namespace",
+                    "type": "query",
+                    "datasource": {"type": "prometheus", "uid": "prometheus"},
+                    "query": "label_values(namespace_app:pod_count, namespace)",
+                    "refresh": 2,
+                },
+                {
+                    "name": "app",
+                    "type": "query",
+                    "datasource": {"type": "prometheus", "uid": "prometheus"},
+                    "query": 'label_values(namespace_app:pod_count{namespace="$namespace"}, app)',
+                    "refresh": 2,
+                },
+            ]
+        },
+        "panels": panels,
+        "schemaVersion": 39,
+    }
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {
+            "name": "grafana-dashboard-foremast",
+            "namespace": MONITORING_NAMESPACE,
+        },
+        "data": {"foremast.json": _json.dumps(dashboard, indent=1)},
+    }
+
+
 def grafana() -> list[dict]:
+    provider = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {
+            "name": "grafana-dashboard-provider",
+            "namespace": MONITORING_NAMESPACE,
+        },
+        "data": {
+            "provider.yaml": (
+                "apiVersion: 1\n"
+                "providers:\n"
+                "  - name: foremast\n"
+                "    folder: ''\n"
+                "    type: file\n"
+                "    options:\n"
+                "      path: /var/lib/grafana/dashboards\n"
+            )
+        },
+    }
     datasource = {
         "apiVersion": "v1",
         "kind": "ConfigMap",
@@ -878,6 +972,7 @@ def grafana() -> list[dict]:
                 "apiVersion: 1\n"
                 "datasources:\n"
                 "  - name: Prometheus\n"
+                "    uid: prometheus\n"
                 "    type: prometheus\n"
                 "    access: proxy\n"
                 "    url: http://prometheus-k8s.monitoring.svc:9090\n"
@@ -899,7 +994,15 @@ def grafana() -> list[dict]:
                 {
                     "name": "datasources",
                     "mountPath": "/etc/grafana/provisioning/datasources",
-                }
+                },
+                {
+                    "name": "dashboard-provider",
+                    "mountPath": "/etc/grafana/provisioning/dashboards",
+                },
+                {
+                    "name": "dashboards",
+                    "mountPath": "/var/lib/grafana/dashboards",
+                },
             ],
             "resources": {
                 "requests": {"cpu": "50m", "memory": "128Mi"},
@@ -910,7 +1013,15 @@ def grafana() -> list[dict]:
         scrape=False,
     )
     dep["spec"]["template"]["spec"]["volumes"] = [
-        {"name": "datasources", "configMap": {"name": "grafana-datasources"}}
+        {"name": "datasources", "configMap": {"name": "grafana-datasources"}},
+        {
+            "name": "dashboard-provider",
+            "configMap": {"name": "grafana-dashboard-provider"},
+        },
+        {
+            "name": "dashboards",
+            "configMap": {"name": "grafana-dashboard-foremast"},
+        },
     ]
     svc = {
         "apiVersion": "v1",
@@ -921,7 +1032,7 @@ def grafana() -> list[dict]:
             "ports": [{"port": 3000, "targetPort": 3000}],
         },
     }
-    return [datasource, dep, svc]
+    return [provider, grafana_dashboard(), datasource, dep, svc]
 
 
 # ---------------------------------------------------------------------------

@@ -22,6 +22,8 @@ from __future__ import annotations
 import dataclasses
 import functools
 import json
+
+from foremast_tpu.observe.gauges import _san
 from collections.abc import Iterable
 
 # Rate window for request-class rules (reference uses [1m] throughout the
@@ -159,27 +161,108 @@ def _per_pod_rules(metrics: Iterable[str]) -> list[RecordingRule]:
 BRAIN_GAUGE_SUFFIXES = ("upper", "lower", "anomaly")
 
 
+#: The recorded family the engine's gauges are named after: historical
+#: queries always use the per-app form (`metricsquery.go:73-78`), and the
+#: reference browser charts exactly these (`metrics.js:15-23`).
+def brain_gauge_series(metric: str) -> str:
+    return f"namespace_app_per_pod:{metric}"
+
+
 def brain_rules() -> list[RecordingRule]:
     """Restore the reference's `foremastbrain:` colon spelling.
 
-    The scoring worker exposes `foremastbrain_<metric>_{upper,lower,
-    anomaly}` on :8000/metrics — prometheus_client forbids ':' in
-    exposition names (it is reserved for recording rules). The reference
-    contract, which its dashboards and alert rules are written against, is
-    the colon form `foremastbrain:<metric>_{upper,lower,anomaly}`
+    The scoring worker names its gauges after the job's base series and
+    exposes them with '_' for ':' on :8000/metrics (prometheus_client
+    forbids ':' — it is reserved for recording rules):
+    `foremastbrain_namespace_app_per_pod_<metric>_{upper,lower,anomaly}`.
+    The reference contract, which its dashboards and alert rules are
+    written against, is the colon form
+    `foremastbrain:namespace_app_per_pod:<metric>_{upper,lower,anomaly}`
     (`deploy/foremast/3_brain/foremast-brain.yaml:109-122`,
-    `foremast-browser/src/config/metrics.js:15-23`). One recording rule per
-    (metric, bound) republishes each exported series under the exact
-    reference name, for every metric in the standard vocabulary
-    (ALL_METRICS — the names DeploymentMetadata monitoring lists use)."""
+    `foremast-browser/src/config/metrics.js:15-23`). One recording rule
+    per (metric, bound) republishes each exported series under the exact
+    reference name, for every metric in the standard vocabulary."""
     return [
         RecordingRule(
-            f"foremastbrain:{m}_{suffix}",
-            f"foremastbrain_{m}_{suffix}",
+            f"foremastbrain:{brain_gauge_series(m)}_{suffix}",
+            f"foremastbrain_{_san(brain_gauge_series(m))}_{suffix}",
         )
         for m in ALL_METRICS
         for suffix in BRAIN_GAUGE_SUFFIXES
     ]
+
+
+def alert_rules() -> list[dict]:
+    """Alerting rules over the brain's gauge families.
+
+    The reference declares the intent without shipping rules: "We will
+    send foremast internal metrics so that we can define AlertRules in
+    prometheus to generate Alerts" (`types.go:190-191`). These close that
+    loop, written against the colon-spelled series `brain_rules` records:
+
+      * ForemastAnomaly<metric>   — the sticky anomaly gauge changed
+        value in the last 5 m (a NEW anomaly event; the gauge holds the
+        last anomalous value forever, so `changes()` isolates events —
+        same semantics as the dashboard join, ui/join.py);
+      * ForemastUpperBreach<metric> — the measured per-pod series sits
+        above the model's upper band for 2 m (label_replace aligns the
+        gauge's exported_namespace with the recorded series' namespace);
+      * ForemastEngineDown        — no scoring engine is exporting
+        self-telemetry at all.
+    """
+    rules: list[dict] = []
+    for m in ALL_METRICS:
+        gauge = brain_gauge_series(m)  # the series the engine publishes
+        rules.append(
+            {
+                "alert": f"ForemastAnomaly_{m}",
+                "expr": f"changes(foremastbrain:{gauge}_anomaly[5m]) > 0",
+                "labels": {"severity": "warning"},
+                "annotations": {
+                    "summary": (
+                        "Foremast flagged an anomaly on "
+                        + m
+                        + " for {{ $labels.app }} in "
+                        + "{{ $labels.exported_namespace }}"
+                    )
+                },
+            }
+        )
+        rules.append(
+            {
+                "alert": f"ForemastUpperBreach_{m}",
+                # max by(...) dedupes scrape-label variants of the gauge
+                # (engine restart keeps the old pod's series alive for the
+                # staleness window; group_left needs a unique right side)
+                "expr": (
+                    f"{gauge} > on(namespace, app) group_left() "
+                    "max by (namespace, app) (label_replace("
+                    f'foremastbrain:{gauge}_upper, "namespace", "$1", '
+                    '"exported_namespace", "(.*)"))'
+                ),
+                "for": "2m",
+                "labels": {"severity": "warning"},
+                "annotations": {
+                    "summary": (
+                        m
+                        + " above the model's upper band for "
+                        + "{{ $labels.app }} in {{ $labels.namespace }}"
+                    )
+                },
+            }
+        )
+    rules.append(
+        {
+            "alert": "ForemastEngineDown",
+            "expr": "absent(foremast_worker_tick_seconds_count)",
+            "for": "5m",
+            "labels": {"severity": "critical"},
+            "annotations": {
+                "summary": "no foremast scoring engine is exporting telemetry"
+            },
+        }
+    )
+    return rules
 
 
 def all_rules() -> list[RecordingRule]:
@@ -221,6 +304,10 @@ def prometheus_rule_manifest(
                 {
                     "name": "foremastbrain.gauge.spelling.rules",
                     "rules": [r.to_dict() for r in brain_rules()],
+                },
+                {
+                    "name": "foremast.alert.rules",
+                    "rules": alert_rules(),
                 },
             ]
         },

@@ -8,15 +8,17 @@ Prometheus series scraped from :8000/metrics
 — model internals become dashboards and alert-rule inputs
 (`types.go:190-191`). Same here, via prometheus_client.
 
-Note: prometheus_client forbids ':' in metric names (it is the PromQL
-recording-rule separator); the reference's names come from recording-style
-gauge registration. We export `foremastbrain_<metric>_upper` and restore
-the exact `foremastbrain:` spelling via generated recording rules —
-`metrics.rules.brain_rules()`, rendered into
-`deploy/foremast/2_watch/metrics-rules.yaml` — one
-`foremastbrain:<metric>_<suffix> = foremastbrain_<metric>_<suffix>` rule
-per metric in the standard vocabulary (`metrics.rules.ALL_METRICS`), so
-reference-compatible dashboards and alert rules see data unchanged.
+Gauge naming contract (`metrics.js:15-23`): the gauge is named after the
+BASE SERIES of the job's historical query — the reference browser charts
+`foremastbrain:namespace_app_per_pod:<metric>_{upper,lower,anomaly}`.
+prometheus_client forbids ':' in exposition names (it is the PromQL
+recording-rule separator), so the worker exports the sanitized form
+`foremastbrain_namespace_app_per_pod_<metric>_<suffix>` and the generated
+recording rules (`metrics.rules.brain_rules()`, rendered into
+`deploy/foremast/2_watch/metrics-rules.yaml` and the standalone stack's
+native rule file) republish every family under the exact reference
+spelling, so reference-compatible dashboards and alert rules see data
+unchanged.
 """
 
 from __future__ import annotations
@@ -77,9 +79,45 @@ class BrainGauges:
             an.labels(**labels).set(anomaly_value)
 
 
+# base series name of a BARE-selector PromQL query, e.g.
+# `query=namespace_app_per_pod:http_server_requests_latency{...}`. The
+# lookahead rejects wrapped expressions (`query=sum(rate(...))` must NOT
+# name a gauge "sum" — such jobs fall back to the alias).
+_SERIES_RE = re.compile(r"query=([a-zA-Z_:][a-zA-Z0-9_:]*)(?=\{|&|$)")
+
+
+def _series_names(config: str) -> dict[str, str]:
+    """alias -> base series name from a job config string's queries.
+
+    Uses the canonical config-string codec (`metrics.promql.decode_config`
+    — the same strings the brain fetches) and extracts the series from
+    each URL; aliases whose query is not a bare selector are omitted (the
+    caller falls back to the alias)."""
+    import urllib.parse
+
+    from foremast_tpu.metrics.promql import decode_config
+
+    out: dict[str, str] = {}
+    for alias, url in decode_config(config or "").items():
+        m = _SERIES_RE.search(urllib.parse.unquote(url))
+        if m:
+            out[alias] = m.group(1)
+    return out
+
+
 def make_verdict_hook(gauges: BrainGauges, namespace: str | None = None):
     """BrainWorker.on_verdict adapter: publish the latest band edge and
     anomalous value per metric after each judgment.
+
+    Gauge names follow the reference contract
+    (`foremast-browser/src/config/metrics.js:15-23`): the gauge is named
+    after the BASE SERIES of the job's historical query — e.g.
+    `foremastbrain:namespace_app_per_pod:http_server_requests_latency_upper`
+    (exported with '_' for ':'; the generated recording rules restore the
+    colon spelling) — NOT after the job's short alias, so the UI, Grafana
+    dashboard, and alert rules can all address the band without knowing
+    per-app alias conventions. Jobs whose queries carry no parsable series
+    name (arbitrary REST clients) fall back to the alias.
 
     The `exported_namespace` label is derived per-document from the job's
     PromQL selector (`namespace="..."` inside currentConfig) so the gauge
@@ -95,11 +133,16 @@ def make_verdict_hook(gauges: BrainGauges, namespace: str | None = None):
     def hook(doc, verdicts):
         m = ns_re.search(urllib.parse.unquote(doc.current_config or ""))
         namespace = m.group(1) if m else default_ns
+        # historical queries always use the per-app family the browser
+        # charts (metricsquery.go:73-78); fall back to the current config
+        names = _series_names(doc.historical_config) or _series_names(
+            doc.current_config
+        )
         for v in verdicts:
             if len(v.upper) == 0:
                 continue
             gauges.publish(
-                metric=v.alias,
+                metric=names.get(v.alias, v.alias),
                 namespace=namespace,
                 app=doc.app_name,
                 upper=float(v.upper[-1]),

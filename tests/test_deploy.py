@@ -92,7 +92,9 @@ def test_monitoring_stack_is_self_contained():
     assert jobs == {"kube-state-metrics", "kubernetes-pods-scrape"}
     assert prom_cfg["rule_files"] == ["/etc/prometheus/rules.yml"]
     rules = yaml.safe_load(data["rules.yml"])
-    records = [r["record"] for g in rules["groups"] for r in g["rules"]]
+    records = [
+        r["record"] for g in rules["groups"] for r in g["rules"] if "record" in r
+    ]
     assert "namespace_pod:http_server_requests_error_5xx" in records
     assert any(r.startswith("foremastbrain:") for r in records)
 
@@ -115,5 +117,37 @@ def test_monitoring_stack_is_self_contained():
     assert any("metric-labels-allowlist" in a for a in args)
 
     graf = t["prometheus/2_stack/grafana.yaml"]
-    ds = next(d for d in graf if d["kind"] == "ConfigMap")
-    assert "prometheus-k8s.monitoring.svc:9090" in ds["data"]["datasources.yaml"]
+    cms = {d["metadata"]["name"]: d for d in graf if d["kind"] == "ConfigMap"}
+    assert "prometheus-k8s.monitoring.svc:9090" in (
+        cms["grafana-datasources"]["data"]["datasources.yaml"]
+    )
+
+    # the provisioned dashboard is generated from the UI's own panel spec
+    import json as _json
+
+    from foremast_tpu.ui.metrics import DEFAULT_PANELS
+
+    dash = _json.loads(
+        cms["grafana-dashboard-foremast"]["data"]["foremast.json"]
+    )
+    assert len(dash["panels"]) == len(DEFAULT_PANELS)
+    for p, spec in zip(dash["panels"], DEFAULT_PANELS):
+        exprs = [tgt["expr"] for tgt in p["targets"]]
+        assert len(exprs) == 4  # base/upper/lower/anomaly
+        assert any(spec.metric in e for e in exprs)
+        assert all('$namespace' in e and '$app' in e for e in exprs)
+    # the dashboard lands in the provider's path via the pod volumes
+    dep = next(d for d in graf if d["kind"] == "Deployment")
+    mounts = {
+        m["mountPath"]
+        for m in dep["spec"]["template"]["spec"]["containers"][0]["volumeMounts"]
+    }
+    assert "/var/lib/grafana/dashboards" in mounts
+    assert "/etc/grafana/provisioning/dashboards" in mounts
+
+    # alert rules ride the same native rule file Prometheus loads
+    assert any(
+        r.get("alert") == "ForemastEngineDown"
+        for g in rules["groups"]
+        for r in g["rules"]
+    )

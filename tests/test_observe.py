@@ -41,8 +41,14 @@ def test_worker_publishes_gauges(demo_traces):
         Document(
             id="g1",
             app_name="demo",
-            current_config="error4xx== http://x/cur",
-            historical_config="error4xx== http://x/hist",
+            current_config=(
+                "error4xx== http://x/cur?query=namespace_pod"
+                "%3Ahttp_server_requests_error_4xx%7Bnamespace%3D%22ns%22%7D"
+            ),
+            historical_config=(
+                "error4xx== http://x/hist?query=namespace_app_per_pod"
+                "%3Ahttp_server_requests_error_4xx%7Bnamespace%3D%22ns%22%7D"
+            ),
         )
     )
     reg = CollectorRegistry()
@@ -52,9 +58,12 @@ def test_worker_publishes_gauges(demo_traces):
     )
     worker.tick(now=1e12)
     text = generate_latest(reg).decode()
-    assert "foremastbrain_error4xx_upper" in text
+    # gauge named after the HISTORICAL query's base series (the reference
+    # browser contract, metrics.js:15-23), not the job's short alias
+    g = "foremastbrain_namespace_app_per_pod_http_server_requests_error_4xx"
+    assert f"{g}_upper" in text
     assert 'app="demo"' in text
-    assert "foremastbrain_error4xx_anomaly" in text  # spike published
+    assert f"{g}_anomaly" in text  # spike published
 
 
 def test_verdict_hook_derives_namespace_from_query():
@@ -121,8 +130,14 @@ def test_worker_metrics_counters(demo_traces):
         Document(
             id="wm1",
             app_name="demo",
-            current_config="error4xx== http://x/cur",
-            historical_config="error4xx== http://x/hist",
+            current_config=(
+                "error4xx== http://x/cur?query=namespace_pod"
+                "%3Ahttp_server_requests_error_4xx%7Bnamespace%3D%22ns%22%7D"
+            ),
+            historical_config=(
+                "error4xx== http://x/hist?query=namespace_app_per_pod"
+                "%3Ahttp_server_requests_error_4xx%7Bnamespace%3D%22ns%22%7D"
+            ),
         )
     )
     reg = CollectorRegistry()
@@ -132,3 +147,20 @@ def test_worker_metrics_counters(demo_traces):
     assert 'foremast_worker_jobs_total{status="completed_unhealth"} 1.0' in text
     assert "foremast_worker_windows_total 1.0" in text
     assert "foremast_worker_tick_seconds_count 1.0" in text
+
+
+def test_series_names_rejects_wrapped_expressions():
+    """Gauge naming falls back to the alias for non-bare-selector queries:
+    `sum(rate(...))` must not name a gauge "sum" (two such aliases would
+    collide into one family and overwrite each other)."""
+    from foremast_tpu.observe.gauges import _series_names
+
+    cfg = (
+        "a== http://x?query=sum%28rate%28m1%5B5m%5D%29%29"
+        " ||b== http://x?query=namespace_app_per_pod%3Alat%7Bapp%3D%22s%22%7D"
+        " ||c== http://x?query=bare_series&start=1&end=2"
+    )
+    names = _series_names(cfg)
+    assert "a" not in names  # wrapped expression: alias fallback
+    assert names["b"] == "namespace_app_per_pod:lat"
+    assert names["c"] == "bare_series"

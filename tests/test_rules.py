@@ -77,21 +77,59 @@ def test_manifest_yaml_roundtrip():
         "core.metrics.aggregation.rules",
         "request.metrics.aggregation.rules",
         "foremastbrain.gauge.spelling.rules",
+        "foremast.alert.rules",
     }
+
+
+def test_alert_rules_cover_every_metric_and_engine_liveness():
+    """The reference only DECLARES alerting intent (`types.go:190-191`);
+    the generated rules deliver it: per metric an anomaly-event alert
+    (changes() on the sticky gauge — same event semantics as the UI join)
+    and an upper-band breach alert with the exported_namespace join, plus
+    an engine-liveness alert."""
+    from foremast_tpu.metrics.rules import alert_rules
+
+    rules = alert_rules()
+    by_name = {r["alert"]: r for r in rules}
+    for m in ALL_METRICS:
+        gauge = f"namespace_app_per_pod:{m}"  # what the engine publishes
+        anom = by_name[f"ForemastAnomaly_{m}"]
+        assert f"changes(foremastbrain:{gauge}_anomaly[5m]) > 0" == anom["expr"]
+        breach = by_name[f"ForemastUpperBreach_{m}"]
+        assert f"foremastbrain:{gauge}_upper" in breach["expr"]
+        assert 'label_replace' in breach["expr"]
+        assert "exported_namespace" in breach["expr"]
+        # engine replicas / restart staleness must not break the join
+        assert "max by (namespace, app)" in breach["expr"]
+        assert breach["for"] == "2m"
+    down = by_name["ForemastEngineDown"]
+    assert down["labels"]["severity"] == "critical"
+    assert "foremast_worker_tick_seconds_count" in down["expr"]
+    assert len(rules) == 2 * len(ALL_METRICS) + 1
 
 
 def test_brain_rules_pin_colon_spelling_for_every_published_metric():
     """The signature observability contract (`foremast-brain.yaml:109-122`,
     `metrics.js:15-23`): every metric the engine can publish gauges for
     must have a recording rule mapping the exported underscore name to the
-    reference's exact colon name, for all three suffixes."""
+    reference's exact colon name — INCLUDING the recorded-family prefix
+    (`foremastbrain:namespace_app_per_pod:<metric>_<suffix>`, the literal
+    series the reference browser queries) — for all three suffixes."""
     by_record = {r.record: r.expr for r in brain_rules()}
     for metric in ALL_METRICS:
         for suffix in BRAIN_GAUGE_SUFFIXES:
-            colon = f"foremastbrain:{metric}_{suffix}"
-            assert by_record[colon] == f"foremastbrain_{metric}_{suffix}"
+            colon = f"foremastbrain:namespace_app_per_pod:{metric}_{suffix}"
+            assert by_record[colon] == (
+                f"foremastbrain_namespace_app_per_pod_{metric}_{suffix}"
+            )
     assert set(BRAIN_GAUGE_SUFFIXES) == {"upper", "lower", "anomaly"}
+    # exact reference spelling spot-check (metrics.js:15)
+    assert (
+        "foremastbrain:namespace_app_per_pod:http_server_requests_error_5xx_upper"
+        in by_record
+    )
     # the exported (underscore) names are exactly what BrainGauges creates
+    # when publishing under the series name the verdict hook derives
     from prometheus_client import CollectorRegistry
 
     from foremast_tpu.observe.gauges import BrainGauges
@@ -99,7 +137,14 @@ def test_brain_rules_pin_colon_spelling_for_every_published_metric():
     reg = CollectorRegistry()
     g = BrainGauges(registry=reg)
     for metric in ALL_METRICS:
-        g.publish(metric, "ns", "app", upper=1.0, lower=0.0, anomaly_value=2.0)
+        g.publish(
+            f"namespace_app_per_pod:{metric}",
+            "ns",
+            "app",
+            upper=1.0,
+            lower=0.0,
+            anomaly_value=2.0,
+        )
     exported = {m.name for m in reg.collect()}
     for r in brain_rules():
         assert r.expr in exported
