@@ -195,6 +195,36 @@ def config4_lstm_ae(small: bool):
         train_seconds=round(train_s, 2),
     )
 
+    # the hybrid judgment's closed-form companion (models/residual_mvn.py):
+    # per-job HW fit + residual covariance over [S, F, Th], then causal
+    # continuation + Mahalanobis over the current windows
+    from foremast_tpu.models.residual_mvn import (
+        chi2_quantile,
+        fit_residual_mvn,
+        score_residual_mvn,
+    )
+
+    th = 256 if small else 1024
+    hist = jnp.asarray(
+        rng.normal(0.5, 0.1, size=(s, f, th)).astype(np.float32)
+    )
+    cur = jnp.asarray(rng.normal(0.5, 0.1, size=(s, f, t_len)).astype(np.float32))
+    t0 = time.perf_counter()
+    state = fit_residual_mvn(hist)
+    jax.block_until_ready(state.cov)
+    fit_s = time.perf_counter() - t0
+    cut = chi2_quantile(4.0, f)
+    dt = _bench(lambda st, c: score_residual_mvn(st, c, cut), state, cur)
+    _emit(
+        "4b-residual-mvn",
+        "windows_scored_per_sec",
+        s / dt,
+        "windows/s",
+        jobs=s,
+        hist_len=th,
+        fit_seconds=round(fit_s, 2),
+    )
+
 
 def config5_cluster_batch(small: bool):
     """BASELINE config 5: 10k services x 4 metrics x 30-min windows.

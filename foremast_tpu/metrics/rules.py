@@ -200,23 +200,36 @@ def alert_rules() -> list[dict]:
     prometheus to generate Alerts" (`types.go:190-191`). These close that
     loop, written against the colon-spelled series `brain_rules` records:
 
-      * ForemastAnomaly<metric>   — the sticky anomaly gauge changed
-        value in the last 5 m (a NEW anomaly event; the gauge holds the
-        last anomalous value forever, so `changes()` isolates events —
-        same semantics as the dashboard join, ui/join.py);
-      * ForemastUpperBreach<metric> — the measured per-pod series sits
-        above the model's upper band for 2 m (label_replace aligns the
-        gauge's exported_namespace with the recorded series' namespace);
+      * ForemastAnomaly_<metric>  — the sticky anomaly gauge changed
+        value or newly appeared in the last 5 m (an anomaly EVENT; the
+        gauge holds the last anomalous value forever, so changes() +
+        appearance isolate events — same semantics as the dashboard
+        join, ui/join.py);
+      * Foremast{Upper,Lower}Breach_<metric> — the measured per-app
+        series breaches the model band for 2 m, direction-aware: error/
+        latency/resource metrics page above the UPPER band, success/
+        traffic metrics (2xx, request count) page below the LOWER band
+        (label_replace aligns the gauge's exported_namespace with the
+        recorded series' namespace);
       * ForemastEngineDown        — no scoring engine is exporting
         self-telemetry at all.
     """
     rules: list[dict] = []
     for m in ALL_METRICS:
         gauge = brain_gauge_series(m)  # the series the engine publishes
+        anom = f"foremastbrain:{gauge}_anomaly"
         rules.append(
             {
                 "alert": f"ForemastAnomaly_{m}",
-                "expr": f"changes(foremastbrain:{gauge}_anomaly[5m]) > 0",
+                # the sticky gauge yields an event when its value CHANGES
+                # or when the series APPEARS (first-ever anomaly for this
+                # app — changes() alone is 0 on a newly-born series). A
+                # repeat anomaly at the exact same value inside one series
+                # lifetime is indistinguishable from stickiness — a
+                # limitation of the reference's gauge contract itself.
+                "expr": (
+                    f"changes({anom}[5m]) > 0 or ({anom} unless {anom} offset 5m)"
+                ),
                 "labels": {"severity": "warning"},
                 "annotations": {
                     "summary": (
@@ -228,16 +241,30 @@ def alert_rules() -> list[dict]:
                 },
             }
         )
+        # direction-aware band breach: error/latency/resource metrics page
+        # when ABOVE the upper band; success/traffic metrics page when
+        # BELOW the lower band (a 2xx/request-rate collapse is the outage
+        # signal for those; healthy-high traffic above the band is not).
+        low_is_bad = m in (
+            "http_server_requests_2xx",
+            "http_server_requests_count",
+        )
+        band = "lower" if low_is_bad else "upper"
+        cmp_op = "<" if low_is_bad else ">"
+        agg = "min" if low_is_bad else "max"
         rules.append(
             {
-                "alert": f"ForemastUpperBreach_{m}",
-                # max by(...) dedupes scrape-label variants of the gauge
-                # (engine restart keeps the old pod's series alive for the
-                # staleness window; group_left needs a unique right side)
+                "alert": (
+                    f"Foremast{'Lower' if low_is_bad else 'Upper'}Breach_{m}"
+                ),
+                # min/max by(...) dedupes scrape-label variants of the
+                # gauge (engine restart keeps the old pod's series alive
+                # for the staleness window; group_left needs a unique
+                # right side)
                 "expr": (
-                    f"{gauge} > on(namespace, app) group_left() "
-                    "max by (namespace, app) (label_replace("
-                    f'foremastbrain:{gauge}_upper, "namespace", "$1", '
+                    f"{gauge} {cmp_op} on(namespace, app) group_left() "
+                    f"{agg} by (namespace, app) (label_replace("
+                    f'foremastbrain:{gauge}_{band}, "namespace", "$1", '
                     '"exported_namespace", "(.*)"))'
                 ),
                 "for": "2m",
@@ -245,7 +272,8 @@ def alert_rules() -> list[dict]:
                 "annotations": {
                     "summary": (
                         m
-                        + " above the model's upper band for "
+                        + f" {'below' if low_is_bad else 'above'} the model's "
+                        + f"{band} band for "
                         + "{{ $labels.app }} in {{ $labels.namespace }}"
                     )
                 },

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -72,6 +73,7 @@ def chi2_quantile(threshold: float, dof: int) -> float:
     return float(stats.chi2.ppf(1.0 - p_tail, dof))
 
 
+@partial(jax.jit, static_argnames=("season_length", "min_points", "ridge"))
 def fit_residual_mvn(
     hist: jax.Array,
     mask: jax.Array | None = None,
@@ -111,6 +113,7 @@ def fit_residual_mvn(
     return MVNState(hw=fc, mu=mu, cov=cov, valid=valid)
 
 
+@partial(jax.jit, static_argnames=("season_length",))
 def score_residual_mvn(
     state: MVNState,
     cur: jax.Array,

@@ -94,13 +94,22 @@ def test_alert_rules_cover_every_metric_and_engine_liveness():
     for m in ALL_METRICS:
         gauge = f"namespace_app_per_pod:{m}"  # what the engine publishes
         anom = by_name[f"ForemastAnomaly_{m}"]
-        assert f"changes(foremastbrain:{gauge}_anomaly[5m]) > 0" == anom["expr"]
-        breach = by_name[f"ForemastUpperBreach_{m}"]
-        assert f"foremastbrain:{gauge}_upper" in breach["expr"]
+        a = f"foremastbrain:{gauge}_anomaly"
+        # value change OR first appearance both count as an anomaly event
+        assert f"changes({a}[5m]) > 0" in anom["expr"]
+        assert f"({a} unless {a} offset 5m)" in anom["expr"]
+        # direction-aware breach: traffic/success metrics page on a
+        # LOWER-band collapse, everything else on an upper-band breach
+        low_is_bad = m in ("http_server_requests_2xx", "http_server_requests_count")
+        side = "Lower" if low_is_bad else "Upper"
+        breach = by_name[f"Foremast{side}Breach_{m}"]
+        band = "lower" if low_is_bad else "upper"
+        assert f"foremastbrain:{gauge}_{band}" in breach["expr"]
+        assert (" < " if low_is_bad else " > ") in breach["expr"]
         assert 'label_replace' in breach["expr"]
         assert "exported_namespace" in breach["expr"]
         # engine replicas / restart staleness must not break the join
-        assert "max by (namespace, app)" in breach["expr"]
+        assert f"{'min' if low_is_bad else 'max'} by (namespace, app)" in breach["expr"]
         assert breach["for"] == "2m"
     down = by_name["ForemastEngineDown"]
     assert down["labels"]["severity"] == "critical"
