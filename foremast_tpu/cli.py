@@ -110,7 +110,11 @@ def cmd_score(args: argparse.Namespace) -> int:
 
     store = InMemoryStore()
     doc, _ = store.create(doc)
-    worker = BrainWorker(store, source, claim_limit=1)
+    # same env-var config surface as the worker loop (the reference brain
+    # is configured entirely through env, foremast-brain/README.md:20-38)
+    from foremast_tpu.config import BrainConfig
+
+    worker = BrainWorker(store, source, BrainConfig.from_env(), claim_limit=1)
 
     from foremast_tpu.jobs.models import (
         STATUS_COMPLETED_HEALTH,

@@ -23,18 +23,22 @@ import numpy as np
 
 from foremast_tpu.ops.anomaly import BOUND_BOTH, BOUND_LOWER, BOUND_UPPER
 
-# Pairwise algorithm selectors (`foremast-brain/README.md:34`).
+# Pairwise algorithm selectors (`foremast-brain/README.md:34`); FRIEDMAN
+# is the "Fried manchi square (special case)" of the reference's design
+# doc (`docs/guides/design.md:90-93`).
 PAIRWISE_ALL = "ALL"
 PAIRWISE_ANY = "ANY"
 PAIRWISE_MANN_WHITE = "MANN_WHITE"
 PAIRWISE_WILCOXON = "WILCOXON"
 PAIRWISE_KRUSKAL = "KRUSKAL"
+PAIRWISE_FRIEDMAN = "FRIEDMAN"
 PAIRWISE_CHOICES = (
     PAIRWISE_ALL,
     PAIRWISE_ANY,
     PAIRWISE_MANN_WHITE,
     PAIRWISE_WILCOXON,
     PAIRWISE_KRUSKAL,
+    PAIRWISE_FRIEDMAN,
 )
 
 _BOUND_NAMES = {
@@ -126,6 +130,8 @@ class PairwiseConfig:
     min_mann_white_points: int = 20
     min_wilcoxon_points: int = 20
     min_kruskal_points: int = 5
+    # no reference deployment pins a Friedman minimum; pairs like Wilcoxon
+    min_friedman_points: int = 20
 
     def __post_init__(self):
         if self.algorithm not in PAIRWISE_CHOICES:
@@ -139,6 +145,14 @@ class BrainConfig:
     algorithm: str = "moving_average_all"  # ML_ALGORITHM, yaml:24-25
     anomaly: AnomalyConfig = AnomalyConfig()
     pairwise: PairwiseConfig = PairwiseConfig()
+    # Season length, in time steps, for every seasonal model (fitted
+    # Holt-Winters, the trend+Fourier seasonal model, the residual-MVN's
+    # HW state, and the auto screen). The deployed default matches the
+    # reference's canonical workload: *daily* cycles at the 60 s PromQL
+    # step of the 7-day historical window (`metricsquery.go:43,75-77`)
+    # = 1440 steps. No reference env var exists (its HW season was an
+    # internal constant); ML_SEASON_STEPS is this framework's knob.
+    season_steps: int = 1440
     min_historical_points: int = 10  # MIN_HISTORICAL_DATA_POINT_TO_MEASURE README:23
     max_stuck_seconds: float = 90.0  # MAX_STUCK_IN_SECONDS, yaml:80-81
     max_cache_size: int = 1000  # MAX_CACHE_SIZE model cache, README:30
@@ -199,11 +213,13 @@ class BrainConfig:
             min_mann_white_points=get("MIN_MANN_WHITE_DATA_POINTS", 20),
             min_wilcoxon_points=get("MIN_WILCOXON_DATA_POINTS", 20),
             min_kruskal_points=get("MIN_KRUSKAL_DATA_POINTS", 5),
+            min_friedman_points=get("MIN_FRIEDMAN_DATA_POINTS", 20),
         )
         return BrainConfig(
             algorithm=get("ML_ALGORITHM", "moving_average_all"),
             anomaly=anomaly,
             pairwise=pairwise,
+            season_steps=get("ML_SEASON_STEPS", 1440),
             min_historical_points=get("MIN_HISTORICAL_DATA_POINT_TO_MEASURE", 10),
             max_stuck_seconds=get("MAX_STUCK_IN_SECONDS", 90.0),
             max_cache_size=get("MAX_CACHE_SIZE", 1000),

@@ -96,6 +96,48 @@ EXPENSIVE_FITS = frozenset(
 )
 
 
+# Fits whose horizon depends on trend or seasonal phase: only these need
+# the hist->cur gap advance (scoring._advance_gap). The gap is a provable
+# no-op for level-only models (moving averages, EWMA), so the judge skips
+# computing it there — the deployed default stays zero-overhead.
+GAP_SENSITIVE_FITS = frozenset(
+    {
+        "double_exponential_smoothing",
+        "holtwinters",
+        "holt_winters",
+        "auto_univariate",
+        "seasonal",
+        "prophet",
+        "seasonal_hourly",
+    }
+)
+
+
+def _gap_steps(tasks: Sequence[MetricTask]) -> np.ndarray:
+    """Per-task hist->cur gap in whole steps, [B] int32.
+
+    The fitted forecaster's phase assumes the current window starts ONE
+    step after the history's last point; re-check ticks drift later. The
+    step is inferred from the history's endpoints — O(1) per task; the
+    reference's windows are regular PromQL query_range grids
+    (`metricsquery.go:43`), where endpoint spacing IS the step. Tasks
+    without both windows gap 0."""
+    out = np.zeros(len(tasks), np.int32)
+    for i, t in enumerate(tasks):
+        ht = t.hist_times
+        ct = t.cur_times
+        if len(ht) == 0 or len(ct) == 0:
+            continue
+        step = (
+            (float(ht[-1]) - float(ht[0])) / (len(ht) - 1)
+            if len(ht) > 1
+            else 60.0
+        )
+        k = int(round((float(ct[0]) - float(ht[-1])) / max(step, 1.0)))
+        out[i] = max(k - 1, 0)
+    return out
+
+
 class HealthJudge:
     """Batched scorer with reference-parity config semantics.
 
@@ -153,8 +195,12 @@ class HealthJudge:
         of shapes.
         """
         cfg = self.config
+        # season_steps keys the cache too: season buffers of different
+        # lengths must never stack into one batch (and a reconfigured
+        # season invalidates every fitted seasonal state)
         keys = [
-            (cfg.algorithm, t.fit_key) if t.fit_key else None for t in tasks
+            (cfg.algorithm, cfg.season_steps, t.fit_key) if t.fit_key else None
+            for t in tasks
         ]
         entries = [self.fit_cache.get(k) if k else None for k in keys]
         miss = [i for i, e in enumerate(entries) if e is None]
@@ -166,7 +212,10 @@ class HealthJudge:
                 th,
             )
             fc = scoring.fit_forecast(
-                hist.values, hist.mask, algorithm=cfg.algorithm
+                hist.values,
+                hist.mask,
+                algorithm=cfg.algorithm,
+                season_length=cfg.season_steps,
             )
             n_hist = hist.count().astype(jnp.int32)
             level = np.asarray(fc.level)
@@ -187,21 +236,29 @@ class HealthJudge:
                 entries[i] = entry
                 if keys[i] is not None:
                     self.fit_cache.put(keys[i], entry)
+        # Season buffers may mix lengths within one batch: auto fits on a
+        # history shorter than two cycles return the mean model's [1] zero
+        # buffer (scoring.tile_season documents why tiling is exact).
         m = max(len(e[2]) for e in entries)
-        assert all(len(e[2]) == m for e in entries), "mixed season lengths"
         return scoring.score_from_state(
             batch,
             jnp.asarray([e[0] for e in entries], jnp.float32),
             jnp.asarray([e[1] for e in entries], jnp.float32),
-            jnp.asarray(np.stack([e[2] for e in entries])),
+            jnp.asarray(np.stack([scoring.tile_season(e[2], m) for e in entries])),
             jnp.asarray([e[3] for e in entries], jnp.int32),
             jnp.asarray([e[4] for e in entries], jnp.float32),
             jnp.asarray([e[5] for e in entries], jnp.int32),
+            gap_steps=(
+                jnp.asarray(_gap_steps(tasks))
+                if cfg.algorithm in GAP_SENSITIVE_FITS
+                else None
+            ),
             pairwise_algorithm=cfg.pairwise.algorithm,
             p_threshold=cfg.pairwise.threshold,
             min_mw=cfg.pairwise.min_mann_white_points,
             min_wilcoxon=cfg.pairwise.min_wilcoxon_points,
             min_kruskal=cfg.pairwise.min_kruskal_points,
+            min_friedman=cfg.pairwise.min_friedman_points,
         )
 
     def _judge_bucket(
@@ -251,12 +308,19 @@ class HealthJudge:
         else:
             res = scoring.score(
                 batch,
+                gap_steps=(
+                    jnp.asarray(_gap_steps(tasks))
+                    if cfg.algorithm in GAP_SENSITIVE_FITS
+                    else None
+                ),
                 algorithm=cfg.algorithm,
+                season_length=cfg.season_steps,
                 pairwise_algorithm=cfg.pairwise.algorithm,
                 p_threshold=cfg.pairwise.threshold,
                 min_mw=cfg.pairwise.min_mann_white_points,
                 min_wilcoxon=cfg.pairwise.min_wilcoxon_points,
                 min_kruskal=cfg.pairwise.min_kruskal_points,
+                min_friedman=cfg.pairwise.min_friedman_points,
             )
         verdicts = np.asarray(res.verdict)
         anoms = np.asarray(res.anomalies)

@@ -19,7 +19,7 @@ gauge bounds stay meaningful via marginal mean +/- threshold * sigma.
 Canary pairwise semantics (`docs/guides/design.md:31-33`,
 `foremast-brain/README.md:5-11`) apply to joint jobs exactly as to
 univariate ones: every metric's current window is tested against its
-baseline window (Mann-Whitney / Wilcoxon / Kruskal per
+baseline window (Mann-Whitney / Wilcoxon / Kruskal / Friedman per
 ML_PAIRWISE_ALGORITHM), and if ANY metric's distributions differ the
 job's joint detection threshold is lowered by
 `scoring.DIFF_THRESHOLD_FACTOR` — a suspicious canary gets tighter
@@ -148,8 +148,10 @@ def _pack(rows: list[np.ndarray], length: int) -> tuple[jnp.ndarray, jnp.ndarray
 def _coerce_entry(entry) -> tuple:
     """Normalize a cache entry to (AEParams, float, float, mvn | None).
 
-    `mvn` is the seasonal-residual Gaussian state as a plain tuple of host
-    arrays (level, trend, season, phase, resid_mu, cov, valid) — see
+    `mvn` is the seasonal-residual Gaussian state as a plain 9-tuple of
+    host values — (level [F], trend [F], season [F, m], phase [F],
+    resid_mu [F], cov [F, F], valid bool, hist_last_ts int, hist_len int);
+    the two trailing ints are the time anchor `_mvn_fresh` checks — see
     `_judge_lstm_group`. Orbax restores NamedTuple pytrees as plain dicts
     and tuples as lists (models/cache.py load); scoring stacks entries
     with jax.tree.map, so every entry must share exact structures. Legacy
@@ -323,6 +325,7 @@ class MultivariateJudge:
             min_mw=cfg.pairwise.min_mann_white_points,
             min_wilcoxon=cfg.pairwise.min_wilcoxon_points,
             min_kruskal=cfg.pairwise.min_kruskal_points,
+            min_friedman=cfg.pairwise.min_friedman_points,
         )
         p, differs = np.asarray(p), np.asarray(differs)
         out, i = [], 0
@@ -556,43 +559,21 @@ class MultivariateJudge:
         need_mvn = [
             j for j in joints if not _mvn_fresh(j, entries[id(j)][3])
         ]
-        if need_mvn:
-            thb = bucket_length(max(len(j.hist_t) for j in need_mvn))
-            hist = np.zeros((len(need_mvn), f, thb), np.float32)
-            hmask = np.zeros((len(need_mvn), thb), bool)
-            for i, j in enumerate(need_mvn):
-                nh = j.hist_v.shape[1]
-                hist[i, :, :nh] = j.hist_v
-                hmask[i, :nh] = True
-            st = fit_residual_mvn(jnp.asarray(hist), jnp.asarray(hmask))
-            n = len(need_mvn)
-            lv = np.asarray(st.hw.level, np.float32).reshape(n, f)
-            tr = np.asarray(st.hw.trend, np.float32).reshape(n, f)
-            se = np.asarray(st.hw.season, np.float32).reshape(n, f, -1)
-            ph = np.asarray(st.hw.season_phase, np.int32).reshape(n, f)
-            rmu = np.asarray(st.mu, np.float32)
-            cov = np.asarray(st.cov, np.float32)
-            va = np.asarray(st.valid)
-            for i, j in enumerate(need_mvn):
-                e = entries[id(j)]
-                entry = (
-                    e[0],
-                    e[1],
-                    e[2],
-                    (
-                        lv[i],
-                        tr[i],
-                        se[i],
-                        ph[i],
-                        rmu[i],
-                        cov[i],
-                        bool(va[i]),
-                        int(j.hist_t[-1]),
-                        len(j.hist_t),
-                    ),
-                )
-                entries[id(j)] = entry
-                self.cache.put(self._key(j, tc), entry)
+        # Partition by the 2-cycle identifiability rule BEFORE bucketing:
+        # fit_residual_mvn's season guard keys off the batch's STATIC
+        # length, so a 12-hour job bucket-padded next to a 3-day job would
+        # be fitted at the long batch's m and land an empty warm region
+        # (valid=False). Short jobs get their own m=1 (Holt) fit instead.
+        # The short partition is fitted at m=1 EXPLICITLY: its bucket can
+        # still round up past 2*season (a 1.5-day job pads to 4096 > 2880),
+        # which would defeat fit_residual_mvn's static-length guard.
+        season = self.config.season_steps
+        for need, m_part in (
+            ([j for j in need_mvn if len(j.hist_t) >= 2 * season], season),
+            ([j for j in need_mvn if len(j.hist_t) < 2 * season], 1),
+        ):
+            if need:
+                self._fit_mvn_batch(need, entries, f, tc, m_part)
 
         # score every joint job against its (possibly cached) model
         out: list[MetricVerdict] = []
@@ -626,9 +607,13 @@ class MultivariateJudge:
         mvns = [entries[id(j)][3] for j in joints]
         levels = np.stack([m[0] for m in mvns])  # [S, F]
         trends = np.stack([m[1] for m in mvns])
-        seasons = np.stack([m[2] for m in mvns])  # [S, F, m]
+        # entries may mix season widths (identifiability partitions fit
+        # short histories at m=1; scoring.tile_season documents exactness)
+        m_len = max(m[2].shape[-1] for m in mvns)
+        seasons = np.stack(
+            [scoring.tile_season(m[2], m_len) for m in mvns]
+        )  # [S, F, m]
         phases = np.stack([m[3] for m in mvns]).astype(np.int64)
-        m_len = seasons.shape[-1]
         # advance each job's HW state across the real history->current gap
         # (from timestamps) so the seasonal phase lines up with the window
         # being scored; the fitted phase assumes cur starts one step after
@@ -643,9 +628,12 @@ class MultivariateJudge:
             gap = max(k - 1, 0)
             # phase advances by the TRUE gap (mod m — clamping here would
             # corrupt the phase, e.g. 10*m ≡ 0); only the trend
-            # extrapolation is bounded against runaway level drift
+            # extrapolation is bounded against runaway level drift (same
+            # cap as the univariate scorer's _advance_gap)
             phases[i] = (phases[i] + gap) % m_len
-            levels[i] = levels[i] + trends[i] * min(gap, 10 * m_len)
+            levels[i] = levels[i] + trends[i] * min(
+                gap, scoring.GAP_TREND_CAP_STEPS
+            )
         hw = Forecast(
             pred=jnp.zeros((s_count * f, 0), jnp.float32),
             scale=jnp.zeros((s_count * f,), jnp.float32),
@@ -679,15 +667,67 @@ class MultivariateJudge:
             )
         return out
 
+    def _fit_mvn_batch(
+        self,
+        need: list[_JointJob],
+        entries: dict[int, tuple],
+        f: int,
+        tc: int,
+        season: int,
+    ) -> None:
+        """Fit the residual MVN for one identifiability partition and fold
+        the state into each job's cache entry (time-anchored)."""
+        thb = bucket_length(max(len(j.hist_t) for j in need))
+        hist = np.zeros((len(need), f, thb), np.float32)
+        hmask = np.zeros((len(need), thb), bool)
+        for i, j in enumerate(need):
+            nh = j.hist_v.shape[1]
+            hist[i, :, :nh] = j.hist_v
+            hmask[i, :nh] = True
+        st = fit_residual_mvn(
+            jnp.asarray(hist), jnp.asarray(hmask), season_length=season
+        )
+        n = len(need)
+        lv = np.asarray(st.hw.level, np.float32).reshape(n, f)
+        tr = np.asarray(st.hw.trend, np.float32).reshape(n, f)
+        se = np.asarray(st.hw.season, np.float32).reshape(n, f, -1)
+        ph = np.asarray(st.hw.season_phase, np.int32).reshape(n, f)
+        rmu = np.asarray(st.mu, np.float32)
+        cov = np.asarray(st.cov, np.float32)
+        va = np.asarray(st.valid)
+        for i, j in enumerate(need):
+            e = entries[id(j)]
+            entry = (
+                e[0],
+                e[1],
+                e[2],
+                (
+                    lv[i],
+                    tr[i],
+                    se[i],
+                    ph[i],
+                    rmu[i],
+                    cov[i],
+                    bool(va[i]),
+                    int(j.hist_t[-1]),
+                    len(j.hist_t),
+                ),
+            )
+            entries[id(j)] = entry
+            self.cache.put(self._key(j, tc), entry)
+
     def _key(self, j: _JointJob, tc: int) -> tuple:
-        # per (app, aliases, feature-count, window-bucket): job ids differ
-        # per run, but different SERVICES with the same standard alias set
-        # (the instrument starter emits identical names for every app)
-        # must never share a model
+        # per (app, aliases, feature-count, window-bucket, season): job ids
+        # differ per run, but different SERVICES with the same standard
+        # alias set (the instrument starter emits identical names for every
+        # app) must never share a model; season_steps keys the entry too —
+        # the cached MVN season buffer's length must match the configured
+        # season at score time
         return (
             "lstm",
             j.tasks[0].app,
             tuple(t.alias for t in j.tasks),
             j.hist_v.shape[0],
             tc,
+            self.config.season_steps,
         )

@@ -5,8 +5,8 @@ Reference semantics being reproduced (`foremast-brain/README.md:5-11`,
 `docs/guides/design.md:31-33`):
   1. compute the historical model from the 7-day window;
   2. for canary strategies, run pairwise same-distribution tests between
-     baseline and current (Mann-Whitney / Wilcoxon / Kruskal, combinable
-     via ML_PAIRWISE_ALGORITHM);
+     baseline and current (Mann-Whitney / Wilcoxon / Kruskal / Friedman,
+     combinable via ML_PAIRWISE_ALGORITHM);
   3. if the distributions differ, *lower the threshold*;
   4. threshold-based anomaly detection of current points against the
      historical model's bounds (per-metric-type threshold/bound matrix,
@@ -29,10 +29,12 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from foremast_tpu.config import (
     PAIRWISE_ALL,
     PAIRWISE_ANY,
+    PAIRWISE_FRIEDMAN,
     PAIRWISE_KRUSKAL,
     PAIRWISE_MANN_WHITE,
     PAIRWISE_WILCOXON,
@@ -49,7 +51,12 @@ from foremast_tpu.ops.forecasters import (
     moving_average,
     moving_average_all,
 )
-from foremast_tpu.ops.ranks import kruskal_wallis, mann_whitney_u, wilcoxon_signed_rank
+from foremast_tpu.ops.ranks import (
+    friedman_chi_square,
+    kruskal_wallis,
+    mann_whitney_u,
+    wilcoxon_signed_rank,
+)
 from foremast_tpu.ops.windows import MetricWindows
 
 # Verdict codes (map onto the ES status machine, converter.go:13-26:
@@ -80,6 +87,30 @@ AI_MODEL = {
 def register_model(name: str, fit_fn) -> None:
     """Extend the registry (used by models/ for seasonal + learned models)."""
     AI_MODEL[name] = fit_fn
+
+
+# Registry entries that take a season/period dimension, with the keyword
+# each expects — the engine threads one configured value (ML_SEASON_STEPS,
+# config.BrainConfig.season_steps) through all of them.
+_SEASON_KWARG = {
+    "holtwinters": "season_length",
+    "holt_winters": "season_length",
+    "auto_univariate": "season_length",
+    "seasonal": "period",
+    "prophet": "period",
+}
+
+
+def _fit_model(algorithm: str, values, mask, season_length: int):
+    fit = AI_MODEL.get(algorithm)
+    if fit is None:
+        # models/ registers its detectors (seasonal/prophet/...) on import;
+        # resolve lazily so the registry works without callers importing it
+        import foremast_tpu.models  # noqa: F401
+
+        fit = AI_MODEL[algorithm]
+    kw = _SEASON_KWARG.get(algorithm)
+    return fit(values, mask, **({kw: season_length} if kw else {}))
 
 
 @jax.tree_util.register_dataclass
@@ -133,6 +164,7 @@ def pairwise_decision(
     min_mw: int,
     min_wilcoxon: int,
     min_kruskal: int,
+    min_friedman: int = 20,
 ) -> tuple[jax.Array, jax.Array]:
     """Combined same-distribution decision, [B] (p_combined, differs).
 
@@ -145,10 +177,12 @@ def pairwise_decision(
     _, p_mw, ok_mw = mann_whitney_u(x, xm, y, ym, min_points=min_mw)
     _, p_wx, ok_wx = wilcoxon_signed_rank(x, xm, y, ym, min_points=min_wilcoxon)
     _, p_kw, ok_kw = kruskal_wallis(x, xm, y, ym, min_points=min_kruskal)
+    _, p_fr, ok_fr = friedman_chi_square(x, xm, y, ym, min_points=min_friedman)
 
     rej_mw = ok_mw & (p_mw < p_threshold)
     rej_wx = ok_wx & (p_wx < p_threshold)
     rej_kw = ok_kw & (p_kw < p_threshold)
+    rej_fr = ok_fr & (p_fr < p_threshold)
 
     if algorithm == PAIRWISE_MANN_WHITE:
         differs, p = rej_mw, p_mw
@@ -156,13 +190,20 @@ def pairwise_decision(
         differs, p = rej_wx, p_wx
     elif algorithm == PAIRWISE_KRUSKAL:
         differs, p = rej_kw, p_kw
+    elif algorithm == PAIRWISE_FRIEDMAN:
+        differs, p = rej_fr, p_fr
     elif algorithm == PAIRWISE_ANY:
-        differs = rej_mw | rej_wx | rej_kw
-        p = jnp.minimum(jnp.minimum(p_mw, p_wx), p_kw)
+        differs = rej_mw | rej_wx | rej_kw | rej_fr
+        p = jnp.minimum(
+            jnp.minimum(jnp.minimum(p_mw, p_wx), p_kw), p_fr
+        )
     elif algorithm == PAIRWISE_ALL:
-        any_ok = ok_mw | ok_wx | ok_kw
+        any_ok = ok_mw | ok_wx | ok_kw | ok_fr
         all_rej = (
-            (rej_mw | ~ok_mw) & (rej_wx | ~ok_wx) & (rej_kw | ~ok_kw)
+            (rej_mw | ~ok_mw)
+            & (rej_wx | ~ok_wx)
+            & (rej_kw | ~ok_kw)
+            & (rej_fr | ~ok_fr)
         )
         differs = any_ok & all_rej
         # max over *applicable* tests only: gated-out tests have p forced to
@@ -171,7 +212,9 @@ def pairwise_decision(
             jnp.maximum(
                 jnp.where(ok_mw, p_mw, 0.0), jnp.where(ok_wx, p_wx, 0.0)
             ),
-            jnp.where(ok_kw, p_kw, 0.0),
+            jnp.maximum(
+                jnp.where(ok_kw, p_kw, 0.0), jnp.where(ok_fr, p_fr, 0.0)
+            ),
         )
         p = jnp.where(any_ok, p, 1.0)
     else:  # pragma: no cover - config validates
@@ -189,6 +232,7 @@ pairwise = partial(
         "min_mw",
         "min_wilcoxon",
         "min_kruskal",
+        "min_friedman",
     ),
 )(pairwise_decision)
 
@@ -199,13 +243,63 @@ pairwise = partial(
 DIFF_THRESHOLD_FACTOR = 0.5
 
 
+def tile_season(s: np.ndarray, m: int) -> np.ndarray:
+    """Tile a host-side season buffer's last axis from length l to m.
+
+    Exact whenever l | m: the tiled buffer satisfies tiled[i] = s[i mod l],
+    which commutes with every (phase + k) mod m lookup downstream — so
+    non-seasonal [..., 1] zero buffers (and m=1 Holt fits) stack next to
+    full-season ones in a single batch. Shared by the univariate fit-cache
+    scorer and the multivariate MVN scorer."""
+    ell = s.shape[-1]
+    if ell == m:
+        return s
+    assert m % ell == 0, f"incompatible season lengths {ell} vs {m}"
+    return np.tile(s, (1,) * (s.ndim - 1) + (m // ell,))
+
+
+# Trend extrapolation across a hist->cur gap is capped at one day of
+# steps (60 s step): a pathologically stale fit + huge gap must not run a
+# linear trend off to infinity. Deliberately independent of the season
+# length — non-seasonal models carry a [B, 1] season buffer, and a cap of
+# 10*m would collapse to 10 steps for exactly the trended models that
+# need the advance. Shared with the residual-MVN host path.
+GAP_TREND_CAP_STEPS = 1440
+
+
+def _advance_gap(fc: Forecast, gap_steps: jax.Array | None) -> Forecast:
+    """Advance terminal forecaster state across the real hist->cur gap.
+
+    The fitted phase assumes the scored window starts one step after the
+    history's last point; a drifted re-check tick (the fit-cache headline
+    path) or a lagged fetch starts later. The seasonal phase advances by
+    the TRUE gap mod m (clamping would corrupt the phase — 10*m ≡ 0);
+    only the trend extrapolation is bounded against runaway level drift
+    (GAP_TREND_CAP_STEPS), mirroring the residual-MVN path
+    (multivariate._judge_lstm_group). Trendless, seasonless models (the
+    deployed moving_average_all default) are bit-for-bit unaffected."""
+    if gap_steps is None:
+        return fc
+    m = fc.season.shape[-1]
+    gap = gap_steps.astype(jnp.int32)
+    return dataclasses.replace(
+        fc,
+        season_phase=((fc.season_phase + gap) % m).astype(jnp.int32),
+        level=fc.level
+        + fc.trend
+        * jnp.minimum(gap, GAP_TREND_CAP_STEPS).astype(fc.level.dtype),
+    )
+
+
 _STATIC = (
     "algorithm",
+    "season_length",
     "pairwise_algorithm",
     "p_threshold",
     "min_mw",
     "min_wilcoxon",
     "min_kruskal",
+    "min_friedman",
 )
 
 
@@ -219,6 +313,7 @@ def _judgment_tail(
     min_mw: int,
     min_wilcoxon: int,
     min_kruskal: int,
+    min_friedman: int = 20,
 ) -> ScoreResult:
     """Everything after the model fit: pairwise -> threshold lowering ->
     bounds -> flags -> measurability gate -> verdict. Shared by the XLA
@@ -233,6 +328,7 @@ def _judgment_tail(
         min_mw,
         min_wilcoxon,
         min_kruskal,
+        min_friedman,
     )
     eff_threshold = jnp.where(
         differs, batch.threshold * DIFF_THRESHOLD_FACTOR, batch.threshold
@@ -269,6 +365,7 @@ judgment_tail = partial(
         "min_mw",
         "min_wilcoxon",
         "min_kruskal",
+        "min_friedman",
     ),
 )(_judgment_tail)
 
@@ -276,25 +373,21 @@ judgment_tail = partial(
 @partial(jax.jit, static_argnames=_STATIC)
 def _score_xla(
     batch: ScoreBatch,
+    gap_steps: jax.Array | None = None,
     algorithm: str = "moving_average_all",
+    season_length: int = 24,
     pairwise_algorithm: str = PAIRWISE_ALL,
     p_threshold: float = 0.05,
     min_mw: int = 20,
     min_wilcoxon: int = 20,
     min_kruskal: int = 5,
+    min_friedman: int = 20,
 ) -> ScoreResult:
     """The pure-XLA scoring program (partitions under GSPMD for the
     sharded path — no custom calls, so the mesh slices it freely)."""
     hist = batch.historical
-
-    fit = AI_MODEL.get(algorithm)
-    if fit is None:
-        # models/ registers its detectors (seasonal/prophet/...) on import;
-        # resolve lazily so the registry works without callers importing it
-        import foremast_tpu.models  # noqa: F401
-
-        fit = AI_MODEL[algorithm]
-    fc: Forecast = fit(hist.values, hist.mask)
+    fc: Forecast = _fit_model(algorithm, hist.values, hist.mask, season_length)
+    fc = _advance_gap(fc, gap_steps)
     pred = horizon(fc, batch.current.length)  # [B, Tc] forecast
 
     return _judgment_tail(
@@ -307,22 +400,28 @@ def _score_xla(
         min_mw,
         min_wilcoxon,
         min_kruskal,
+        min_friedman,
     )
 
 
 @partial(jax.jit, static_argnames=_STATIC)
 def _score_pallas(
     batch: ScoreBatch,
+    gap_steps: jax.Array | None = None,
     algorithm: str = "moving_average_all",
+    season_length: int = 24,
     pairwise_algorithm: str = PAIRWISE_ALL,
     p_threshold: float = 0.05,
     min_mw: int = 20,
     min_wilcoxon: int = 20,
     min_kruskal: int = 5,
+    min_friedman: int = 20,
 ) -> ScoreResult:
     """Fused-kernel path: pairwise stays XLA; the moving_average_all
     judgment runs as one pallas_call (ops/kernels.py)."""
-    del algorithm  # dispatcher guarantees moving_average_all
+    # dispatcher guarantees moving_average_all, whose forecast is the
+    # global mean — trendless and seasonless, so the gap is a no-op too
+    del algorithm, season_length, gap_steps
     cur = batch.current
     p, differs = pairwise_decision(
         cur,
@@ -332,6 +431,7 @@ def _score_pallas(
         min_mw,
         min_wilcoxon,
         min_kruskal,
+        min_friedman,
     )
     eff_threshold = jnp.where(
         differs, batch.threshold * DIFF_THRESHOLD_FACTOR, batch.threshold
@@ -356,20 +456,18 @@ def _score_pallas(
     )
 
 
-@partial(jax.jit, static_argnames=("algorithm",))
+@partial(jax.jit, static_argnames=("algorithm", "season_length"))
 def fit_forecast(
-    values: jax.Array, mask: jax.Array, algorithm: str = "moving_average_all"
+    values: jax.Array,
+    mask: jax.Array,
+    algorithm: str = "moving_average_all",
+    season_length: int = 24,
 ) -> Forecast:
     """Fit the historical model alone (no judgment) — the program behind
     the univariate fit cache: a re-check tick whose history is unchanged
     skips this and replays the cached terminal state through
     `score_from_state`."""
-    fit = AI_MODEL.get(algorithm)
-    if fit is None:
-        import foremast_tpu.models  # noqa: F401
-
-        fit = AI_MODEL[algorithm]
-    return fit(values, mask)
+    return _fit_model(algorithm, values, mask, season_length)
 
 
 @partial(
@@ -390,11 +488,13 @@ def score_from_state(
     season_phase: jax.Array,
     scale: jax.Array,
     n_hist: jax.Array,
+    gap_steps: jax.Array | None = None,
     pairwise_algorithm: str = PAIRWISE_ALL,
     p_threshold: float = 0.05,
     min_mw: int = 20,
     min_wilcoxon: int = 20,
     min_kruskal: int = 5,
+    min_friedman: int = 20,
 ) -> ScoreResult:
     """Judgment from fitted forecaster terminal state (no history scan).
 
@@ -402,7 +502,8 @@ def score_from_state(
     consumed by the judgment — only `horizon` extrapolation from terminal
     (level, trend, season, phase), the residual `scale`, and the history
     point count feed `_judgment_tail` — so a cached fit reproduces the
-    fresh-fit verdict bit for bit."""
+    fresh-fit verdict bit for bit (including the `gap_steps` phase/level
+    advance, applied identically in both programs)."""
     fc = Forecast(
         pred=jnp.zeros((level.shape[0], 0), level.dtype),
         scale=scale,
@@ -411,6 +512,7 @@ def score_from_state(
         season=season,
         season_phase=season_phase,
     )
+    fc = _advance_gap(fc, gap_steps)
     pred = horizon(fc, batch.current.length)
     return _judgment_tail(
         batch,
@@ -422,6 +524,7 @@ def score_from_state(
         min_mw,
         min_wilcoxon,
         min_kruskal,
+        min_friedman,
     )
 
 

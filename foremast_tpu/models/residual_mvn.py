@@ -86,17 +86,28 @@ def fit_residual_mvn(
     hist: [B, F, Th] aligned joint histories (joint observations are
     intersected upstream, `multivariate._align`, so every metric of a job
     shares one validity pattern); mask: [B, Th] valid-prefix mask for
-    bucket-padded batches (None = all valid)."""
+    bucket-padded batches (None = all valid).
+
+    Identifiability guard (the same 2-cycle rule as `fit_holt_winters`
+    and the auto screen): a batch whose static length holds fewer than
+    two full seasons fits with season length 1 instead — the HW
+    degenerates to Holt's linear method, residuals stay causal and the
+    covariance still learns co-movement; only the (unidentifiable) cycle
+    is dropped. Without this, a daily-configured engine (m=1440) would
+    either disable the MVN outright on sub-2-day histories (empty warm
+    region -> valid=False) or score against a season memorized from one
+    partial cycle."""
     b, f, th = hist.shape
     a, bt, g = HW_PARAMS
+    m_eff = int(season_length) if th >= 2 * int(season_length) else 1
     if mask is None:
         mask = jnp.ones((b, th), bool)
     flat = hist.reshape(b * f, th)
     mflat = jnp.repeat(mask, f, axis=0)
-    fc = holt_winters(flat, mflat, season_length, a, bt, g)
+    fc = holt_winters(flat, mflat, m_eff, a, bt, g)
     resid = (flat - fc.pred).reshape(b, f, th)
     # drop the first season: those predictions come from init state
-    warm_mask = mask & (jnp.arange(th)[None, :] >= season_length)  # [B, Th]
+    warm_mask = mask & (jnp.arange(th)[None, :] >= m_eff)  # [B, Th]
     n = jnp.maximum(jnp.sum(warm_mask, axis=-1), 1)  # [B]
     w = warm_mask[:, None, :].astype(resid.dtype)  # [B, 1, Th]
     mu = jnp.sum(resid * w, axis=-1) / n[:, None]  # [B, F]
@@ -113,23 +124,31 @@ def fit_residual_mvn(
     return MVNState(hw=fc, mu=mu, cov=cov, valid=valid)
 
 
-@partial(jax.jit, static_argnames=("season_length",))
+@jax.jit
 def score_residual_mvn(
     state: MVNState,
     cur: jax.Array,
     d2_cutoff: jax.Array | float,
-    season_length: int = SEASON_LENGTH,
 ) -> jax.Array:
     """Anomaly flags [B, Tc] for aligned joint current windows [B, F, Tc].
 
     Causal HW residual per metric -> Mahalanobis d^2 against the
     historical residual Gaussian -> flag where d^2 exceeds the calibrated
-    cutoff (see `chi2_quantile`). Invalid fits flag nothing."""
+    cutoff (see `chi2_quantile`). Invalid fits flag nothing. The season
+    length is the STATE's own (its buffer width): a short-history fit
+    that degenerated to m=1 (see `fit_residual_mvn`) must be continued
+    at m=1, not zeroed against the configured length."""
     b, f, tc = cur.shape
     a, bt, g = HW_PARAMS
     flat = cur.reshape(b * f, tc)
     pred, _ = hw_continue(
-        state.hw, flat, jnp.ones(flat.shape, bool), season_length, a, bt, g
+        state.hw,
+        flat,
+        jnp.ones(flat.shape, bool),
+        state.hw.season.shape[-1],
+        a,
+        bt,
+        g,
     )
     resid = (flat - pred).reshape(b, f, tc)
     d = resid - state.mu[:, :, None]  # [B, F, Tc]

@@ -52,8 +52,23 @@ def fit_seasonal(
 
     `period` in time steps (1440 = daily seasonality at the reference's
     60 s PromQL step, `metricsquery.go:43`); `order` harmonics.
+
+    Histories shorter than two full periods are seasonally
+    unidentifiable — the harmonics are near-collinear with the trend
+    over a partial cycle, so the "fitted" cycle would be noise that the
+    horizon then extrapolates; such SERIES get the global-mean model
+    (same two-layer rule as `fit_holt_winters`: a static early-out for
+    all-short batches plus a per-series select for short real histories
+    riding a long padded bucket).
     """
+    from foremast_tpu.ops.forecasters import (
+        _guard_unidentifiable,
+        moving_average_all,
+    )
+
     b, t_len = values.shape
+    if t_len < 2 * int(period):
+        return moving_average_all(values, mask)
     dtype = values.dtype
     x = _design(jnp.arange(t_len), period, order, dtype)  # [T, K]
     k = x.shape[-1]
@@ -82,7 +97,7 @@ def fit_seasonal(
     level = w[:, 0] + w[:, 1] * last_valid.astype(dtype)  # trend at last step
     trend = w[:, 1]
     seas_f = jnp.einsum("pk,bk->bp", xf[:, 2:], w[:, 2:])  # [B, P]
-    return Forecast(
+    fc = Forecast(
         pred=pred,
         scale=scale,
         level=level,
@@ -90,3 +105,4 @@ def fit_seasonal(
         season=seas_f,
         season_phase=((last_valid + 1) % period).astype(jnp.int32),
     )
+    return _guard_unidentifiable(fc, values, mask, int(period))

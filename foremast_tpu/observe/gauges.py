@@ -82,8 +82,10 @@ class BrainGauges:
 # base series name of a BARE-selector PromQL query, e.g.
 # `query=namespace_app_per_pod:http_server_requests_latency{...}`. The
 # lookahead rejects wrapped expressions (`query=sum(rate(...))` must NOT
-# name a gauge "sum" — such jobs fall back to the alias).
-_SERIES_RE = re.compile(r"query=([a-zA-Z_:][a-zA-Z0-9_:]*)(?=\{|&|$)")
+# name a gauge "sum" — such jobs fall back to the alias), and the
+# leading anchor requires a real parameter boundary (a REST-supplied URL
+# with `subquery=foo` must not derive a gauge name from it).
+_SERIES_RE = re.compile(r"(?:^|[?&])query=([a-zA-Z_:][a-zA-Z0-9_:]*)(?=\{|&|$)")
 
 
 def _series_names(config: str) -> dict[str, str]:
@@ -91,8 +93,11 @@ def _series_names(config: str) -> dict[str, str]:
 
     Uses the canonical config-string codec (`metrics.promql.decode_config`
     — the same strings the brain fetches) and extracts the series from
-    each URL; aliases whose query is not a bare selector are omitted (the
-    caller falls back to the alias)."""
+    each URL; aliases whose query is not a bare selector are omitted, and
+    so are aliases whose queries resolve to the SAME base series (two
+    colliding aliases publishing one gauge family would silently
+    last-write-win each other's verdicts) — in both cases the caller
+    falls back to the alias-named gauge."""
     import urllib.parse
 
     from foremast_tpu.metrics.promql import decode_config
@@ -102,7 +107,10 @@ def _series_names(config: str) -> dict[str, str]:
         m = _SERIES_RE.search(urllib.parse.unquote(url))
         if m:
             out[alias] = m.group(1)
-    return out
+    counts: dict[str, int] = {}
+    for series in out.values():
+        counts[series] = counts.get(series, 0) + 1
+    return {a: s for a, s in out.items() if counts[s] == 1}
 
 
 def make_verdict_hook(gauges: BrainGauges, namespace: str | None = None):

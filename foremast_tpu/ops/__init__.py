@@ -20,6 +20,7 @@ from foremast_tpu.ops.ranks import (
     mann_whitney_u,
     wilcoxon_signed_rank,
     kruskal_wallis,
+    friedman_chi_square,
 )
 from foremast_tpu.ops.anomaly import (
     BOUND_UPPER,
@@ -47,6 +48,7 @@ __all__ = [
     "mann_whitney_u",
     "wilcoxon_signed_rank",
     "kruskal_wallis",
+    "friedman_chi_square",
     "BOUND_UPPER",
     "BOUND_LOWER",
     "BOUND_BOTH",

@@ -235,19 +235,16 @@ def double_exponential(
 # ---------------------------------------------------------------------------
 
 
-def holt_winters(
-    values: jax.Array,
-    mask: jax.Array,
-    season_length: int = 24,
-    alpha: float = 0.3,
-    beta: float = 0.05,
-    gamma: float = 0.1,
-) -> Forecast:
-    """Additive Holt-Winters, batched, scanning over whole *seasons*.
+# Season lengths up to this are run with all m phase updates unrolled in
+# the scan body (fastest small-m shape, measured below); longer seasons
+# (daily m=1440 at the reference's 60 s step) take the rolled path whose
+# compiled program is O(1) in m — unrolling 1440 phases emits O(T) HLO
+# and explodes compile time.
+_HW_UNROLL_MAX = 64
 
-    Season indexing uses the absolute time-step index modulo m (windows are
-    regularly sampled — 60 s PromQL step in the reference,
-    `metricsquery.go:43` — so gaps keep their phase).
+
+def _hw_season_blocked(values, mask, m_len, alpha, beta, gamma, init_level, init_season):
+    """Small-m Holt-Winters body: scan over whole seasons, phases unrolled.
 
     TPU shape choice: the scan iterates over T/m seasons with the m phase
     updates unrolled inside the body, and the seasonal state carried as a
@@ -263,30 +260,8 @@ def holt_winters(
     grid selection + full-res final per-series pass at 29-55k. The fused
     season body wins because fit time tracks the sequential substep chain
     almost exclusively.)
-
-    `alpha`/`beta`/`gamma` may be scalars or per-series [B] arrays.
-
-    Initialization: level <- mean of the first season's valid points,
-    seasonal offsets <- first-season residuals vs that mean.
     """
-    m_len = int(season_length)
     b, t_len = values.shape
-    dtype = values.dtype
-    alpha = jnp.asarray(alpha, dtype)
-    beta = jnp.asarray(beta, dtype)
-    gamma = jnp.asarray(gamma, dtype)
-
-    first_season_mask = mask & (jnp.arange(t_len)[None, :] < m_len)
-    init_level = masked_mean(values, first_season_mask)  # [B]
-    # seasonal init: first-season residuals (0 where that slot was invalid)
-    pad = m_len - min(m_len, t_len)
-    fs_vals = values[:, :m_len]
-    fs_mask = first_season_mask[:, :m_len]
-    if pad:
-        fs_vals = jnp.pad(fs_vals, ((0, 0), (0, pad)))
-        fs_mask = jnp.pad(fs_mask, ((0, 0), (0, pad)))
-    init_season = jnp.where(fs_mask, fs_vals - init_level[:, None], 0.0)
-
     # pad the series to whole seasons; padded steps are masked, so state
     # carries through them unchanged and their preds are sliced away
     n_seasons = -(-t_len // m_len)
@@ -318,7 +293,7 @@ def holt_winters(
 
     init = (
         init_level,
-        jnp.zeros((b,), dtype),
+        jnp.zeros((b,), values.dtype),
         tuple(init_season[:, p] for p in range(m_len)),
         jnp.zeros((b,), bool),
     )
@@ -326,6 +301,98 @@ def holt_winters(
     pred = preds.reshape(n_seasons * m_len, -1).T[..., :t_len]
     pred = pred.reshape(values.shape)
     season = jnp.stack(season_t, axis=-1)  # [B, m]
+    return pred, level, trend, season
+
+
+def _hw_rolled(values, mask, m_len, alpha, beta, gamma, init_level, init_season):
+    """Long-season Holt-Winters body: one scan step per time step with the
+    seasonal state as a [m, B] carry indexed by a *dynamic* phase.
+
+    The phase p = t mod m is shared by the whole batch (season indexing is
+    by absolute time-step index), so the per-step seasonal access is a
+    single dynamic row slice + in-place row write — O(B) traffic per step
+    and O(1) HLO in m, which is what makes daily cycles (m=1440,
+    `metricsquery.go:43` 60 s step over the 7-day window) compile at all.
+    The recurrence is bit-identical to the season-blocked body.
+    """
+    b, t_len = values.shape
+    phases = jnp.arange(t_len, dtype=jnp.int32) % m_len
+
+    def step(carry, xs):
+        level, trend, season, inited = carry  # season: [m, B]
+        x, msk, p = xs
+        s_t = jax.lax.dynamic_slice_in_dim(season, p, 1, axis=0)[0]  # [B]
+        pred = level + trend + s_t
+        new_level = alpha * (x - s_t) + (1.0 - alpha) * (level + trend)
+        new_trend = beta * (new_level - level) + (1.0 - beta) * trend
+        new_s = gamma * (x - new_level) + (1.0 - gamma) * s_t
+        upd = msk & inited
+        row = jnp.where(upd, new_s, s_t)
+        season = jax.lax.dynamic_update_slice_in_dim(season, row[None], p, axis=0)
+        level = jnp.where(upd, new_level, level)
+        trend = jnp.where(upd, new_trend, trend)
+        pred = jnp.where(inited, pred, x)
+        return (level, trend, season, inited | msk), pred
+
+    init = (
+        init_level,
+        jnp.zeros((b,), values.dtype),
+        init_season.T,  # [m, B]
+        jnp.zeros((b,), bool),
+    )
+    (level, trend, season, _), preds = jax.lax.scan(
+        step, init, (values.T, mask.T, phases)
+    )
+    return preds.T, level, trend, season.T
+
+
+def holt_winters(
+    values: jax.Array,
+    mask: jax.Array,
+    season_length: int = 24,
+    alpha: float = 0.3,
+    beta: float = 0.05,
+    gamma: float = 0.1,
+) -> Forecast:
+    """Additive Holt-Winters, batched.
+
+    Season indexing uses the absolute time-step index modulo m (windows are
+    regularly sampled — 60 s PromQL step in the reference,
+    `metricsquery.go:43` — so gaps keep their phase).
+
+    Two compile shapes for one recurrence: season lengths up to
+    `_HW_UNROLL_MAX` scan over whole seasons with the m phase updates
+    unrolled (`_hw_season_blocked`); longer seasons — the reference's
+    canonical *daily* cycle is m=1440 at the 60 s step — take the rolled
+    per-step scan (`_hw_rolled`), whose program size is independent of m.
+
+    `alpha`/`beta`/`gamma` may be scalars or per-series [B] arrays.
+
+    Initialization: level <- mean of the first season's valid points,
+    seasonal offsets <- first-season residuals vs that mean.
+    """
+    m_len = int(season_length)
+    b, t_len = values.shape
+    dtype = values.dtype
+    alpha = jnp.asarray(alpha, dtype)
+    beta = jnp.asarray(beta, dtype)
+    gamma = jnp.asarray(gamma, dtype)
+
+    first_season_mask = mask & (jnp.arange(t_len)[None, :] < m_len)
+    init_level = masked_mean(values, first_season_mask)  # [B]
+    # seasonal init: first-season residuals (0 where that slot was invalid)
+    pad = m_len - min(m_len, t_len)
+    fs_vals = values[:, :m_len]
+    fs_mask = first_season_mask[:, :m_len]
+    if pad:
+        fs_vals = jnp.pad(fs_vals, ((0, 0), (0, pad)))
+        fs_mask = jnp.pad(fs_mask, ((0, 0), (0, pad)))
+    init_season = jnp.where(fs_mask, fs_vals - init_level[:, None], 0.0)
+
+    body = _hw_season_blocked if m_len <= _HW_UNROLL_MAX else _hw_rolled
+    pred, level, trend, season = body(
+        values, mask, m_len, alpha, beta, gamma, init_level, init_season
+    )
     # horizon continues right after each series' LAST VALID point: phase
     # from the last valid absolute index (consistent with the in-fit
     # "gaps keep their phase" indexing), not the bucket-padded array
@@ -338,6 +405,32 @@ def holt_winters(
     return _finalize(
         pred, values, mask, level=level, trend=trend, season=season, season_phase=phase_next
     )
+
+
+def _guard_unidentifiable(fc: Forecast, values, mask, m_len: int) -> Forecast:
+    """Per-series 2-cycle identifiability select.
+
+    The static guards in the fit entries key off the (bucket-padded)
+    batch length; a series with fewer than two cycles of REAL points can
+    ride a long bucket past them and get a memorized noise season. This
+    select keeps the global-mean model for exactly those series — the
+    dynamic companion to the static early-outs."""
+    enough = jnp.sum(mask, axis=-1) >= 2 * m_len  # [B]
+    ma = moving_average_all(values, mask)
+    ma = Forecast(
+        pred=ma.pred,
+        scale=ma.scale,
+        level=ma.level,
+        trend=ma.trend,
+        season=jnp.zeros_like(fc.season),
+        season_phase=fc.season_phase,
+    )
+
+    def sel(a_leaf, b_leaf):
+        keep = enough.reshape((-1,) + (1,) * (a_leaf.ndim - 1))
+        return jnp.where(keep, a_leaf, b_leaf)
+
+    return jax.tree_util.tree_map(sel, fc, ma)
 
 
 # auto_univariate: a series must beat the global-mean model's in-sample
@@ -354,30 +447,54 @@ def fit_auto_univariate(
     """Structure-screened model selection, per series.
 
     The deployed default `moving_average_all` is blind to seasonality and
-    trend (its band must widen to cover the cycle), while a fitted
-    Holt-Winters on a genuinely flat series merely soaks up noise. This
-    fit runs both and picks per series: the structured model wins only
-    where it explains at least half the global-mean model's in-sample
-    variance (AUTO_SSE_RATIO) — flat series keep the mean model, seasonal
-    and trending series route to the fitted Holt-Winters. One jitted
-    program; the screen is two masked SSE reductions on fits already
-    computed."""
+    trend (its band must widen to cover the cycle), while a flexible fit
+    on a genuinely flat series merely soaks up noise. This fit runs three
+    candidates — the global mean, a fitted Holt-Winters(m), and the
+    trend+Fourier seasonal model (models/seasonal.py, period=m) — and
+    picks per series: a structured model wins only where it explains at
+    least half the mean model's variance (AUTO_SSE_RATIO); between the
+    two structured fits the lower SSE wins. Pooling phases through the
+    Fourier basis is what carries LONG cycles (m=1440 daily at the 60 s
+    step sees only ~7 seasons in the 7-day window — per-phase HW state is
+    7-sample noisy, Fourier pools all 10k points into a few harmonics).
+
+    The screen is scored on the *warm* region only (absolute index >= m):
+    Holt-Winters' first season has near-zero residuals by construction
+    (seasonal state is initialized from those very residuals), which would
+    bias an all-points SSE toward HW by a full season's share.
+
+    Histories shorter than two full cycles keep the mean model outright:
+    seasonal structure is unidentifiable from <2 periods, and a "fitted"
+    cycle there would be pure noise soak-up. One jitted program.
+    """
+    m_len = int(season_length)
+    t_len = values.shape[1]
     ma = moving_average_all(values, mask)
-    hw = fit_holt_winters(values, mask, season_length)
-    m = mask.astype(values.dtype)
+    if t_len < 2 * m_len:  # also the guard inside both structured fits
+        return ma
+    # import at call time: models.seasonal imports this module at top level
+    from foremast_tpu.models.seasonal import fit_seasonal
+
+    hw = fit_holt_winters(values, mask, m_len)
+    se = fit_seasonal(values, mask, period=m_len)
+    warm = (mask & (jnp.arange(t_len)[None, :] >= m_len)).astype(values.dtype)
 
     def sse(fc):
-        r = (values - fc.pred) * m
+        r = (values - fc.pred) * warm
         return jnp.sum(r * r, axis=-1)  # [B]
 
-    use_hw = sse(hw) < AUTO_SSE_RATIO * sse(ma)  # [B]
+    sse_ma, sse_hw, sse_se = sse(ma), sse(hw), sse(se)
+    use_struct = jnp.minimum(sse_hw, sse_se) < AUTO_SSE_RATIO * sse_ma  # [B]
+    prefer_se = sse_se <= sse_hw  # [B]
 
-    def pick(hw_leaf, ma_leaf):
-        sel = use_hw.reshape((-1,) + (1,) * (hw_leaf.ndim - 1))
-        return jnp.where(sel, hw_leaf, ma_leaf)
+    def sel(flag, a_leaf, b_leaf):
+        return jnp.where(
+            flag.reshape((-1,) + (1,) * (a_leaf.ndim - 1)), a_leaf, b_leaf
+        )
 
-    # ma's seasonal buffer is [B, 1] zeros; expand to hw's [B, m] so the
-    # two Forecasts share one structure
+    # ma's seasonal buffer is [B, 1] zeros; expand to the structured [B, m]
+    # so all three Forecasts share one structure (se/hw phases are both
+    # (last_valid + 1) mod m, so the select is phase-consistent)
     ma = Forecast(
         pred=ma.pred,
         scale=ma.scale,
@@ -386,7 +503,8 @@ def fit_auto_univariate(
         season=jnp.zeros_like(hw.season),
         season_phase=hw.season_phase,
     )
-    return jax.tree_util.tree_map(pick, hw, ma)
+    structured = jax.tree_util.tree_map(partial(sel, prefer_se), se, hw)
+    return jax.tree_util.tree_map(partial(sel, use_struct), structured, ma)
 
 
 def hw_continue(
@@ -421,17 +539,20 @@ def hw_continue(
     if season.shape[-1] != m_len:  # non-seasonal fit: zero offsets
         season = jnp.zeros((b, m_len), dtype)
 
+    rows = jnp.arange(b)
+
     def step(carry, xs):
         level, trend, season, phase = carry
         x, m = xs
-        onehot = jax.nn.one_hot(phase, m_len, dtype=dtype)  # [B, m]
-        s_t = jnp.sum(season * onehot, axis=-1)  # [B]
+        # per-series dynamic phase: one gathered element + one scattered
+        # write per step (O(B), not an O(B*m) one-hot — the seasonal
+        # buffer is [B, 1440] for daily cycles)
+        s_t = jnp.take_along_axis(season, phase[:, None], axis=1)[:, 0]  # [B]
         pred = level + trend + s_t
         new_level = alpha * (x - s_t) + (1.0 - alpha) * (level + trend)
         new_trend = beta * (new_level - level) + (1.0 - beta) * trend
         new_s = gamma * (x - new_level) + (1.0 - gamma) * s_t
-        upd = m.astype(dtype)
-        season_out = season + (upd * (new_s - s_t))[:, None] * onehot
+        season_out = season.at[rows, phase].set(jnp.where(m, new_s, s_t))
         level_out = jnp.where(m, new_level, level)
         trend_out = jnp.where(m, new_trend, trend)
         return (level_out, trend_out, season_out, (phase + 1) % m_len), pred
@@ -471,7 +592,18 @@ def fit_holt_winters(
     parameters (SURVEY.md section 7 "hard parts" (c)) — the whole grid runs as
     one vmapped program; each series independently picks its SSE-minimizing
     (alpha, beta, gamma).
+
+    Histories shorter than two full seasons are seasonally unidentifiable:
+    every grid point memorizes the single partial cycle (the seasonal
+    state is initialized from those very residuals, so in-sample SSE ~ 0
+    and the fitted band degenerates to ~zero width), while the unfilled
+    seasonal slots zero out the horizon. Such SERIES get the global-mean
+    model instead — a static early-out when the whole batch is short,
+    plus a per-series select (`_guard_unidentifiable`) because bucket
+    padding can carry a short real history inside a long batch.
     """
+    if values.shape[1] < 2 * int(season_length):
+        return moving_average_all(values, mask)
     grid = jnp.asarray(_HW_GRID, dtype=values.dtype)  # [G,3]
 
     def run(params):
@@ -490,4 +622,5 @@ def fit_holt_winters(
         idx = best.reshape((-1,) + (1,) * (moved.ndim - 1))
         return jnp.take_along_axis(moved, idx, axis=1).squeeze(1)
 
-    return jax.tree_util.tree_map(pick, fcs)
+    fc = jax.tree_util.tree_map(pick, fcs)
+    return _guard_unidentifiable(fc, values, mask, int(season_length))

@@ -1,11 +1,12 @@
 """Masked, batched rank statistics on TPU.
 
 The reference brain's pairwise baseline-vs-current same-distribution tests:
-Mann-Whitney U, Wilcoxon signed-rank, Kruskal-Wallis (reference
+Mann-Whitney U, Wilcoxon signed-rank, Kruskal-Wallis, and the two-group
+Friedman chi-square special case (all four named in reference
 `docs/guides/design.md:90-93`), selectable/combinable via
-`ML_PAIRWISE_ALGORITHM` = ALL | ANY | MANN_WHITE | WILCOXON | KRUSKAL
-(`foremast-brain/README.md:34`), each gated on a minimum number of points
-(`deploy/foremast/3_brain/foremast-brain.yaml:74-79`).
+`ML_PAIRWISE_ALGORITHM` = ALL | ANY | MANN_WHITE | WILCOXON | KRUSKAL |
+FRIEDMAN (`foremast-brain/README.md:34`), each gated on a minimum number
+of points (`deploy/foremast/3_brain/foremast-brain.yaml:74-79`).
 
 TPU-first design (SURVEY.md section 7 "hard parts" (a)): ranking under masks
 without host round-trips. Pairwise windows are short (the 10-minute analysis
@@ -139,6 +140,54 @@ def wilcoxon_signed_rank(
     ok = (jnp.sum(pair_mask, axis=-1) >= min_points) & (n > 0) & (sd > 0)
     p = jnp.where(ok, p, 1.0)
     return w_plus, p, ok
+
+
+def friedman_chi_square(
+    x: jax.Array,
+    x_mask: jax.Array,
+    y: jax.Array,
+    y_mask: jax.Array,
+    min_points: int = 20,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Batched two-group paired Friedman chi-square — the reference's
+    "Fried manchi square (special case)" (`docs/guides/design.md:90-93`):
+    the fourth and last named pairwise algorithm.
+
+    Blocks are position-wise (baseline, current) pairs; ranks within each
+    block are 1/2 (1.5/1.5 on a within-pair tie); the column rank sums
+    feed the standard Friedman statistic with k=2 treatments:
+
+        chi2_F = [12 / (n k (k+1))] (R1^2 + R2^2) - 3 n (k+1),  k = 2,
+
+    divided by the tie correction C = 1 - sum(t^3 - t) / [n k (k^2-1)]
+    = 1 - ties/n (each tied block contributes t=2 -> 6), then referred to
+    chi^2 with k-1 = 1 dof. With no within-pair ties this reduces
+    algebraically to the sign-test form (n_plus - n_minus)^2 / n. scipy's
+    public `friedmanchisquare` refuses k < 3, so the golden test
+    replicates its exact formula (per-block `rankdata` + chi2.sf) at k=2.
+
+    Pairs position-wise like Wilcoxon, but uses only the SIGN of each
+    difference — insensitive to magnitude outliers a single spike injects.
+    Returns (chi2 [B], p [B], ok [B]). Gate: `MIN_FRIEDMAN_DATA_POINTS`
+    valid pairs, and at least one untied pair (C > 0).
+    """
+    dtype = x.dtype
+    pair = x_mask & y_mask
+    n = jnp.sum(pair, axis=-1).astype(dtype)
+    n_plus = jnp.sum(pair & (x > y), axis=-1).astype(dtype)
+    n_minus = jnp.sum(pair & (x < y), axis=-1).astype(dtype)
+    ties = jnp.sum(pair & (x == y), axis=-1).astype(dtype)
+    # column rank sums: winner ranks 2, loser 1, tie 1.5 each
+    r1 = 2.0 * n_plus + n_minus + 1.5 * ties  # x column
+    r2 = 2.0 * n_minus + n_plus + 1.5 * ties
+    n_safe = jnp.maximum(n, 1.0)
+    stat = 2.0 / n_safe * (r1 * r1 + r2 * r2) - 9.0 * n
+    c = 1.0 - ties / n_safe
+    stat = jnp.maximum(stat / jnp.maximum(c, 1e-30), 0.0)
+    p = jnp.clip(_chi2_sf(stat, jnp.asarray(1.0, dtype)), 0.0, 1.0)
+    ok = (n >= min_points) & (c > 0)
+    p = jnp.where(ok, p, 1.0)
+    return stat, p, ok
 
 
 def kruskal_wallis(

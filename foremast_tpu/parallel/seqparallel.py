@@ -170,4 +170,5 @@ def score_time_sharded(batch, mesh: Mesh, config=None):
         min_mw=cfg.pairwise.min_mann_white_points,
         min_wilcoxon=cfg.pairwise.min_wilcoxon_points,
         min_kruskal=cfg.pairwise.min_kruskal_points,
+        min_friedman=cfg.pairwise.min_friedman_points,
     )

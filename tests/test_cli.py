@@ -159,3 +159,23 @@ def test_watch_missing_monitor_fails(monkeypatch, capsys):
         "foremast_tpu.watch.kubeapi.HttpKube", lambda base_url=None: InMemoryKube()
     )
     assert main(["watch", "ghost", "-n", "ns1"]) == 1
+
+
+def test_score_honors_env_config(tmp_path, capsys, monkeypatch):
+    """cmd_score must build its worker from BrainConfig.from_env() — the
+    reference brain is configured entirely through env vars
+    (foremast-brain/README.md:20-38). A near-zero threshold must flip
+    even the normal trace to anomaly; the indexed rule matrix would be
+    silently ignored if score used BrainConfig() defaults."""
+    monkeypatch.setenv("metric_type_threshold_count", "1")
+    monkeypatch.setenv("metric_type0", "error4xx")
+    monkeypatch.setenv("threshold0", "0.0001")
+    req = make_request(tmp_path)
+    rc, resp = run_score(
+        capsys,
+        req,
+        current={"error4xx": NORMAL},
+        baseline={"error4xx": NORMAL},
+        historical={"error4xx": NORMAL},
+    )
+    assert resp["status"] == "anomaly"

@@ -71,3 +71,24 @@ def test_gather_builds_dense_vectors():
     np.testing.assert_allclose(thr, [10.0, 2.0, 2.0])
     np.testing.assert_array_equal(bound, [BOUND_BOTH, BOUND_UPPER, BOUND_UPPER])
     np.testing.assert_allclose(mlb, [0.25, 0.0, 0.0])
+
+
+def test_from_env_friedman_round_trip():
+    """FRIEDMAN (design.md:90-93's fourth pairwise algorithm) selects and
+    gates from env like the other three."""
+    from foremast_tpu.config import PAIRWISE_FRIEDMAN
+
+    cfg = BrainConfig.from_env(
+        {
+            "ML_PAIRWISE_ALGORITHM": "friedman",
+            "MIN_FRIEDMAN_DATA_POINTS": "12",
+            "ML_SEASON_STEPS": "288",
+        }
+    )
+    assert cfg.pairwise.algorithm == PAIRWISE_FRIEDMAN
+    assert cfg.pairwise.min_friedman_points == 12
+    assert cfg.season_steps == 288
+    # defaults: daily season, Wilcoxon-like Friedman gate
+    d = BrainConfig.from_env({})
+    assert d.season_steps == 1440
+    assert d.pairwise.min_friedman_points == 20

@@ -245,7 +245,7 @@ def test_fit_cache_reuses_fit_and_matches_fresh_results():
     from foremast_tpu.models.cache import ModelCache
 
     rng = np.random.default_rng(0)
-    cfg = BrainConfig(algorithm="holt_winters")
+    cfg = BrainConfig(algorithm="holt_winters", season_steps=24)
     plain = HealthJudge(cfg)
     cached = HealthJudge(cfg)
     cached.fit_cache = ModelCache(8)
@@ -289,7 +289,7 @@ def test_fit_cache_mixed_keyed_and_unkeyed_batch():
     from foremast_tpu.models.cache import ModelCache
 
     rng = np.random.default_rng(1)
-    cfg = BrainConfig(algorithm="holt_winters")
+    cfg = BrainConfig(algorithm="holt_winters", season_steps=24)
     judge = HealthJudge(cfg)
     judge.fit_cache = ModelCache(8)
     tasks = [
@@ -330,7 +330,7 @@ def test_worker_sets_fit_key_only_for_settled_histories():
     t = np.arange(64, dtype=np.int64) * 60 + int(now) - 864000
     v = np.ones(64, np.float32)
     src.register("q", (t, v))
-    w = BrainWorker(InMemoryStore(), src, BrainConfig(algorithm="holt_winters"))
+    w = BrainWorker(InMemoryStore(), src, BrainConfig(algorithm="holt_winters", season_steps=24))
     doc = Document(
         id="d1", app_name="demo", status="initial",
         current_config="m== http://p/q?query=x&start=1&end=2&step=60",
@@ -354,3 +354,86 @@ def test_worker_sets_fit_key_only_for_settled_histories():
     assert tasks2[0].fit_key is None
     # the worker attaches its fit cache to the univariate judge
     assert w.judge.univariate.fit_cache is w._fit_cache
+
+
+def test_seasonal_phase_advances_across_hist_cur_gap():
+    """A re-check tick whose current window starts LATER than one step
+    after the history's end must be judged at the advanced seasonal
+    phase (ADVICE r2: score_from_state used to replay the stale phase).
+    Both the fresh path and the warm fit-cache path must agree."""
+    from foremast_tpu.models.cache import ModelCache
+
+    rng = np.random.default_rng(4)
+    n, m, tc, gap = 24 * 12, 24, 10, 6  # quarter-cycle drift
+    t = np.arange(n, dtype=np.float64)
+    hist = (5 + 2 * np.sin(2 * np.pi * t / m)
+            + rng.normal(0, 0.05, n)).astype(np.float32)
+    ht = 1_700_000_000 + 60 * np.arange(n, dtype=np.int64)
+
+    def task(job, start_idx, cur_start_ts):
+        tcur = start_idx + np.arange(tc, dtype=np.float64)
+        cur = (5 + 2 * np.sin(2 * np.pi * tcur / m)).astype(np.float32)
+        ct = cur_start_ts + 60 * np.arange(tc, dtype=np.int64)
+        return MetricTask(
+            job_id=job, alias="latency", metric_type="latency",
+            hist_times=ht, hist_values=hist,
+            cur_times=ct, cur_values=cur,
+            fit_key="app|latency|u1",
+        )
+
+    late_ts = ht[-1] + 60 * (gap + 1)
+    aligned = task("ok", n + gap, late_ts)  # true values at the true time
+    stale = task("bad", n, late_ts)  # values from the pre-gap phase
+
+    cfg = BrainConfig(algorithm="holt_winters", season_steps=m)
+    fresh = HealthJudge(cfg).judge([aligned, stale])
+    assert fresh[0].verdict == HEALTHY
+    assert fresh[1].verdict == UNHEALTHY
+
+    cached = HealthJudge(cfg)
+    cached.fit_cache = ModelCache(8)
+    warm_fill = cached.judge([aligned])  # fills the cache
+    assert warm_fill[0].verdict == HEALTHY
+    warm = cached.judge([aligned, stale])  # warm: score_from_state path
+    assert [v.verdict for v in warm] == [v.verdict for v in fresh]
+
+
+def test_pairwise_friedman_selector_and_combiners():
+    """FRIEDMAN as a first-class ML_PAIRWISE_ALGORITHM choice: a clean
+    level shift (every pair moves the same way) is exactly Friedman's
+    strength; ANY/ALL include it (design.md:90-93 lists all four)."""
+    import jax.numpy as jnp
+
+    from foremast_tpu.config import PAIRWISE_FRIEDMAN
+    from foremast_tpu.engine import scoring
+    from foremast_tpu.ops.windows import MetricWindows
+
+    rng = np.random.default_rng(5)
+    n = 32
+    base = rng.normal(1.0, 0.1, (2, n)).astype(np.float32)
+    cur = base.copy()
+    cur[1] = base[1] + 0.25  # shifted row: every pair increases
+
+    def win(v):
+        return MetricWindows(
+            values=jnp.asarray(v),
+            mask=jnp.ones(v.shape, bool),
+            times=jnp.zeros(v.shape, jnp.int32),
+        )
+
+    p, differs = scoring.pairwise(
+        win(cur), win(base),
+        algorithm=PAIRWISE_FRIEDMAN, p_threshold=0.05,
+        min_mw=20, min_wilcoxon=20, min_kruskal=5, min_friedman=20,
+    )
+    assert not bool(differs[0]) and float(p[0]) > 0.05
+    assert bool(differs[1]) and float(p[1]) < 0.05
+    # combiners include the fourth test
+    for combo in ("ANY", "ALL"):
+        p2, d2 = scoring.pairwise(
+            win(cur), win(base),
+            algorithm=combo, p_threshold=0.05,
+            min_mw=20, min_wilcoxon=20, min_kruskal=5, min_friedman=20,
+        )
+        assert bool(d2[1]), combo
+        assert not bool(d2[0]), combo

@@ -232,19 +232,31 @@ def test_residual_mvn_catches_correlation_break():
     assert flags[:, 11].all()
 
 
-def test_residual_mvn_short_history_invalid_flags_nothing():
+def test_residual_mvn_short_history_degrades_to_holt_and_tiny_invalid():
+    """Histories under two seasons fit with m=1 (Holt residuals — the
+    2-cycle identifiability rule) instead of going dark: the MVN stays
+    valid and still catches gross joint anomalies. Histories too short
+    for even the Holt fit's warm region stay invalid and flag nothing."""
     from foremast_tpu.models.residual_mvn import (
         fit_residual_mvn,
         score_residual_mvn,
     )
 
     rng = np.random.default_rng(2)
-    hist, cur = _comoving(rng, 2, 3, 26, 10)  # only 2 warm points
+    hist, cur = _comoving(rng, 2, 3, 26, 10)  # < 2*24: m=1 partition
     state = fit_residual_mvn(jnp.asarray(hist))
-    assert not np.asarray(state.valid).any()
+    assert state.hw.season.shape[-1] == 1
+    assert np.asarray(state.valid).all()
     cur[:, :, 4] += 100.0
     flags = np.asarray(score_residual_mvn(state, jnp.asarray(cur), 10.0))
-    assert not flags.any()
+    assert flags[:, 4].all()
+
+    tiny_hist, tiny_cur = _comoving(rng, 2, 3, 8, 10)  # 7 warm < min 10
+    tiny = fit_residual_mvn(jnp.asarray(tiny_hist))
+    assert not np.asarray(tiny.valid).any()
+    tiny_cur[:, :, 4] += 100.0
+    tflags = np.asarray(score_residual_mvn(tiny, jnp.asarray(tiny_cur), 10.0))
+    assert not tflags.any()
 
 
 def test_residual_mvn_prefix_mask_matches_exact_length():

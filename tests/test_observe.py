@@ -164,3 +164,34 @@ def test_series_names_rejects_wrapped_expressions():
     assert "a" not in names  # wrapped expression: alias fallback
     assert names["b"] == "namespace_app_per_pod:lat"
     assert names["c"] == "bare_series"
+
+
+def test_series_names_requires_query_param_boundary():
+    """`subquery=foo` (or any param merely ending in "query") must not
+    derive a gauge name (ADVICE r2): the match anchors to a real `query=`
+    parameter at the URL's query-string boundary."""
+    from foremast_tpu.observe.gauges import _series_names
+
+    cfg = (
+        "a== http://x?subquery=not_a_series&other=1"
+        " ||b== http://x?start=1&query=real_series&end=2"
+    )
+    names = _series_names(cfg)
+    assert "a" not in names  # no bare `query=`: alias fallback
+    assert names["b"] == "real_series"
+
+
+def test_series_names_drops_same_series_collisions():
+    """Two aliases of one job resolving to the SAME base series must not
+    share a gauge family (last verdict would silently win — ADVICE r2):
+    both fall back to their alias-named gauges."""
+    from foremast_tpu.observe.gauges import _series_names
+
+    cfg = (
+        "p50== http://x?query=latency_series%7Bq%3D%220.5%22%7D"
+        " ||p99== http://x?query=latency_series%7Bq%3D%220.99%22%7D"
+        " ||ok== http://x?query=other_series"
+    )
+    names = _series_names(cfg)
+    assert "p50" not in names and "p99" not in names
+    assert names["ok"] == "other_series"

@@ -244,7 +244,9 @@ def test_sharded_judge_composes_with_fit_cache():
             fit_key=f"a{i}|m|u{i}",
         )
 
-    judge = ShardedJudge(BrainConfig(algorithm="holt_winters"))
+    # season_steps matches the 24-step cycle this test synthesizes (the
+    # deployed default is the daily 1440)
+    judge = ShardedJudge(BrainConfig(algorithm="holt_winters", season_steps=24))
     judge.fit_cache = ModelCache(64)
     tasks = [task(i, spike=(i == 3)) for i in range(12)]  # 12 % 8 != 0: pads
     v1 = judge.judge(tasks)

@@ -139,3 +139,56 @@ def test_golden_trace_pairwise_detects_spike(demo_traces):
     # identical distributions -> p near 1; spike trace is mostly identical
     # traffic so MW (median-ish) may not fire, but identical must pass
     assert float(p_mw[1]) > 0.4
+
+
+def _friedman_k2_reference(x, y):
+    """scipy's friedmanchisquare formula applied at k=2 with scipy
+    primitives (the public function refuses k < 3): per-block rankdata,
+    tie correction c = 1 - sum(t^3 - t)/(n k (k^2-1)), chi2(k-1) sf."""
+    n, k = len(x), 2
+    ranks = np.stack([sps.rankdata([xi, yi]) for xi, yi in zip(x, y)])
+    ssbn = np.sum(ranks.sum(axis=0) ** 2)
+    ties = sum(
+        np.sum(np.asarray([(ranks[i] == r).sum() for r in set(ranks[i])]) ** 3
+               - np.asarray([(ranks[i] == r).sum() for r in set(ranks[i])]))
+        for i in range(n)
+    )
+    c = 1.0 - ties / (n * k * (k * k - 1))
+    stat = (12.0 / (n * k * (k + 1)) * ssbn - 3.0 * n * (k + 1)) / c
+    return stat, sps.distributions.chi2.sf(stat, k - 1)
+
+
+def test_friedman_matches_scipy_formula_at_k2():
+    from foremast_tpu.ops import friedman_chi_square
+
+    pairs = [
+        (CASES[0][0][:25], CASES[0][1][:25]),  # same distribution
+        (CASES[1][0][:25], CASES[1][1][:25]),  # shifted: must reject
+        # heavy within-pair ties (rounded)
+        (np.round(RNG.normal(0, 1, 30)).astype(np.float32),
+         np.round(RNG.normal(0, 1, 30)).astype(np.float32)),
+    ]
+    x, xm, y, ym = _batch(pairs)
+    stat, p, ok = friedman_chi_square(x, xm, y, ym, min_points=20)
+    for i, (a, b) in enumerate(pairs):
+        want_stat, want_p = _friedman_k2_reference(a, b)
+        assert bool(ok[i])
+        assert float(stat[i]) == pytest.approx(want_stat, abs=1e-3)
+        assert float(p[i]) == pytest.approx(want_p, abs=1e-4)
+    # and the no-tie identity: chi2 == (n+ - n-)^2 / n (sign-test form)
+    a, b = pairs[1]
+    npl = int((a > b).sum()); nmi = int((a < b).sum())
+    assert float(stat[1]) == pytest.approx((npl - nmi) ** 2 / (npl + nmi), abs=1e-3)
+
+
+def test_friedman_gates_and_all_ties():
+    from foremast_tpu.ops import friedman_chi_square
+
+    # all pairs tied: c = 0 -> inconclusive, not NaN
+    x = np.ones(24, np.float32)
+    pairs = [(x, x.copy()), (x[:8], x[:8].copy())]  # second: under min gate
+    xv, xm, yv, ym = _batch(pairs)
+    stat, p, ok = friedman_chi_square(xv, xm, yv, ym, min_points=20)
+    assert not bool(ok[0]) and float(p[0]) == 1.0
+    assert not bool(ok[1]) and float(p[1]) == 1.0
+    assert np.isfinite(np.asarray(stat)).all()
