@@ -720,6 +720,12 @@ def prometheus_config() -> dict:
         "  evaluation_interval: 30s\n"
         "rule_files:\n"
         "  - /etc/prometheus/rules.yml\n"
+        # route the generated Foremast* alert rules (metrics/rules.py)
+        # to the stack's Alertmanager (alertmanager() below)
+        "alerting:\n"
+        "  alertmanagers:\n"
+        "    - static_configs:\n"
+        "        - targets: ['alertmanager-main.monitoring.svc:9093']\n"
         "scrape_configs:\n"
         "  - job_name: kube-state-metrics\n"
         "    static_configs:\n"
@@ -863,6 +869,205 @@ def kube_state_metrics() -> list[dict]:
         },
     }
     return [sa, role, binding, dep, svc]
+
+
+def alertmanager_config_yaml() -> str:
+    """The default route/receiver config, with the reference bundle's
+    cadence (`deploy/prometheus-operator/alertmanager-secret.yaml` —
+    base64 of: resolve 5m, 30s group_wait / 5m group_interval / 12h
+    repeat, one default receiver). Two deliberate divergences: grouping
+    keys on ['alertname', 'app'] instead of the reference's ['job']
+    because every generated Foremast* alert is app-scoped (one page per
+    service, not one per scrape job), and the receiver is a stub the
+    operator points at their pager bridge instead of the operator
+    bundle's 'null' sink — `kubectl edit configmap alertmanager-config`
+    is the whole integration step."""
+    return (
+        "global:\n"
+        "  resolve_timeout: 5m\n"
+        "route:\n"
+        "  group_by: ['alertname', 'app']\n"
+        "  group_wait: 30s\n"
+        "  group_interval: 5m\n"
+        "  repeat_interval: 12h\n"
+        "  receiver: 'default'\n"
+        "receivers:\n"
+        "  - name: 'default'\n"
+        "    # point this at your pager/chat bridge; an unset webhook list\n"
+        "    # keeps alerts visible in the Alertmanager UI/API only\n"
+    )
+
+
+def alertmanager() -> list[dict]:
+    """Self-contained Alertmanager (role of the reference's
+    alertmanager-{alertmanager,service,secret,serviceAccount}.yaml
+    operator bundle): the ForemastAnomaly_*/Foremast*Breach_*/
+    ForemastEngineDown rules (`metrics/rules.alert_rules`) evaluate in
+    Prometheus and ROUTE here — grouping, silences, and receivers
+    included; without it the alert rules fire into the void."""
+    cm = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {
+            "name": "alertmanager-config",
+            "namespace": MONITORING_NAMESPACE,
+        },
+        "data": {"alertmanager.yml": alertmanager_config_yaml()},
+    }
+    dep = _deployment(
+        "alertmanager-main",
+        {
+            "name": "alertmanager",
+            "image": "prom/alertmanager:v0.27.0",
+            "args": [
+                "--config.file=/etc/alertmanager/alertmanager.yml",
+                "--storage.path=/alertmanager",
+            ],
+            "ports": [{"containerPort": 9093, "name": "web"}],
+            "volumeMounts": [
+                {"name": "config", "mountPath": "/etc/alertmanager"},
+                {"name": "data", "mountPath": "/alertmanager"},
+            ],
+            "resources": {
+                "requests": {"cpu": "20m", "memory": "64Mi"},
+                "limits": {"memory": "256Mi"},
+            },
+        },
+        namespace=MONITORING_NAMESPACE,
+        scrape=False,
+    )
+    dep["spec"]["template"]["spec"]["volumes"] = [
+        {"name": "config", "configMap": {"name": "alertmanager-config"}},
+        {"name": "data", "emptyDir": {}},
+    ]
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            # same service name as the reference bundle
+            # (alertmanager-service.yaml) so runbooks port to it directly
+            "name": "alertmanager-main",
+            "namespace": MONITORING_NAMESPACE,
+        },
+        "spec": {
+            "selector": {"app": "alertmanager-main"},
+            "ports": [{"name": "web", "port": 9093, "targetPort": 9093}],
+        },
+    }
+    return [cm, dep, svc]
+
+
+def node_exporter() -> list[dict]:
+    """node-exporter DaemonSet + Service (role of the reference's
+    node-exporter-{daemonset,service,serviceAccount}.yaml): host CPU/
+    memory feed the cpu/memory metric types of the threshold matrix
+    (`foremast-brain.yaml:56-73`). Pods carry the stack's scrape
+    annotations, so the existing pod-annotation job collects them — no
+    kube-rbac-proxy sidecar (the reference's secure-scrape proxy; this
+    self-contained stack scrapes in-cluster HTTP directly)."""
+    sa = {
+        "apiVersion": "v1",
+        "kind": "ServiceAccount",
+        "metadata": {
+            "name": "node-exporter",
+            "namespace": MONITORING_NAMESPACE,
+        },
+    }
+    ds = {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {
+            "name": "node-exporter",
+            "namespace": MONITORING_NAMESPACE,
+            "labels": {"app": "node-exporter"},
+        },
+        "spec": {
+            "selector": {"matchLabels": {"app": "node-exporter"}},
+            "template": {
+                "metadata": {
+                    "labels": {"app": "node-exporter"},
+                    "annotations": {
+                        "prometheus.io/scrape": "true",
+                        "prometheus.io/port": "9100",
+                    },
+                },
+                "spec": {
+                    "serviceAccountName": "node-exporter",
+                    "hostNetwork": True,
+                    "hostPID": True,
+                    "securityContext": {
+                        "runAsNonRoot": True,
+                        "runAsUser": 65534,
+                    },
+                    "tolerations": [
+                        {
+                            "key": "node-role.kubernetes.io/master",
+                            "effect": "NoSchedule",
+                        },
+                        {
+                            "key": "node-role.kubernetes.io/control-plane",
+                            "effect": "NoSchedule",
+                        },
+                    ],
+                    "containers": [
+                        {
+                            "name": "node-exporter",
+                            "image": "quay.io/prometheus/node-exporter:v1.8.1",
+                            "args": [
+                                # same collector surface as the reference
+                                # daemonset (node-exporter-daemonset.yaml),
+                                # minus the localhost+proxy split
+                                "--path.procfs=/host/proc",
+                                "--path.sysfs=/host/sys",
+                                (
+                                    "--collector.filesystem.mount-points-exclude="
+                                    "^/(dev|proc|sys|var/lib/docker/.+)($|/)"
+                                ),
+                            ],
+                            "ports": [
+                                {"containerPort": 9100, "name": "metrics"}
+                            ],
+                            "resources": {
+                                "requests": {"cpu": "50m", "memory": "64Mi"},
+                                "limits": {"memory": "180Mi"},
+                            },
+                            "volumeMounts": [
+                                {
+                                    "name": "proc",
+                                    "mountPath": "/host/proc",
+                                    "readOnly": True,
+                                },
+                                {
+                                    "name": "sys",
+                                    "mountPath": "/host/sys",
+                                    "readOnly": True,
+                                },
+                            ],
+                        }
+                    ],
+                    "volumes": [
+                        {"name": "proc", "hostPath": {"path": "/proc"}},
+                        {"name": "sys", "hostPath": {"path": "/sys"}},
+                    ],
+                },
+            },
+        },
+    }
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": "node-exporter",
+            "namespace": MONITORING_NAMESPACE,
+            "labels": {"app": "node-exporter"},
+        },
+        "spec": {
+            "clusterIP": "None",
+            "selector": {"app": "node-exporter"},
+            "ports": [{"name": "metrics", "port": 9100, "targetPort": 9100}],
+        },
+    }
+    return [sa, ds, svc]
 
 
 def grafana_dashboard() -> dict:
@@ -1165,8 +1370,11 @@ an EMPTY cluster — no out-of-repo prerequisites:
 reference's `deploy/prometheus-operator/` kube-prometheus bundle):
 Prometheus with the pod-annotation scrape job and the generated recording
 rules mounted as native rule files, kube-state-metrics (the rules'
-`kube_pod_labels` join), and Grafana pre-provisioned with the Prometheus
-datasource on :3000. If you already run prometheus-operator instead, skip
+`kube_pod_labels` join), Alertmanager on :9093 receiving the generated
+`Foremast*` alert rules (edit `alertmanager-config` to point the default
+receiver at your pager), node-exporter feeding the cpu/memory metric
+types, and Grafana pre-provisioned with the Prometheus datasource on
+:3000. If you already run prometheus-operator instead, skip
 `prometheus/{00namespace.yaml,1_rbac,2_stack}` and use
 `prometheus/additional-scrape-configs.yaml` as an additionalScrapeConfigs
 secret plus `foremast/2_watch/metrics-rules.yaml` (the same rules as a
@@ -1207,6 +1415,8 @@ def tree(cfg: BrainConfig | None = None) -> dict[str, object]:
         "prometheus/2_stack/prometheus-config.yaml": [prometheus_config()],
         "prometheus/2_stack/prometheus.yaml": prometheus_deployment(),
         "prometheus/2_stack/kube-state-metrics.yaml": kube_state_metrics(),
+        "prometheus/2_stack/alertmanager.yaml": alertmanager(),
+        "prometheus/2_stack/node-exporter.yaml": node_exporter(),
         "prometheus/2_stack/grafana.yaml": grafana(),
         "foremast/00namespace.yaml": [namespace()],
         "foremast/1_crds/deploymentmetadata.yaml": [deployment_metadata_crd()],

@@ -113,26 +113,35 @@ GAP_SENSITIVE_FITS = frozenset(
 )
 
 
+def infer_step(times: np.ndarray) -> float:
+    """Sampling step of a window, from the median of its spacings.
+
+    Median, not endpoint spacing: PromQL query_range omits empty steps,
+    so a scrape outage mid-window inflates (end-start)/(n-1) by the
+    missing fraction and would mis-advance the seasonal phase. Shared by
+    the univariate gap advance and the multivariate MVN scorer so the
+    two paths cannot diverge. Falls back to the reference's 60 s step
+    (`metricsquery.go:43`) for single-point windows."""
+    if len(times) < 2:
+        return 60.0
+    return float(np.median(np.diff(times)))
+
+
 def _gap_steps(tasks: Sequence[MetricTask]) -> np.ndarray:
     """Per-task hist->cur gap in whole steps, [B] int32.
 
     The fitted forecaster's phase assumes the current window starts ONE
-    step after the history's last point; re-check ticks drift later. The
-    step is inferred from the history's endpoints — O(1) per task; the
-    reference's windows are regular PromQL query_range grids
-    (`metricsquery.go:43`), where endpoint spacing IS the step. Tasks
-    without both windows gap 0."""
+    step after the history's last point; re-check ticks drift later.
+    Tasks without both windows gap 0. Only computed for gap-sensitive
+    algorithms (GAP_SENSITIVE_FITS) — the O(n) step inference never runs
+    for the deployed level-only default."""
     out = np.zeros(len(tasks), np.int32)
     for i, t in enumerate(tasks):
         ht = t.hist_times
         ct = t.cur_times
         if len(ht) == 0 or len(ct) == 0:
             continue
-        step = (
-            (float(ht[-1]) - float(ht[0])) / (len(ht) - 1)
-            if len(ht) > 1
-            else 60.0
-        )
+        step = infer_step(np.asarray(ht))
         k = int(round((float(ct[0]) - float(ht[-1])) / max(step, 1.0)))
         out[i] = max(k - 1, 0)
     return out

@@ -43,7 +43,13 @@ import numpy as np
 
 from foremast_tpu.config import BrainConfig
 from foremast_tpu.engine import scoring
-from foremast_tpu.engine.judge import HealthJudge, MetricTask, MetricVerdict, bucket_length
+from foremast_tpu.engine.judge import (
+    HealthJudge,
+    MetricTask,
+    MetricVerdict,
+    bucket_length,
+    infer_step,
+)
 from foremast_tpu.models.bivariate import detect_bivariate, fit_bivariate
 from foremast_tpu.models.cache import ModelCache
 from foremast_tpu.models.lstm_ae import (
@@ -619,11 +625,7 @@ class MultivariateJudge:
         # being scored; the fitted phase assumes cur starts one step after
         # the history's last point
         for i, j in enumerate(joints):
-            step = (
-                float(np.median(np.diff(j.hist_t)))
-                if len(j.hist_t) > 1
-                else 60.0
-            )
+            step = infer_step(j.hist_t)
             k = int(round((float(j.cur_t[0]) - mvns[i][7]) / max(step, 1.0)))
             gap = max(k - 1, 0)
             # phase advances by the TRUE gap (mod m — clamping here would
