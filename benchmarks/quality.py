@@ -260,6 +260,34 @@ def score_joint(kind: str, b: int, th: int, tc: int):
     return prf1(tp, fp, fn)
 
 
+def joint_clean_false_alarms(b: int, th: int, tc: int) -> tuple[int, int]:
+    """Job-level false alarms on CLEAN joint windows (no injected
+    anomalies): how many of `b` healthy deployments the joint hybrid
+    detector would mark Unhealthy. Fail-fast + AutoRollback semantics
+    (design.md:43) turn every falsely-flagged job into a potential
+    rollback, so this is the metric that prices the detector's tail —
+    point precision alone hides it. Returns (false_alarm_jobs, jobs)."""
+    import dataclasses
+
+    from foremast_tpu.config import BrainConfig
+    from foremast_tpu.engine import scoring as engine_scoring
+    from foremast_tpu.engine.multivariate import MultivariateJudge
+
+    rng = np.random.default_rng(7)
+    hist = draw_comoving(rng, b, 4, th, 0)
+    cur = draw_comoving(rng, b, 4, tc, th)
+    tasks, _ = _joint_tasks(hist, cur, "clean")
+    cfg = BrainConfig(algorithm="lstm_autoencoder", season_steps=PERIOD)
+    cfg = dataclasses.replace(
+        cfg, anomaly=dataclasses.replace(cfg.anomaly, threshold=4.0, rules=())
+    )
+    verdicts = MultivariateJudge(cfg).judge(tasks)
+    bad_jobs = {
+        v.job_id for v in verdicts if v.verdict == engine_scoring.UNHEALTHY
+    }
+    return len(bad_jobs), b
+
+
 JOINT_SCENARIOS = ("bivariate", "lstm", "lstm-break")
 
 
@@ -312,8 +340,21 @@ def main(argv=None):
             ),
             flush=True,
         )
+    jb = 16 if args.small else 64  # LSTM trains one model per job
+    fa, n_jobs = joint_clean_false_alarms(jb, th, tc)
+    print(
+        json.dumps(
+            {
+                "scenario": "joint-clean-windows",
+                "algorithm": "lstm_autoencoder",
+                "job_false_alarms": fa,
+                "jobs": n_jobs,
+                "false_alarms_per_10k_jobs": round(fa / n_jobs * 10_000, 1),
+            }
+        ),
+        flush=True,
+    )
     for kind in JOINT_SCENARIOS:
-        jb = 16 if args.small else 64  # LSTM trains one model per job
         p, r, f1 = score_joint(kind, jb, th, tc)
         print(
             json.dumps(

@@ -20,12 +20,14 @@ from foremast_tpu.models.lstm_ae import (
     AEParams,
     LSTMAEConfig,
     LSTMParams,
+    ae_cutoff,
     fit_many,
     init,
     init_many,
     recon_error,
     reconstruct,
     score_many,
+    score_many_cutoff,
     train_step,
     train_step_many,
 )
@@ -58,6 +60,8 @@ __all__ = [
     "recon_error",
     "reconstruct",
     "score_many",
+    "score_many_cutoff",
+    "ae_cutoff",
     "train_step",
     "train_step_many",
     "fit_seasonal",

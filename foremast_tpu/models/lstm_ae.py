@@ -219,15 +219,58 @@ def shardings(mesh, params, opt_state, hidden: int):
     return jax.tree.map(spec, params), jax.tree.map(spec, opt_state)
 
 
+def ae_cutoff(err_mean, err_std, threshold) -> "np.ndarray":
+    """Quantile-matched reconstruction-error cutoff, [S] (host-side).
+
+    Reconstruction error is a squared quantity — right-skewed, never
+    Gaussian — so mean + threshold*sigma underestimates its tail and the
+    naive bound pays false positives at exactly the configured-sigma
+    rates the other detectors hold (VERDICT r2 item 4). Instead the
+    training-error moments fit a gamma (k = mean^2/var, theta =
+    var/mean; chi^2-family, the natural model for squared errors), and
+    the cutoff is the gamma quantile with the SAME tail mass as the
+    two-sided normal tail P(|z| > threshold) — the calibration
+    `residual_mvn.chi2_quantile` already applies to the MVN. Never
+    returns less than the classic mean + threshold*sigma bound, so
+    recalibration can only tighten precision. `threshold` may be scalar
+    or [S] (per-job canary lowering)."""
+    import numpy as np
+    from scipy import stats
+
+    mean = np.maximum(np.asarray(err_mean, np.float64), 1e-300)
+    std = np.asarray(err_std, np.float64)
+    var = np.maximum(std * std, 0.0)
+    p_tail = np.clip(2.0 * stats.norm.sf(np.asarray(threshold, np.float64)), 1e-300, 1.0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        k = np.where(var > 0, mean * mean / np.maximum(var, 1e-300), 1.0)
+        theta = np.where(var > 0, var / mean, 0.0)
+        gq = stats.gamma.ppf(1.0 - p_tail, k, scale=theta)
+    gq = np.where((var > 0) & np.isfinite(gq), gq, mean)
+    return np.maximum(gq, np.asarray(err_mean) + np.asarray(threshold) * std).astype(
+        np.float32
+    )
+
+
 @jax.jit
 def score_many(params, x, mask, err_mean, err_std, threshold):
     """Anomaly flags for [S, B, T, F] windows against trained models.
 
-    A point is anomalous where recon error > err_mean + threshold * err_std
-    (mean + threshold*sigma, matching the statistical detectors' bounds
-    semantics). Returns (flags [S, B, T], errors [S, B, T]).
-    """
+    Classic mean + threshold*sigma bound on the reconstruction error —
+    kept for benchmarks and as the simple API; the shipped judge uses
+    `score_many_cutoff` with the quantile-matched `ae_cutoff` instead
+    (squared errors are right-skewed, so this bound's tail is heavier
+    than the configured sigmas imply). Returns (flags [S, B, T],
+    errors [S, B, T])."""
     err = jax.vmap(recon_error)(params, x, mask)
     thr = (err_mean + threshold * err_std)[:, None, None]  # [S, 1, 1]
     flags = mask & (err > thr)
+    return flags, err
+
+
+@jax.jit
+def score_many_cutoff(params, x, mask, cutoff):
+    """Anomaly flags for [S, B, T, F] windows against per-model error
+    cutoffs [S] (see `ae_cutoff`). Returns (flags [S, B, T], errors)."""
+    err = jax.vmap(recon_error)(params, x, mask)
+    flags = mask & (err > cutoff[:, None, None])
     return flags, err

@@ -282,3 +282,55 @@ def test_residual_mvn_prefix_mask_matches_exact_length():
     np.testing.assert_allclose(
         np.asarray(exact.hw.level), np.asarray(padded.hw.level), rtol=1e-4
     )
+
+
+def test_ae_cutoff_gamma_tail_above_gaussian_bound():
+    """Reconstruction error is right-skewed; the gamma quantile cutoff
+    must sit at or above mean + thr*std (never loosen precision) and
+    reduce to the mean for degenerate zero-variance errors."""
+    from scipy import stats
+
+    from foremast_tpu.models.lstm_ae import ae_cutoff
+
+    mean = np.array([0.02, 0.5], np.float32)
+    std = np.array([0.02, 0.0], np.float32)  # row 1: cv=1 (exponential-like)
+    cut = ae_cutoff(mean, std, 4.0)
+    assert cut[0] >= mean[0] + 4.0 * std[0]
+    # cv=1 => k=1 (exponential): quantile = -theta*ln(p_tail), well above
+    p_tail = 2 * stats.norm.sf(4.0)
+    assert cut[0] == pytest.approx(-0.02 * np.log(p_tail), rel=1e-3)
+    assert cut[1] == pytest.approx(0.5)  # zero variance: mean fallback
+    # per-job thresholds broadcast (canary lowering)
+    cut2 = ae_cutoff(mean, std, np.array([4.0, 2.0], np.float32))
+    assert cut2[0] == pytest.approx(cut[0], rel=1e-6)
+
+
+def test_residual_mvn_robust_d2_suppresses_spike_echo():
+    """The causal HW state absorbs an observed spike and contaminates the
+    NEXT prediction (an echo). The two-pass robust d^2 must keep the
+    spike's own score high while flattening the echo back to clean
+    levels; the plain pass shows the echo."""
+    from foremast_tpu.models.residual_mvn import (
+        chi2_quantile,
+        fit_residual_mvn,
+        residual_mvn_d2,
+        residual_mvn_d2_robust,
+    )
+
+    rng = np.random.default_rng(11)
+    b, f, th, tc = 4, 3, 480, 30
+    hist, cur = _comoving(rng, b, f, th, tc)
+    spike_t = 12
+    cur[:, :, spike_t] += 1.0  # huge joint spike
+    cut = chi2_quantile(4.0, f)
+    state = fit_residual_mvn(jnp.asarray(hist))
+    plain = np.asarray(residual_mvn_d2(state, jnp.asarray(cur)))
+    robust = np.asarray(
+        residual_mvn_d2_robust(state, jnp.asarray(cur), cut)
+    )
+    assert (robust[:, spike_t] > cut).all()  # the spike still screams
+    # echo at t+1: plain is inflated, robust returns to clean levels
+    clean_ref = np.median(robust[:, spike_t + 3 :], axis=1)
+    assert (robust[:, spike_t + 1] < plain[:, spike_t + 1]).all()
+    assert (robust[:, spike_t + 1] < cut).all()
+    assert (robust[:, spike_t + 1] < 10 * np.maximum(clean_ref, 1.0)).all()
