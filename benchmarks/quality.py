@@ -64,6 +64,8 @@ SPIKE_SIGMA = 8.0  # injected spike size in noise-sigmas
 NOISE = 0.05
 SEASON_AMP = 0.5  # seasonal swing: 10x the noise -> dominates a global band
 TREND_PER_STEP = 0.002
+SHIFT_LEVEL = 0.5  # mid-history step (a redeploy / traffic migration)
+SHIFT_FRAC = 0.55  # shift position as a fraction of the history
 
 
 def gen(kind: str, b: int, th: int, tc: int, seed: int = 0, period: int = PERIOD):
@@ -79,6 +81,16 @@ def gen(kind: str, b: int, th: int, tc: int, seed: int = 0, period: int = PERIOD
             return 1.0 + SEASON_AMP * np.sin(2 * np.pi * t / period)
         if kind == "trend":
             return 1.0 + TREND_PER_STEP * t
+        if kind == "shift":
+            # seasonal series with a mid-history LEVEL SHIFT: a global
+            # linear trend fits a bogus slope through the step and
+            # mis-centers the horizon band; the changepoint trend
+            # (models/seasonal.py hinges) localizes it
+            return (
+                1.0
+                + SEASON_AMP * np.sin(2 * np.pi * t / period)
+                + SHIFT_LEVEL * (t >= SHIFT_FRAC * th)
+            )
         raise ValueError(kind)
 
     hist = signal(t_hist) + rng.normal(0, NOISE, (b, th))
@@ -299,7 +311,7 @@ def main(argv=None):
     b = 32 if args.small else 256
     th = 240 if args.small else 1008  # ~10-42 cycles of the 24-step season
     tc = 30
-    for kind in ("flat", "seasonal", "trend"):
+    for kind in ("flat", "seasonal", "trend", "shift"):
         # one draw + one batch per scenario: every algorithm judges the
         # exact same arrays
         hist, cur, truth = gen(kind, b, th, tc)

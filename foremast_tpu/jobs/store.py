@@ -21,6 +21,7 @@ speaks the ES REST API directly (no client lib in the image).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from datetime import datetime, timezone
@@ -35,6 +36,9 @@ from foremast_tpu.jobs.models import (
     TERMINAL_STATUSES,
     Document,
 )
+
+
+log = logging.getLogger("foremast_tpu.jobs.store")
 
 
 def now_rfc3339() -> str:
@@ -144,11 +148,50 @@ class InMemoryStore(JobStore):
             return [d for d in self._docs.values() if d.status not in TERMINAL_STATUSES]
 
 
+# Explicit mapping for the `documents` index. The claim query depends on
+# exact-match `terms` over `status`/`processingContent` and `range`+`sort`
+# over `modifiedAt` (see `ElasticsearchStore.claim`); with dynamic mapping
+# those land as analyzed `text` (term queries then hit analyzer behavior)
+# and date detection depends on the cluster's settings — the semantics
+# this store is built on must come from a template, not mapping luck.
+# The reference inherited defaults from the olivere client
+# (`elasticsearchstore.go:16-19`); this framework pins them. Config/
+# content blobs are stored but never queried, so they are unindexed.
+INDEX_MAPPINGS = {
+    "properties": {
+        "id": {"type": "keyword"},
+        "appName": {"type": "keyword"},
+        "status": {"type": "keyword"},
+        "statusCode": {"type": "keyword"},
+        "strategy": {"type": "keyword"},
+        "processingContent": {"type": "keyword"},
+        "createdAt": {"type": "date"},
+        "modifiedAt": {"type": "date"},
+        "startTime": {"type": "date", "ignore_malformed": True},
+        "endTime": {"type": "date", "ignore_malformed": True},
+        "currentConfig": {"type": "keyword", "index": False, "doc_values": False},
+        "baselineConfig": {"type": "keyword", "index": False, "doc_values": False},
+        "historicalConfig": {"type": "keyword", "index": False, "doc_values": False},
+        "currentMetricStore": {"type": "keyword", "index": False, "doc_values": False},
+        "baselineMetricStore": {"type": "keyword", "index": False, "doc_values": False},
+        "historicalMetricStore": {"type": "keyword", "index": False, "doc_values": False},
+        "reason": {"type": "keyword", "index": False, "doc_values": False},
+        "anomalyInfo": {"type": "object", "enabled": False},
+    }
+}
+
+
+class MappingDivergence(RuntimeError):
+    """The live `documents` index mapping contradicts the claim-critical
+    field types — a permanent config error (ES cannot retype in place)."""
+
+
 class ElasticsearchStore(JobStore):
     """ES REST backend — index/type parity with elasticsearchstore.go:16-19.
 
     Connection-retry semantics mirror the service's forever-retry loop
-    (`service main.go:248-260`) via `wait_ready`.
+    (`service main.go:248-260`) via `wait_ready`, which also creates the
+    index with the explicit `INDEX_MAPPINGS` (idempotent).
     """
 
     INDEX = "documents"
@@ -169,15 +212,92 @@ class ElasticsearchStore(JobStore):
     def wait_ready(self, retry_seconds: float = 3.0, max_wait: float | None = None):
         start = time.time()
         while True:
+            reachable = False
             try:
                 r = self._s.get(self.endpoint, timeout=self.timeout)
-                if r.ok:
-                    return True
+                reachable = r.ok
             except Exception:
                 pass
+            if reachable:
+                # connectivity retries are silent (the reference's
+                # forever-retry loop); index/mapping problems are CONFIG
+                # errors and must not be mistaken for "ES still down" —
+                # permanent (4xx / divergence) raises, transient (5xx,
+                # races during cluster start) logs and retries
+                try:
+                    self.ensure_index()
+                    return True
+                except MappingDivergence:
+                    raise
+                except Exception as e:
+                    status = getattr(
+                        getattr(e, "response", None), "status_code", None
+                    )
+                    if status is not None and 400 <= status < 500 and status != 429:
+                        raise
+                    log.warning("ensure_index failed, retrying: %s", e)
             if max_wait is not None and time.time() - start > max_wait:
                 return False
             time.sleep(retry_seconds)
+
+    # claim()'s server-side semantics stand on exactly these field types;
+    # ensure_index verifies them against a pre-existing index's live
+    # mapping (full equality would be too strict — ES normalizes
+    # mappings and other fields are never queried)
+    CLAIM_CRITICAL_TYPES = {
+        "status": "keyword",
+        "processingContent": "keyword",
+        "appName": "keyword",
+        "createdAt": "date",
+        "modifiedAt": "date",
+    }
+
+    def ensure_index(self) -> bool:
+        """Create the `documents` index with the explicit mappings.
+
+        Idempotent — but NOT blindly so: an existing index (a previous
+        deployment, or an auto-created one from a write that raced ahead
+        of wait_ready) answers 400 resource_already_exists, and its LIVE
+        mapping is then fetched and checked against the claim-critical
+        field types; divergence raises `MappingDivergence` instead of
+        silently running the claim query against analyzed-text/dynamic
+        fields (the exact luck this template exists to remove). ES
+        forbids changing existing field types in place, so divergence
+        needs operator action (reindex), not a retry."""
+        r = self._s.put(
+            self._url(), json={"mappings": INDEX_MAPPINGS}, timeout=self.timeout
+        )
+        if r.status_code == 400:
+            body = {}
+            try:
+                body = r.json()
+            except Exception:
+                pass
+            err = str(body.get("error", body))
+            if "resource_already_exists" not in err:
+                r.raise_for_status()
+            rm = self._s.get(self._url("_mapping"), timeout=self.timeout)
+            rm.raise_for_status()
+            props = (
+                rm.json()
+                .get(self.INDEX, {})
+                .get("mappings", {})
+                .get("properties", {})
+            )
+            bad = {
+                f: (props.get(f) or {}).get("type")
+                for f, want in self.CLAIM_CRITICAL_TYPES.items()
+                if (props.get(f) or {}).get("type") != want
+            }
+            if bad:
+                raise MappingDivergence(
+                    f"index '{self.INDEX}' exists with incompatible mappings "
+                    f"{bad}; claim semantics require "
+                    f"{self.CLAIM_CRITICAL_TYPES} — reindex required"
+                )
+            return True
+        r.raise_for_status()
+        return True
 
     # -- JobStore -------------------------------------------------------
 
