@@ -112,7 +112,13 @@ def fit_residual_mvn(
     w = warm_mask[:, None, :].astype(resid.dtype)  # [B, 1, Th]
     mu = jnp.sum(resid * w, axis=-1) / n[:, None]  # [B, F]
     rc = (resid - mu[:, :, None]) * w
-    cov = jnp.einsum("bft,bgt->bfg", rc, rc) / n[:, None, None]
+    # full-precision accumulation: the 10k-term residual outer products
+    # feed a solve — TPU default-bf16 matmul accumulation would quantize
+    # the covariance (same hazard as the seasonal Gram, seasonal._design)
+    cov = (
+        jnp.einsum("bft,bgt->bfg", rc, rc, precision=jax.lax.Precision.HIGHEST)
+        / n[:, None, None]
+    )
     # scale-aware ridge keeps tiny-magnitude metrics invertible without
     # distorting their geometry
     tr = jnp.trace(cov, axis1=-2, axis2=-1) / f  # [B]

@@ -14,12 +14,13 @@ The hinge features at evenly spaced interior knots c_j are Prophet's
 changepoint mechanism: a redeploy-style level shift fits as a local ramp
 instead of corrupting the global slope and mis-centering the band at the
 horizon. Capacity control is primarily the SPARSE knot grid (8 knots
-over the history), not the ridge: at raw time-index column scales the
-Gram diagonal (~T^3/3) dwarfs any sane Tikhonov term, so `cp_ridge`
-(the analog of Prophet's changepoint prior) only bites for extreme
-values — measured: cp_ridge in {1, 100, 1e4} yields identical fits on
-both shift and clean seasonal series at T=1008, with spurious terminal
-trend already bounded at noise level (~1e-4/step) by the grid alone.
+over the history), not the ridge: even with normalized O(1) columns the
+masked Gram diagonal (~n/3) dwarfs the default Tikhonov term, so
+`cp_ridge` (the analog of Prophet's changepoint prior) only bites for
+extreme values — measured: cp_ridge in {1, 100, 1e4} yields identical
+fits on both shift and clean seasonal series at T=1008, with spurious
+terminal trend already bounded at noise level (~1e-4/step) by the grid
+alone.
 
 Batched masked normal equations: the design matrix X [T, K] is shared
 across the batch; per-series masked Gram matrices are one einsum, solved by
@@ -57,15 +58,22 @@ def _design(
     order: int,
     dtype,
     knots: list[float] = (),
+    t_scale: float = 1.0,
 ) -> jax.Array:
     """Feature matrix [len(t_idx), 2 + len(knots) + 2*order]:
-    [1, t, hinge(t - c_j)..., sin/cos harmonics...]."""
-    t = t_idx.astype(dtype)
+    [1, t/t_scale, hinge((t - c_j)/t_scale)..., sin/cos harmonics...].
+
+    `t_scale` normalizes the trend/hinge columns to O(1) — with raw time
+    indices the Gram matrix carries O(T^3) entries, which the TPU's
+    default-bf16 matmul accumulation cannot represent (measured: the
+    shift-scenario F1 drops 0.998 -> 0.979 and the trend scenario
+    0.999 -> 0.92 on-chip with raw columns)."""
+    t = t_idx.astype(dtype) / float(t_scale)
     cols = [jnp.ones_like(t), t]
     for c in knots:
-        cols.append(jnp.maximum(t - c, 0.0))
+        cols.append(jnp.maximum(t - float(c / t_scale), 0.0))
     for k in range(1, order + 1):
-        w = 2.0 * jnp.pi * k / period
+        w = 2.0 * jnp.pi * k / (period / float(t_scale))
         cols.append(jnp.sin(w * t))
         cols.append(jnp.cos(w * t))
     return jnp.stack(cols, axis=-1)
@@ -113,13 +121,17 @@ def fit_seasonal(
     dtype = values.dtype
     knots = _knots(t_len, n_changepoints)
     n_cp = len(knots)
-    x = _design(jnp.arange(t_len), period, order, dtype, knots)  # [T, K]
+    # normalized trend/hinge columns + full-precision accumulation: the
+    # Gram solve is numerically load-bearing (see _design docstring)
+    t_scale = float(t_len)
+    hi = jax.lax.Precision.HIGHEST
+    x = _design(jnp.arange(t_len), period, order, dtype, knots, t_scale)
     k = x.shape[-1]
     m = mask.astype(dtype)  # [B, T]
     # per-series masked Gram: G[b] = X^T diag(m_b) X   -> [B, K, K]
     xm = x[None, :, :] * m[:, :, None]  # [B, T, K]
-    gram = jnp.einsum("btk,tl->bkl", xm, x)
-    rhs = jnp.einsum("btk,bt->bk", xm, values)
+    gram = jnp.einsum("btk,tl->bkl", xm, x, precision=hi)
+    rhs = jnp.einsum("btk,bt->bk", xm, values, precision=hi)
     # per-column ridge: hinge (slope-change) weights carry the stronger
     # penalty — Prophet's changepoint prior as a diagonal Tikhonov term
     ridge_diag = jnp.asarray(
@@ -130,7 +142,7 @@ def fit_seasonal(
         gram + jnp.diag(ridge_diag)[None], rhs[..., None]
     )[..., 0]  # [B, K]
 
-    pred = jnp.einsum("tk,bk->bt", x, w)
+    pred = jnp.einsum("tk,bk->bt", x, w, precision=hi)
     scale = masked_std((values - pred) * m, mask)
 
     # Materialize one full seasonal cycle over ABSOLUTE phases (season[:, j]
@@ -138,23 +150,28 @@ def fit_seasonal(
     # each series' own continuation point: the forecast resumes right after
     # the last VALID step (n_valid), not after the bucket-padded array end
     # — a [288]-valid history in a [512] bucket must not shift the cycle.
-    xf = _design(jnp.arange(period), period, order, dtype)  # [P, 2+2*order]
+    xf = _design(
+        jnp.arange(period), period, order, dtype, t_scale=t_scale
+    )  # [P, 2+2*order]
     # last valid absolute index per series (consistent with the absolute
     # positions the regression itself uses, including interior gaps)
     last_valid = jnp.max(
         jnp.where(mask, jnp.arange(t_len)[None, :], -1), axis=-1
     )
-    lv = last_valid.astype(dtype)
     # trend value + slope AT each series' last valid step: base line plus
-    # every hinge active there (the post-changepoint regime)
+    # every hinge active there (the post-changepoint regime). Weights act
+    # on the NORMALIZED time axis (t/t_scale), so per-step slopes divide
+    # by t_scale and hinge activations compare normalized positions.
+    lv = last_valid.astype(dtype) / t_scale
     level = w[:, 0] + w[:, 1] * lv
-    trend = w[:, 1]
+    trend = w[:, 1] / t_scale
     for j, c in enumerate(knots):
         d_j = w[:, 2 + j]
-        level = level + d_j * jnp.maximum(lv - c, 0.0)
-        trend = trend + d_j * (lv > c).astype(dtype)
+        cn = c / t_scale
+        level = level + d_j * jnp.maximum(lv - cn, 0.0)
+        trend = trend + d_j * (lv > cn).astype(dtype) / t_scale
     seas_f = jnp.einsum(
-        "pk,bk->bp", xf[:, 2:], w[:, 2 + n_cp :]
+        "pk,bk->bp", xf[:, 2:], w[:, 2 + n_cp :], precision=hi
     )  # [B, P] harmonics only
     fc = Forecast(
         pred=pred,
