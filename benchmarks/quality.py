@@ -1,13 +1,20 @@
 """Detector-quality benchmark: point-level anomaly F1 per algorithm.
 
-Three synthetic scenario families probe where each detector should win:
+Synthetic scenario families probe where each detector should win:
 
   * flat    — stationary noise + injected spikes (the golden-trace shape);
               every detector should score well.
-  * seasonal— strong daily cycle + spikes; the global-mean band must widen
+  * seasonal— strong cycle + spikes; the global-mean band must widen
               to cover the cycle, so moving_average_all loses recall or
               precision while holt_winters / seasonal track the cycle.
   * trend   — steady drift + spikes; trendless models mis-center bounds.
+  * shift   — mid-history level step; global-trend fits mis-center the
+              band (the changepoint trend localizes it).
+  * daily-1440 / daily-1440-sharp — the reference's real workload shape
+    (m=1440 at the 60 s step over the 7-day history), smooth and
+    cron-burst variants.
+  * joint scenarios + clean-window job-level false alarms for the
+    multivariate hybrid.
 
 Each scenario builds B windows with known injected anomaly points; F1 is
 computed over current-window points against ground truth. Usage:
@@ -79,6 +86,13 @@ def gen(kind: str, b: int, th: int, tc: int, seed: int = 0, period: int = PERIOD
             return 1.0 + 0.0 * t
         if kind == "seasonal":
             return 1.0 + SEASON_AMP * np.sin(2 * np.pi * t / period)
+        if kind == "sharp-seasonal":
+            # a cron-style burst: 10 steps of every cycle sit 10x the
+            # noise above the base — unrepresentable by low-order
+            # Fourier, exactly what the pooled phase-means fit carries
+            return 1.0 + SEASON_AMP * (
+                (t % period) < max(10, period // 144)
+            ).astype(float)
         if kind == "trend":
             return 1.0 + TREND_PER_STEP * t
         if kind == "shift":
@@ -333,25 +347,31 @@ def main(argv=None):
     # The reference's real workload shape: a DAILY cycle (m=1440 at the
     # 60 s step) over the full 7-day history. The global-mean default must
     # swallow the whole cycle in its band; the auto screen must route
-    # these series to the pooled Fourier fit (fit_auto_univariate
+    # these series to a pooled structured fit (fit_auto_univariate
     # docstring) and keep point F1 >= 0.99.
     db = 8 if args.small else 128
-    hist, cur, truth = gen("seasonal", db, TH_DAILY, tc, period=PERIOD_DAILY)
-    batch = make_batch(hist, cur)
-    for algo in ("moving_average_all", "auto_univariate", "seasonal"):
-        f1, p, r = score_algorithm(batch, truth, algo, season_length=PERIOD_DAILY)
-        print(
-            json.dumps(
-                {
-                    "scenario": "daily-1440",
-                    "algorithm": algo,
-                    "f1": round(f1, 3),
-                    "precision": round(p, 3),
-                    "recall": round(r, 3),
-                }
-            ),
-            flush=True,
-        )
+    for daily_kind, label in (
+        ("seasonal", "daily-1440"),
+        # sharp cron-style bursts: the pooled phase-means candidate's
+        # scenario (low-order Fourier cannot represent the shape)
+        ("sharp-seasonal", "daily-1440-sharp"),
+    ):
+        hist, cur, truth = gen(daily_kind, db, TH_DAILY, tc, period=PERIOD_DAILY)
+        batch = make_batch(hist, cur)
+        for algo in ("moving_average_all", "auto_univariate", "seasonal", "phase_means"):
+            f1, p, r = score_algorithm(batch, truth, algo, season_length=PERIOD_DAILY)
+            print(
+                json.dumps(
+                    {
+                        "scenario": label,
+                        "algorithm": algo,
+                        "f1": round(f1, 3),
+                        "precision": round(p, 3),
+                        "recall": round(r, 3),
+                    }
+                ),
+                flush=True,
+            )
     jb = 16 if args.small else 64  # LSTM trains one model per job
     fa, n_jobs = joint_clean_false_alarms(jb, th, tc)
     print(

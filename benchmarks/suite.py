@@ -190,7 +190,7 @@ def config3d_daily_season(small: bool):
     dt = _bench(
         lambda x: scoring.score(x, algorithm="auto_univariate", season_length=m),
         batch,
-        iters=3,
+        iters=3 if small else 20,
     )
     wps = b / dt
     _emit(

@@ -78,9 +78,10 @@ class MetricVerdict:
     dist_differs: bool
 
 
-# Fits whose cost scales with history length (sequential scans): caching
-# their terminal state pays. Closed-form fits (moving averages) are cheaper
-# than the cache round trip.
+# Fits whose cost scales with history length (sequential scans, or a
+# full-history read a warm tick can skip shipping): caching their
+# terminal state pays. The plain moving averages are cheaper than the
+# cache round trip.
 EXPENSIVE_FITS = frozenset(
     {
         "ewma",
@@ -88,6 +89,7 @@ EXPENSIVE_FITS = frozenset(
         "double_exponential_smoothing",
         "holtwinters",
         "holt_winters",
+        "phase_means",
         "auto_univariate",
         "seasonal",
         "prophet",
@@ -105,6 +107,7 @@ GAP_SENSITIVE_FITS = frozenset(
         "double_exponential_smoothing",
         "holtwinters",
         "holt_winters",
+        "phase_means",
         "auto_univariate",
         "seasonal",
         "prophet",

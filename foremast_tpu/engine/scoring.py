@@ -47,6 +47,7 @@ from foremast_tpu.ops.forecasters import (
     ewma,
     fit_auto_univariate,
     fit_holt_winters,
+    fit_phase_means,
     horizon,
     moving_average,
     moving_average_all,
@@ -78,7 +79,10 @@ AI_MODEL = {
     "double_exponential_smoothing": double_exponential,
     "holtwinters": fit_holt_winters,
     "holt_winters": fit_holt_winters,
-    # structure-screened per-series selection (MA vs fitted Holt-Winters):
+    # pooled per-phase means + linear trend: the long-season (daily)
+    # workhorse — parallel reductions, representation-free cycle shape
+    "phase_means": fit_phase_means,
+    # structure-screened per-series selection (MA vs structured fits):
     # the recommended default where metric shapes are unknown
     "auto_univariate": fit_auto_univariate,
 }
@@ -95,6 +99,7 @@ def register_model(name: str, fit_fn) -> None:
 _SEASON_KWARG = {
     "holtwinters": "season_length",
     "holt_winters": "season_length",
+    "phase_means": "season_length",
     "auto_univariate": "season_length",
     "seasonal": "period",
     "prophet": "period",

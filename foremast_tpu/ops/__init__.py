@@ -14,6 +14,7 @@ from foremast_tpu.ops.forecasters import (
     holt_winters,
     fit_auto_univariate,
     fit_holt_winters,
+    fit_phase_means,
 )
 from foremast_tpu.ops.ranks import (
     masked_ranks,
@@ -44,6 +45,7 @@ __all__ = [
     "holt_winters",
     "fit_auto_univariate",
     "fit_holt_winters",
+    "fit_phase_means",
     "masked_ranks",
     "mann_whitney_u",
     "wilcoxon_signed_rank",

@@ -449,14 +449,23 @@ def fit_auto_univariate(
     The deployed default `moving_average_all` is blind to seasonality and
     trend (its band must widen to cover the cycle), while a flexible fit
     on a genuinely flat series merely soaks up noise. This fit runs three
-    candidates — the global mean, a fitted Holt-Winters(m), and the
-    trend+Fourier seasonal model (models/seasonal.py, period=m) — and
-    picks per series: a structured model wins only where it explains at
-    least half the mean model's variance (AUTO_SSE_RATIO); between the
-    two structured fits the lower SSE wins. Pooling phases through the
-    Fourier basis is what carries LONG cycles (m=1440 daily at the 60 s
-    step sees only ~7 seasons in the 7-day window — per-phase HW state is
-    7-sample noisy, Fourier pools all 10k points into a few harmonics).
+    candidates — the global mean, an ADAPTIVE structured fit, and the
+    changepoint-trend+Fourier seasonal model (models/seasonal.py,
+    period=m) — and picks per series: a structured model wins only where
+    it explains at least half the mean model's variance (AUTO_SSE_RATIO);
+    between the two structured fits the lower SSE wins.
+
+    The adaptive candidate depends on the season length: small m (<=
+    _HW_UNROLL_MAX) uses the fitted Holt-Winters; LONG cycles (m=1440
+    daily at the 60 s step) use the pooled phase-means fit
+    (fit_phase_means) — Holt-Winters there would burn a T-step
+    sequential scan for (T/m)-sample-noisy per-phase state, while the
+    pooled fit is one parallel reduction and carries arbitrary cycle
+    shapes. Long seasons also add a phase-SIGNIFICANCE routing gate
+    (Bonferroni-corrected z on the pooled phase means): sparse cycle
+    features — a cron-style burst 10 sigmas high but <1% of samples —
+    cannot move the SSE ratio, yet a phase-blind band false-flags every
+    burst occurrence.
 
     The screen is scored on the *warm* region only (absolute index >= m):
     Holt-Winters' first season has near-zero residuals by construction
@@ -475,7 +484,18 @@ def fit_auto_univariate(
     # import at call time: models.seasonal imports this module at top level
     from foremast_tpu.models.seasonal import fit_seasonal
 
-    hw = fit_holt_winters(values, mask, m_len)
+    # Long seasons swap the adaptive candidate: Holt-Winters needs a
+    # T-step sequential scan and its per-phase state is ~(T/m)-sample
+    # noisy, while the pooled phase-means fit is one parallel reduction
+    # and representation-free (sharp cron-style cycle features included)
+    # — see fit_phase_means. Its in-sample SSE is ~(1-m/T) optimistic
+    # (each phase mean includes the scored point), comfortably inside
+    # the AUTO_SSE_RATIO=0.5 margin that keeps flat series on the mean
+    # model.
+    if m_len <= _HW_UNROLL_MAX:
+        hw = fit_holt_winters(values, mask, m_len)
+    else:
+        hw = fit_phase_means(values, mask, m_len)
     se = fit_seasonal(values, mask, period=m_len)
     warm = (mask & (jnp.arange(t_len)[None, :] >= m_len)).astype(values.dtype)
 
@@ -485,6 +505,24 @@ def fit_auto_univariate(
 
     sse_ma, sse_hw, sse_se = sse(ma), sse(hw), sse(se)
     use_struct = jnp.minimum(sse_hw, sse_se) < AUTO_SSE_RATIO * sse_ma  # [B]
+    if m_len > _HW_UNROLL_MAX:
+        # The SSE-ratio gate is blind to SPARSE cycle features: a
+        # cron-style burst 10 sigmas high but 10/1440 of the cycle wide
+        # moves total SSE by <1%, yet a phase-blind band false-flags
+        # every burst occurrence. Under "no structure" a pooled phase
+        # mean is ~N(0, sigma^2/k), so a phase whose |mean| * sqrt(k) /
+        # sigma clears a Bonferroni-corrected normal quantile (alpha =
+        # 1e-3 over m phases; ~4.9 sigmas at m=1440, comfortably above
+        # the ~3.8 max-of-1440 null expectation) is real structure —
+        # route those series to the phase-means fit regardless of SSE.
+        from scipy import stats as _stats  # host-side, static per m
+
+        z_thr = float(_stats.norm.ppf(1.0 - 1e-3 / m_len))
+        kcnt = _phase_counts(mask, m_len, values.dtype)  # [B, m]
+        z = jnp.abs(hw.season) * jnp.sqrt(jnp.maximum(kcnt, 1.0)) / jnp.maximum(
+            hw.scale[:, None], 1e-30
+        )
+        use_struct = use_struct | (jnp.max(z, axis=-1) > z_thr)
     prefer_se = sse_se <= sse_hw  # [B]
 
     def sel(flag, a_leaf, b_leaf):
@@ -505,6 +543,110 @@ def fit_auto_univariate(
     )
     structured = jax.tree_util.tree_map(partial(sel, prefer_se), se, hw)
     return jax.tree_util.tree_map(partial(sel, use_struct), structured, ma)
+
+
+def _phase_counts(mask: jax.Array, m_len: int, dtype) -> jax.Array:
+    """Valid observations per phase, [B, m] — the k the phase-means fit
+    pools over AND the z-gate in the auto screen tests against (one
+    definition so the two can never desynchronize)."""
+    b, t_len = mask.shape
+    n_seasons = -(-t_len // m_len)
+    pad = n_seasons * m_len - t_len
+    mm = mask.astype(dtype)
+    return jnp.sum(
+        jnp.pad(mm, ((0, 0), (0, pad))).reshape(b, n_seasons, m_len), axis=1
+    )
+
+
+@partial(jax.jit, static_argnames=("season_length",))
+def fit_phase_means(
+    values: jax.Array, mask: jax.Array, season_length: int = 1440
+) -> Forecast:
+    """Pooled per-phase means + linear trend — the long-season workhorse.
+
+    For daily cycles (m=1440 at the 60 s step) the 7-day window holds
+    only ~7 observations per phase; Holt-Winters burns a 10,080-step
+    sequential scan to produce 7-sample-noisy per-phase state, and a
+    low-order Fourier basis cannot represent SHARP cycle features (a
+    cron job's minute-wide daily spike). This model is the TPU-native
+    answer: detrend with a masked linear fit, then pool each phase's
+    residuals across seasons — season[p] = mean of detrended values at
+    absolute index ≡ p (mod m). Everything is a parallel reduction
+    (reshape to [B, seasons, m], masked mean over the seasons axis) —
+    no sequential chain at all — and the cycle shape is unconstrained.
+
+    The residual scale uses leave-one-out corrected residuals: with k
+    observations per phase, the in-sample residual against a mean that
+    INCLUDES the point shrinks by (k-1)/k, so r_loo = r * k/(k-1) —
+    at k=7 an uncorrected band would be ~8% too tight. Points at phases
+    observed exactly ONCE carry an identically-zero residual (the phase
+    mean IS the point) and are EXCLUDED from the scale reduction — on
+    gappy histories they would deflate the band below the true noise.
+
+    Same identifiability rule as every seasonal fit: under two full
+    cycles (static batch length or per-series valid count) the series
+    keeps the global-mean model.
+    """
+    m_len = int(season_length)
+    b, t_len = values.shape
+    dtype = values.dtype
+    if t_len < 2 * m_len:
+        return moving_average_all(values, mask)
+
+    # masked linear trend on normalized time (TPU bf16-matmul-safe scale)
+    tn = (jnp.arange(t_len, dtype=dtype) / t_len)[None, :]  # [1, T]
+    mm = mask.astype(dtype)
+    n = jnp.maximum(jnp.sum(mm, axis=-1), 1.0)
+    st = jnp.sum(tn * mm, axis=-1)
+    sx = jnp.sum(values * mm, axis=-1)
+    stt = jnp.sum(tn * tn * mm, axis=-1)
+    stx = jnp.sum(tn * values * mm, axis=-1)
+    denom = stt - st * st / n
+    slope_n = jnp.where(denom > 1e-12, (stx - st * sx / n) / jnp.maximum(denom, 1e-12), 0.0)
+    intercept = sx / n - slope_n * st / n
+    detrended = values - (intercept[:, None] + slope_n[:, None] * tn)
+
+    # per-phase pooled means over whole seasons (pad to a multiple of m)
+    n_seasons = -(-t_len // m_len)
+    pad = n_seasons * m_len - t_len
+    dv = jnp.pad(detrended * mm, ((0, 0), (0, pad))).reshape(b, n_seasons, m_len)
+    k = _phase_counts(mask, m_len, dtype)  # [B, m] observations per phase
+    season = jnp.where(k > 0, jnp.sum(dv, axis=1) / jnp.maximum(k, 1.0), 0.0)
+
+    phase_idx = jnp.arange(t_len) % m_len
+    pred = (
+        intercept[:, None]
+        + slope_n[:, None] * tn
+        + jnp.take(season, phase_idx, axis=1)
+    )
+    # leave-one-out residuals: k/(k-1) per the point's own phase count;
+    # k=1 points are zero-information (their residual is exactly 0) and
+    # drop out of the scale estimate entirely
+    k_at = jnp.take(k, phase_idx, axis=1)  # [B, T]
+    loo = k_at / jnp.maximum(k_at - 1.0, 1.0)
+    resid = (values - pred) * loo
+    scale_mask = mask & (k_at > 1.5)
+    scale = masked_std(resid, scale_mask, ddof=0)
+    # pathological gap patterns can leave NO multiply-observed phase;
+    # an empty scale estimate (0) would mean a zero-width band — fall
+    # back to the plain residual std rather than flag everything
+    scale = jnp.where(
+        jnp.sum(scale_mask, axis=-1) > 0,
+        scale,
+        masked_std(values - pred, mask, ddof=0),
+    )
+
+    last_valid = jnp.max(jnp.where(mask, jnp.arange(t_len)[None, :], -1), axis=-1)
+    lv = last_valid.astype(dtype)
+    fc = Forecast(
+        pred=pred,
+        scale=scale,
+        level=intercept + slope_n * lv / t_len,
+        trend=slope_n / t_len,
+        season=season,
+        season_phase=((last_valid + 1) % m_len).astype(jnp.int32),
+    )
+    return _guard_unidentifiable(fc, values, mask, m_len)
 
 
 def hw_continue(

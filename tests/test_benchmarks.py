@@ -69,3 +69,11 @@ def test_quality_benchmark_structured_beats_flat_on_seasonal(capsys):
     assert f1(("daily-1440", "auto_univariate")) >= 0.99
     assert f1(("daily-1440", "seasonal")) >= 0.99
     assert f1(("daily-1440", "moving_average_all")) < 0.5
+    # sparse sharp cycle features (cron-style bursts): only the pooled
+    # phase-means fit represents the shape, and the auto screen's
+    # phase-significance gate must route to it (the SSE-ratio gate alone
+    # is blind to features covering <1% of samples)
+    assert f1(("daily-1440-sharp", "phase_means")) >= 0.99
+    assert f1(("daily-1440-sharp", "auto_univariate")) >= 0.99
+    assert f1(("daily-1440-sharp", "seasonal")) < 0.7  # Fourier can't
+    assert f1(("daily-1440-sharp", "moving_average_all")) < 0.7

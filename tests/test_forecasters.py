@@ -419,3 +419,31 @@ def test_fit_guards_apply_per_series_under_bucket_padding():
         # the short row's band must be the honest historical std, not a
         # memorized ~zero residual
         assert float(fc.scale[1]) == pytest.approx(float(full[:40].std()), rel=0.05)
+
+
+def test_phase_means_pools_sharp_cycle_and_guards():
+    """The pooled phase-means fit recovers ARBITRARY cycle shapes (a
+    cron-style burst no low-order Fourier basis can express), applies
+    the leave-one-out scale correction, and keeps the mean model below
+    two cycles like every seasonal fit."""
+    from foremast_tpu.ops import fit_phase_means
+
+    rng = np.random.default_rng(23)
+    b, n, m = 4, 4320, 1440  # 3 cycles
+    t = np.arange(n)
+    burst = 5.0 * ((t % m >= 100) & (t % m < 110))
+    v = (10 + burst[None] + 0.002 * t[None]
+         + rng.normal(0, 0.1, (b, n))).astype(np.float32)
+    fc = fit_phase_means(jnp.asarray(v), jnp.ones((b, n), bool), m)
+    h = np.asarray(horizon(fc, m))
+    tt = n + np.arange(m)
+    expect = 10 + 0.002 * tt + 5.0 * ((tt % m >= 100) & (tt % m < 110))
+    assert np.abs(h[0] - expect).max() < 0.5  # burst carried at phase
+    # LOO-corrected scale ~ noise * k/(k-1) at k=3, not deflated below it
+    assert 0.08 < float(fc.scale[0]) < 0.25
+    assert float(fc.trend[0]) == pytest.approx(0.002, rel=0.2)
+
+    short = fit_phase_means(
+        jnp.asarray(v[:, : 2 * m - 1]), jnp.ones((b, 2 * m - 1), bool), m
+    )
+    assert short.season.shape == (b, 1)  # mean-model fallback
