@@ -23,6 +23,7 @@ from foremast_tpu.parallel.seqparallel import (
     sharded_linear_scan,
     sharded_masked_moments,
     sharded_masked_stats,
+    sharded_phase_means,
 )
 
 __all__ = [
@@ -44,4 +45,5 @@ __all__ = [
     "sharded_linear_scan",
     "sharded_masked_moments",
     "sharded_masked_stats",
+    "sharded_phase_means",
 ]

@@ -17,6 +17,10 @@ Two primitives, both built on `shard_map` + XLA collectives over ICI:
   * `sharded_masked_moments` — global masked mean/var across a time-sharded
     window via `psum` (the partial-sum trick), for bounds computed against
     statistics of a sequence no single chip holds.
+  * `sharded_phase_means` — the daily-seasonal (phase-pooled) fit over a
+    time-sharded window: trend moments, per-phase sums/counts, and the
+    leave-one-out residual scale are all per-block partial sums, so the
+    whole long-season fit costs three batched psums plus one pmax.
 
 This is the all-to-all/ring-style sequence-parallel design of the scaling
 playbook applied to scans rather than attention: the sequence axis maps to
@@ -134,6 +138,159 @@ def sharded_masked_moments(
     don't need the count)."""
     _, mean, var = sharded_masked_stats(values, mask, mesh)
     return mean, var
+
+
+def sharded_phase_means(
+    values: jax.Array,
+    mask: jax.Array,
+    season_length: int,
+    mesh: Mesh,
+) -> tuple[
+    jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array
+]:
+    """Daily-seasonal (phase-pooled) fit over a TIME-SHARDED window —
+    context parallelism for the long-season workhorse
+    (`ops.forecasters.fit_phase_means`).
+
+    values/mask: [B, T] with B over `data` and T over `model`. For
+    year-long 60 s histories (~525k points) no single chip need hold the
+    window: every statistic the fit needs — the masked linear trend, the
+    per-phase pooled sums/counts, and the centered leave-one-out residual
+    scale — is a per-block partial sum, so the whole fit costs THREE
+    batched (pytree) psums plus one pmax over ICI. Phase alignment
+    requires the local block length to be a multiple of `season_length`
+    (asserted; pad the window host-side), which makes every block's phase
+    grid start at offset ≡ 0 (mod m).
+
+    Semantics match `fit_phase_means` including the per-series 2-cycle
+    identifiability rule: series with fewer than two cycles of VALID
+    points keep the global-mean model (zero season/trend, historical
+    mean/std as level/scale).
+
+    Returns (season [B, m], level [B], trend [B], scale [B],
+    season_phase [B] int32, n_hist [B] int32), replicated along `model`
+    — the full terminal state `horizon` / `engine.scoring.score_from_state`
+    consume.
+    """
+    m_len = int(season_length)
+    n_model = mesh.shape[MODEL_AXIS]
+    t_total = values.shape[1]
+    t_loc = t_total // n_model
+    assert t_total % n_model == 0, (
+        f"model-axis size ({n_model}) must divide the time axis ({t_total})"
+    )
+    assert t_loc % m_len == 0, (
+        f"local block ({t_loc}) must be a multiple of season_length "
+        f"({m_len}) so every block is phase-aligned — pad the window"
+    )
+
+    def local(v, mk):
+        b, t_blk = v.shape
+        idx = jax.lax.axis_index(MODEL_AXIS)
+        gidx = idx * t_blk + jnp.arange(t_blk)  # global time index, int
+        tn = gidx.astype(v.dtype) / t_total  # normalized (bf16-matmul-safe)
+        mf = mk.astype(v.dtype)
+
+        # psum 1 (batched): masked trend moments + value moments
+        n, st, sx, stt, stx, sxx = jax.lax.psum(
+            (
+                jnp.sum(mf, axis=-1),
+                jnp.sum(tn * mf, axis=-1),
+                jnp.sum(v * mf, axis=-1),
+                jnp.sum(tn * tn * mf, axis=-1),
+                jnp.sum(tn * v * mf, axis=-1),
+                jnp.sum(v * v * mf, axis=-1),
+            ),
+            MODEL_AXIS,
+        )
+        nn = jnp.maximum(n, 1.0)
+        denom = stt - st * st / nn
+        slope_n = jnp.where(
+            denom > 1e-12, (stx - st * sx / nn) / jnp.maximum(denom, 1e-12), 0.0
+        )
+        intercept = sx / nn - slope_n * st / nn
+        det = (v - (intercept[:, None] + slope_n[:, None] * tn)) * mf
+
+        # psum 2 (batched, [B, m]): per-phase pooled sums — the block is
+        # phase-aligned, so a local reshape gives exact phase columns
+        ssum, k = jax.lax.psum(
+            (
+                jnp.sum(det.reshape(b, t_blk // m_len, m_len), axis=1),
+                jnp.sum(mf.reshape(b, t_blk // m_len, m_len), axis=1),
+            ),
+            MODEL_AXIS,
+        )
+        season = jnp.where(k > 0, ssum / jnp.maximum(k, 1.0), 0.0)
+
+        # centered leave-one-out residual scale (k=1 phases carry zero
+        # information and are excluded; degenerate gap patterns fall back
+        # to the plain residual std — same rules as fit_phase_means)
+        phase = gidx % m_len
+        k_at = jnp.take(k, phase, axis=1)
+        pred = (
+            intercept[:, None]
+            + slope_n[:, None] * tn
+            + jnp.take(season, phase, axis=1)
+        )
+        loo = k_at / jnp.maximum(k_at - 1.0, 1.0)
+        smask = mf * (k_at > 1.5)
+        r = (v - pred) * loo
+        r_all = (v - pred) * mf
+        # psum 3 (batched): residual norms/means for both scale paths
+        ss, s1, n2, ss_all, s1_all = jax.lax.psum(
+            (
+                jnp.sum(r * r * smask, axis=-1),
+                jnp.sum(r * smask, axis=-1),
+                jnp.sum(smask, axis=-1),
+                jnp.sum(r_all * r_all, axis=-1),
+                jnp.sum(r_all, axis=-1),
+            ),
+            MODEL_AXIS,
+        )
+
+        def _std(sq, s1_, cnt):
+            c = jnp.maximum(cnt, 1.0)
+            mu = s1_ / c
+            return jnp.sqrt(jnp.maximum(sq / c - mu * mu, 0.0))
+
+        scale = jnp.where(
+            n2 > 0, _std(ss, s1, n2), _std(ss_all, s1_all, nn)
+        )
+
+        # terminal level/trend/phase at the LAST globally valid index
+        local_last = jnp.max(jnp.where(mk, gidx[None, :], -1), axis=-1)
+        last_valid = jax.lax.pmax(local_last, MODEL_AXIS)
+        level = intercept + slope_n * last_valid.astype(v.dtype) / t_total
+        trend = slope_n / t_total
+        season_phase = ((last_valid + 1) % m_len).astype(jnp.int32)
+
+        # per-series 2-cycle identifiability: under-observed series keep
+        # the global-mean model (fit_phase_means applies the same select
+        # via _guard_unidentifiable)
+        enough = n >= 2.0 * m_len
+        mean_v = jnp.where(n > 0, sx / nn, 0.0)
+        var_v = jnp.maximum(sxx / nn - mean_v * mean_v, 0.0)
+        season = jnp.where(enough[:, None], season, 0.0)
+        level = jnp.where(enough, level, mean_v)
+        trend = jnp.where(enough, trend, 0.0)
+        scale = jnp.where(enough, scale, jnp.sqrt(var_v))
+        return season, level, trend, scale, season_phase, n.astype(jnp.int32)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS, MODEL_AXIS)),
+        out_specs=(
+            P(DATA_AXIS, None),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+        ),
+        check_vma=False,
+    )
+    return fn(values, mask)
 
 
 def score_time_sharded(batch, mesh: Mesh, config=None):

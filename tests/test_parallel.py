@@ -320,3 +320,46 @@ def test_sharded_judge_phase_means_seasonal_detection(mesh8):
     assert all(
         v.verdict == HEALTHY for i, v in enumerate(verdicts) if i != 5
     ), [v.verdict for v in verdicts]
+
+
+def test_sharded_phase_means_matches_local_fit(mesh_2d):
+    """Context parallelism for the daily model: the time-sharded
+    phase-means fit must reproduce the single-device fit's terminal
+    state (season buffer, level, trend, LOO scale) to float tolerance,
+    including interior gaps and a ragged (masked) tail."""
+    from foremast_tpu.ops.forecasters import fit_phase_means
+    from foremast_tpu.parallel import sharded_phase_means
+
+    rng = np.random.default_rng(8)
+    b, m, n = 8, 24, 24 * 16  # 16 cycles; t_loc = 192 = 8 cycles per shard
+    t = np.arange(n)
+    v = (5 + 2.5 * ((t % m) < 3) + 0.004 * t
+         + rng.normal(0, 0.1, (b, n))).astype(np.float32)
+    mk = np.ones((b, n), bool)
+    mk[2, 100:130] = False  # interior gap
+    mk[5, 300:] = False  # ragged tail
+    mk[6, m + 10 :] = False  # < 2 cycles valid: identifiability select
+
+    ref = fit_phase_means(jnp.asarray(v), jnp.asarray(mk), m)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    vs = jax.device_put(jnp.asarray(v), NamedSharding(mesh_2d, P("data", "model")))
+    ms = jax.device_put(jnp.asarray(mk), NamedSharding(mesh_2d, P("data", "model")))
+    season, level, trend, scale, phase, n_hist = sharded_phase_means(
+        vs, ms, m, mesh_2d
+    )
+
+    np.testing.assert_allclose(np.asarray(season), np.asarray(ref.season), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(level), np.asarray(ref.level), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(trend), np.asarray(ref.trend), rtol=2e-3, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(scale), np.asarray(ref.scale), rtol=2e-3, atol=2e-4)
+    # full terminal state for horizon/score_from_state
+    np.testing.assert_array_equal(np.asarray(phase), np.asarray(ref.season_phase))
+    np.testing.assert_array_equal(np.asarray(n_hist), np.asarray(mk).sum(axis=1))
+    # the under-observed series kept the global-mean model on BOTH paths
+    assert float(np.abs(np.asarray(season)[6]).max()) == 0.0
+    assert float(np.asarray(trend)[6]) == 0.0
+    sel = v[6][np.asarray(mk)[6]]
+    np.testing.assert_allclose(np.asarray(level)[6], sel.mean(), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(scale)[6], sel.std(), rtol=1e-3)
