@@ -293,35 +293,43 @@ def sharded_phase_means(
     return fn(values, mask)
 
 
-def score_time_sharded(batch, mesh: Mesh, config=None):
-    """Full moving_average_all judgment with the HISTORY time axis sharded
-    over `model` — context parallelism end-to-end.
+def score_time_sharded(
+    batch,
+    mesh: Mesh,
+    config=None,
+    algorithm: str = "moving_average_all",
+    gap_steps: jax.Array | None = None,
+):
+    """Full judgment with the HISTORY time axis sharded over `model` —
+    context parallelism end-to-end.
 
     For histories no single chip holds (year-long windows, 1 s steps):
     place `batch.historical` as [B over data, Th over model]; the model
-    statistics reduce with one psum over ICI, and everything downstream
-    (pairwise tests, bounds, flags, verdict) runs on the short
-    data-sharded current/baseline windows. Semantics match
-    `engine.scoring.score(algorithm="moving_average_all")`.
+    fit reduces over ICI, and everything downstream (pairwise tests,
+    bounds, flags, verdict) runs on the short data-sharded current/
+    baseline windows. Two fits are supported:
 
-    `config`: a BrainConfig for pairwise/threshold parameters (defaults).
+      * `moving_average_all` (the deployed default) — one psum of masked
+        moments; semantics match `engine.scoring.score`.
+      * `phase_means` (the daily-seasonal workhorse) — the distributed
+        phase-pooled fit (`sharded_phase_means`, season from
+        `config.season_steps`), whose terminal state feeds the SAME
+        jitted judgment program the fit cache uses
+        (`scoring.score_from_state`), so bounds/flags/verdicts cannot
+        diverge from the single-chip path.
+
+    `config`: a BrainConfig for season/pairwise/threshold parameters.
+    `gap_steps` [B]: hist->cur gap for drifted re-check windows — the
+    seasonal phase must advance by it exactly like every other
+    phase_means path (`scoring._advance_gap`; `judge._gap_steps`
+    computes it from task timestamps). Ignored by the trendless,
+    seasonless mean model.
     """
     from foremast_tpu.config import BrainConfig
     from foremast_tpu.engine import scoring
 
     cfg = config or BrainConfig()
-
-    n, mean, var = sharded_masked_stats(
-        batch.historical.values, batch.historical.mask, mesh
-    )
-    pred = jnp.broadcast_to(mean[:, None], batch.current.values.shape)
-    # the jitted shared tail: judgment semantics are defined once, in
-    # engine/scoring — this path can never diverge from _score_xla
-    return scoring.judgment_tail(
-        batch,
-        pred,
-        jnp.sqrt(var),
-        n,
+    pw = dict(
         pairwise_algorithm=cfg.pairwise.algorithm,
         p_threshold=cfg.pairwise.threshold,
         min_mw=cfg.pairwise.min_mann_white_points,
@@ -329,3 +337,35 @@ def score_time_sharded(batch, mesh: Mesh, config=None):
         min_kruskal=cfg.pairwise.min_kruskal_points,
         min_friedman=cfg.pairwise.min_friedman_points,
     )
+
+    if algorithm == "phase_means":
+        season, level, trend, scale, phase, n_hist = sharded_phase_means(
+            batch.historical.values,
+            batch.historical.mask,
+            cfg.season_steps,
+            mesh,
+        )
+        return scoring.score_from_state(
+            batch,
+            level,
+            trend,
+            season,
+            phase,
+            scale,
+            n_hist,
+            gap_steps=gap_steps,
+            **pw,
+        )
+    if algorithm != "moving_average_all":
+        raise ValueError(
+            f"score_time_sharded supports moving_average_all and "
+            f"phase_means, not {algorithm!r}"
+        )
+
+    n, mean, var = sharded_masked_stats(
+        batch.historical.values, batch.historical.mask, mesh
+    )
+    pred = jnp.broadcast_to(mean[:, None], batch.current.values.shape)
+    # the jitted shared tail: judgment semantics are defined once, in
+    # engine/scoring — this path can never diverge from _score_xla
+    return scoring.judgment_tail(batch, pred, jnp.sqrt(var), n, **pw)

@@ -363,3 +363,121 @@ def test_sharded_phase_means_matches_local_fit(mesh_2d):
     sel = v[6][np.asarray(mk)[6]]
     np.testing.assert_allclose(np.asarray(level)[6], sel.mean(), rtol=1e-4)
     np.testing.assert_allclose(np.asarray(scale)[6], sel.std(), rtol=1e-3)
+
+
+def test_score_time_sharded_phase_means_matches_single_chip(mesh_2d):
+    """End-to-end context-parallel DAILY judgment: the time-sharded
+    phase-means fit + the shared score_from_state tail must reproduce
+    scoring.score(algorithm='phase_means') verdict-for-verdict on a
+    burst-seasonal fleet with injected off-burst spikes."""
+    import dataclasses
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from foremast_tpu.parallel import score_time_sharded
+
+    rng = np.random.default_rng(10)
+    b, m, th, tc = 16, 24, 24 * 16, 12
+    t = np.arange(th)
+    hv = (5 + 2.0 * ((t % m) < 3) + rng.normal(0, 0.1, (b, th))).astype(np.float32)
+    tcur = th + np.arange(tc)
+    cv = (5 + 2.0 * ((tcur % m) < 3)
+          + rng.normal(0, 0.05, (b, tc))).astype(np.float32)
+    cv[3, 7] += 2.0  # off-burst spike
+    batch = throughput_batch(b, th, tc)
+    batch = dataclasses.replace(
+        batch,
+        historical=dataclasses.replace(
+            batch.historical, values=jnp.asarray(hv)
+        ),
+        current=dataclasses.replace(batch.current, values=jnp.asarray(cv)),
+        threshold=jnp.full((b,), 4.0, jnp.float32),
+    )
+
+    cfg = BrainConfig(season_steps=m)
+    ref = scoring.score(batch, algorithm="phase_means", season_length=m)
+
+    def place(leaf, spec):
+        return jax.device_put(leaf, NamedSharding(mesh_2d, spec))
+
+    placed = scoring.ScoreBatch(
+        historical=jax.tree.map(
+            lambda a: place(a, P("data", "model")), batch.historical
+        ),
+        current=jax.tree.map(lambda a: place(a, P("data")), batch.current),
+        baseline=jax.tree.map(lambda a: place(a, P("data")), batch.baseline),
+        threshold=place(batch.threshold, P("data")),
+        bound=place(batch.bound, P("data")),
+        min_lower_bound=place(batch.min_lower_bound, P("data")),
+        min_points=place(batch.min_points, P("data")),
+    )
+    res = score_time_sharded(placed, mesh_2d, cfg, algorithm="phase_means")
+    np.testing.assert_array_equal(np.asarray(ref.verdict), np.asarray(res.verdict))
+    np.testing.assert_array_equal(
+        np.asarray(ref.anomalies), np.asarray(res.anomalies)
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref.upper), np.asarray(res.upper), rtol=2e-4, atol=2e-4
+    )
+    assert int(np.asarray(res.verdict)[3]) == UNHEALTHY
+    assert (np.asarray(res.verdict) == HEALTHY).sum() == b - 1
+
+
+def test_score_time_sharded_phase_means_advances_gap(mesh_2d):
+    """A drifted re-check window (gap % m != 0) must be judged at the
+    advanced phase on the context-parallel path too (code-review r3:
+    the stale-phase bug the fit-cache path fixed)."""
+    import dataclasses
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from foremast_tpu.parallel import score_time_sharded
+
+    rng = np.random.default_rng(12)
+    # gap=18 puts the data's burst at window positions 6-8 where the
+    # stale (un-advanced) model predicts base level — an UPWARD breach
+    # the default upper bound sees; the advanced model predicts the
+    # burst exactly there and stays quiet
+    b, m, th, tc, gap = 8, 24, 24 * 16, 12, 18
+    t = np.arange(th)
+    hv = (5 + 2.0 * ((t % m) < 3) + rng.normal(0, 0.1, (b, th))).astype(np.float32)
+    # current values are the TRUE continuation gap steps later
+    tcur = th + gap + np.arange(tc)
+    cv = (5 + 2.0 * ((tcur % m) < 3)
+          + rng.normal(0, 0.05, (b, tc))).astype(np.float32)
+    batch = throughput_batch(b, th, tc)
+    batch = dataclasses.replace(
+        batch,
+        historical=dataclasses.replace(batch.historical, values=jnp.asarray(hv)),
+        current=dataclasses.replace(batch.current, values=jnp.asarray(cv)),
+        # no baseline (rollingUpdate shape): the throughput_batch noise
+        # baseline vs the burst current would trip the canary
+        # threshold-lowering and halve the band under test
+        baseline=dataclasses.replace(
+            batch.baseline, mask=jnp.zeros((b, tc), bool)
+        ),
+        threshold=jnp.full((b,), 4.0, jnp.float32),
+    )
+
+    def place(leaf, spec):
+        return jax.device_put(leaf, NamedSharding(mesh_2d, spec))
+
+    placed = scoring.ScoreBatch(
+        historical=jax.tree.map(
+            lambda a: place(a, P("data", "model")), batch.historical
+        ),
+        current=jax.tree.map(lambda a: place(a, P("data")), batch.current),
+        baseline=jax.tree.map(lambda a: place(a, P("data")), batch.baseline),
+        threshold=place(batch.threshold, P("data")),
+        bound=place(batch.bound, P("data")),
+        min_lower_bound=place(batch.min_lower_bound, P("data")),
+        min_points=place(batch.min_points, P("data")),
+    )
+    cfg = BrainConfig(season_steps=m)
+    with_gap = score_time_sharded(
+        placed, mesh_2d, cfg, algorithm="phase_means",
+        gap_steps=jnp.full((b,), gap, jnp.int32),
+    )
+    stale = score_time_sharded(placed, mesh_2d, cfg, algorithm="phase_means")
+    assert (np.asarray(with_gap.verdict) == HEALTHY).all()
+    assert (np.asarray(stale.verdict) == UNHEALTHY).all()  # phase off by 6
