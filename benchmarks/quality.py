@@ -142,14 +142,18 @@ def make_batch(hist: np.ndarray, cur: np.ndarray) -> scoring.ScoreBatch:
     )
 
 
-def score_algorithm(batch, truth: np.ndarray, algorithm: str, season_length: int = 24):
-    _register_models()  # idempotent: any entry point may call first
-    res = scoring.score(batch, algorithm=algorithm, season_length=season_length)
-    flags = np.asarray(res.anomalies)
+def _prf_from_flags(flags: np.ndarray, truth: np.ndarray):
+    """(precision, recall, f1) from point flags vs ground truth."""
     tp = int((flags & truth).sum())
     fp = int((flags & ~truth).sum())
     fn = int((~flags & truth).sum())
-    precision, recall, f1 = prf1(tp, fp, fn)
+    return prf1(tp, fp, fn)
+
+
+def score_algorithm(batch, truth: np.ndarray, algorithm: str, season_length: int = 24):
+    _register_models()  # idempotent: any entry point may call first
+    res = scoring.score(batch, algorithm=algorithm, season_length=season_length)
+    precision, recall, f1 = _prf_from_flags(np.asarray(res.anomalies), truth)
     return f1, precision, recall
 
 
@@ -286,6 +290,34 @@ def score_joint(kind: str, b: int, th: int, tc: int):
     return prf1(tp, fp, fn)
 
 
+def fleet_mix(b: int, th: int, tc: int, seed: int = 0):
+    """ONE batch mixing every univariate shape — the production
+    condition: `auto_univariate` must route each series to its model
+    inside a single compiled program, with no per-batch tuning. Returns
+    (f1, precision, recall) over the whole mixed fleet plus the
+    per-kind F1 dict."""
+    _register_models()
+    kinds = ("flat", "seasonal", "trend", "shift", "sharp-seasonal")
+    per = max(b // len(kinds), 1)
+    hists, curs, truths = [], [], []
+    for j, kind in enumerate(kinds):
+        h, c, tr = gen(kind, per, th, tc, seed=seed + j)
+        hists.append(h)
+        curs.append(c)
+        truths.append(tr)
+    truth = np.concatenate(truths)
+    batch = make_batch(np.concatenate(hists), np.concatenate(curs))
+    res = scoring.score(batch, algorithm="auto_univariate", season_length=PERIOD)
+    flags = np.asarray(res.anomalies)
+    precision, recall, f1 = _prf_from_flags(flags, truth)
+    by_kind = {}
+    for j, kind in enumerate(kinds):
+        sl = slice(j * per, (j + 1) * per)
+        _, _, kf1 = _prf_from_flags(flags[sl], truth[sl])
+        by_kind[kind] = round(kf1, 3)
+    return f1, precision, recall, by_kind
+
+
 def joint_clean_false_alarms(b: int, th: int, tc: int) -> tuple[int, int]:
     """Job-level false alarms on CLEAN joint windows (no injected
     anomalies): how many of `b` healthy deployments the joint hybrid
@@ -372,6 +404,20 @@ def main(argv=None):
                 ),
                 flush=True,
             )
+    mf1, mp, mr, by_kind = fleet_mix(b, th, tc)
+    print(
+        json.dumps(
+            {
+                "scenario": "fleet-mix",
+                "algorithm": "auto_univariate",
+                "f1": round(mf1, 3),
+                "precision": round(mp, 3),
+                "recall": round(mr, 3),
+                "per_kind_f1": by_kind,
+            }
+        ),
+        flush=True,
+    )
     jb = 16 if args.small else 64  # LSTM trains one model per job
     fa, n_jobs = joint_clean_false_alarms(jb, th, tc)
     print(

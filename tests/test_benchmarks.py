@@ -69,6 +69,11 @@ def test_quality_benchmark_structured_beats_flat_on_seasonal(capsys):
     assert f1(("daily-1440", "auto_univariate")) >= 0.99
     assert f1(("daily-1440", "seasonal")) >= 0.99
     assert f1(("daily-1440", "moving_average_all")) < 0.5
+    # ONE mixed batch of every shape — auto must route per series inside
+    # a single compiled program (the production condition)
+    mix = by[("fleet-mix", "auto_univariate")]
+    assert mix["f1"] >= 0.97, mix
+    assert all(v >= 0.95 for v in mix["per_kind_f1"].values()), mix
     # sparse sharp cycle features (cron-style bursts): only the pooled
     # phase-means fit represents the shape, and the auto screen's
     # phase-significance gate must route to it (the SSE-ratio gate alone

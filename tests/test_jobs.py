@@ -477,3 +477,85 @@ def test_hist_end_epoch_parses_all_url_shapes():
     assert _hist_end_epoch("ts(x)&&1699990000&&m&&1700000000") == 1700000000.0
     assert _hist_end_epoch("http://p/api/v1/query_range?q=x") is None
     assert _hist_end_epoch("http://p/api/v1/query_range?end=garbage") is None
+
+
+def test_worker_daily_recheck_warm_ticks_advance_phase():
+    """The production daily loop through the SHIPPED worker path: a
+    10,080-pt burst-seasonal history (default ML_SEASON_STEPS=1440) is
+    fitted ONCE; later re-check ticks run from the cached fit (zero
+    refits — fit_forecast is boobytrapped), judge drifted current
+    windows at the ADVANCED seasonal phase (a clean window straddling
+    the burst stays healthy), and an off-burst spike finalizes the job
+    Unhealthy."""
+    import dataclasses as _dc
+
+    from foremast_tpu.engine import scoring as _scoring
+
+    rng = np.random.default_rng(31)
+    m, th, tc = 1440, 10_080, 20
+    t0 = 1_700_000_000
+    sig = lambda i: 5.0 + 4.0 * ((i % m) < 10) + rng.normal(0, 0.05, len(i))
+    ht = t0 + 60 * np.arange(th, dtype=np.int64)
+    hist_end = int(ht[-1])
+
+    src = ReplaySource()
+    src.register("replay/dhist", (ht, sig(np.arange(th)).astype(np.float32)))
+    windows = {}  # key -> (times, values), re-registered per tick
+
+    def cur_window(gap, spike_at=None):
+        idx = th + gap + np.arange(tc)
+        ct = t0 + 60 * idx
+        cv = sig(idx).astype(np.float32)
+        if spike_at is not None:
+            cv[spike_at] += 1.0  # 20 sigmas, off-burst position
+        return ct.astype(np.int64), cv
+
+    src.register("replay/dcur", lambda: windows["cur"])
+
+    store = InMemoryStore()
+    now1 = hist_end + 3600.0
+    doc = Document(
+        id="daily-job", app_name="dapp", end_time=str(int(now1) + 60 * 3000),
+        current_config="custom_rate== http://replay/dcur",
+        historical_config=(
+            f"custom_rate== http://replay/dhist?query=x&start={t0}"
+            f"&end={hist_end}&step=60"
+        ),
+        strategy="rollingUpdate",
+    )
+    store.create(doc)
+    cfg = BrainConfig(algorithm="auto_univariate")  # daily season default
+    cfg = _dc.replace(
+        cfg, anomaly=_dc.replace(cfg.anomaly, threshold=4.0, rules=())
+    )
+    worker = BrainWorker(store, src, cfg)
+
+    # tick 1 (cold): clean continuation right after the history
+    windows["cur"] = cur_window(gap=0)
+    worker.tick(now=now1)
+    assert store.get("daily-job").status == STATUS_PREPROCESS_COMPLETED
+
+    # ticks 2+: warm — any refit explodes
+    orig = _scoring.fit_forecast
+
+    def boom(*a, **k):  # pragma: no cover - failure path
+        raise AssertionError("refit on a warm daily re-check tick")
+
+    _scoring.fit_forecast = boom
+    try:
+        # clean window STRADDLING the burst, 1430 steps after the
+        # history: phases 1430..1439 then 0..9 — only the advanced
+        # phase predicts the second half's burst
+        windows["cur"] = cur_window(gap=1430)
+        worker.tick(now=now1 + 60 * 1430)
+        assert store.get("daily-job").status == STATUS_PREPROCESS_COMPLETED
+
+        # off-burst spike -> fail-fast Unhealthy, terminal
+        windows["cur"] = cur_window(gap=2000, spike_at=15)
+        worker.tick(now=now1 + 60 * 2000)
+    finally:
+        _scoring.fit_forecast = orig
+    final = store.get("daily-job")
+    assert final.status == STATUS_COMPLETED_UNHEALTH
+    vals = final.anomaly_info["values"]["custom_rate"]
+    assert len(vals) == 2  # exactly the one spiked point, as [t, v]
