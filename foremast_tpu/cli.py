@@ -191,6 +191,10 @@ def cmd_worker(args: argparse.Namespace) -> int:
         start_metrics_server,
     )
 
+    from foremast_tpu.observe import setup_logging
+
+    setup_logging()  # structured JSON logs at INFO (operational events —
+    # claims, warmup, checkpoint, takeovers — are info-level)
     native.ensure_built()  # startup-time compile, never in the hot path
     config = BrainConfig.from_env()
     store = _make_store(args.elastic_url)
@@ -283,6 +287,9 @@ def cmd_worker(args: argparse.Namespace) -> int:
         signal.signal(signal.SIGINT, lambda s, f: stop_event.set())
     except ValueError:
         pass  # not the main thread (embedded use); rely on the caller
+
+    if args.warmup:
+        worker.warmup()
 
     worker.run(
         poll_seconds=args.poll,
@@ -423,6 +430,13 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="orbax-checkpoint trained models here (warm restart skips "
         "LSTM retraining); restored on startup",
+    )
+    p.add_argument(
+        "--warmup",
+        action="store_true",
+        help="precompile the scoring programs for the canonical shapes "
+        "(claim-limit batch, 7-day history) at startup instead of "
+        "paying the 20-40 s XLA compile inside the first real tick",
     )
     p.add_argument(
         "--gauge-port",

@@ -150,6 +150,21 @@ def _gap_steps(tasks: Sequence[MetricTask]) -> np.ndarray:
     return out
 
 
+# Empty padding row for batch-axis bucketing: zero windows everywhere
+# (verdict UNKNOWN, dropped on decode); the constant fit key means the
+# empty-history "fit" caches once, so padded warm ticks stay fit-free.
+_PAD_TASK = MetricTask(
+    job_id="__pad__",
+    alias="__pad__",
+    metric_type=None,
+    hist_times=np.zeros(0, np.int64),
+    hist_values=np.zeros(0, np.float32),
+    cur_times=np.zeros(0, np.int64),
+    cur_values=np.zeros(0, np.float32),
+    fit_key="__pad__",
+)
+
+
 class HealthJudge:
     """Batched scorer with reference-parity config semantics.
 
@@ -183,7 +198,16 @@ class HealthJudge:
 
         out: list[MetricVerdict | None] = [None] * len(tasks)
         for (th, tc), idxs in buckets.items():
+            # The BATCH axis is bucketed too: XLA compiles one program per
+            # (B, Th, Tc) triple, and production claim sizes vary tick to
+            # tick — without padding, a 255-doc claim after a 256-doc one
+            # would eat a fresh 20-40 s TPU compile. Pad rows are empty
+            # (verdict UNKNOWN) and dropped below; their constant
+            # "__pad__" fit key keeps warm ticks fit-free.
             chunk = [tasks[i] for i in idxs]
+            rows = bucket_length(len(chunk))
+            if rows != len(chunk):
+                chunk = chunk + [_PAD_TASK] * (rows - len(chunk))
             for v, i in zip(self._judge_bucket(chunk, th, tc), idxs):
                 out[i] = v
         return [v for v in out if v is not None]

@@ -40,6 +40,12 @@ class ModelCache:
             while len(self._d) > self.max_size:
                 self._d.popitem(last=False)
 
+    def pop(self, key: Hashable) -> None:
+        """Drop an entry if present (e.g. warmup fits that must not
+        occupy real capacity)."""
+        with self._lock:
+            self._d.pop(key, None)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._d)

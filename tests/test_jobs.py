@@ -559,3 +559,37 @@ def test_worker_daily_recheck_warm_ticks_advance_phase():
     assert final.status == STATUS_COMPLETED_UNHEALTH
     vals = final.anomaly_info["values"]["custom_rate"]
     assert len(vals) == 2  # exactly the one spiked point, as [t, v]
+
+
+def test_worker_warmup_precompiles_without_polluting_caches():
+    """`worker --warmup` judges synthetic windows at the canonical
+    shapes so the first real tick reuses compiled programs; warmup fits
+    must not occupy fit-cache capacity, and a real tick afterwards
+    still works."""
+    store = InMemoryStore()
+    src = ReplaySource()
+    # ML_ALGORITHM=auto: the univariate judge rewrites to auto_univariate
+    # (EXPENSIVE -> fit-cached) — the eviction must key off THAT
+    worker = BrainWorker(
+        store, src, BrainConfig(algorithm="auto", season_steps=24),
+        claim_limit=20,
+    )
+    worker.warmup(hist_len=256, cur_len=10)  # CPU-sized shapes
+    assert len(worker._fit_cache) == 0
+    assert store.list_open() == []  # nothing written anywhere
+
+    # real work still flows after warmup
+    nt = 1_700_000_000 + 60 * np.arange(64, dtype=np.int64)
+    nv = np.ones(64, np.float32)
+    src.register("replay/whist", (nt, nv))
+    src.register("replay/wcur", (nt[:10], nv[:10]))
+    store.create(
+        Document(
+            id="wjob", app_name="w", end_time="100",
+            current_config="m== http://replay/wcur",
+            historical_config="m== http://replay/whist",
+            strategy="rollingUpdate",
+        )
+    )
+    worker.tick(now=1e12)
+    assert store.get("wjob").status == STATUS_COMPLETED_HEALTH
