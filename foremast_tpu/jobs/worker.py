@@ -317,6 +317,10 @@ class BrainWorker:
                 self._fit_cache.pop(
                     (eff_algo, self.config.season_steps, f"__warmup__|{i}")
                 )
+            # the warm-replay passes also cached stacked device state for
+            # the warmup claim sets (~25 MB each at daily width) — release
+            if isinstance(uni, HealthJudge):
+                uni._state_stacks.clear()
         log.info(
             "warmup compiled batch buckets %s (Th=%d Tc=%d, algorithm=%s) in %.1fs",
             buckets, hist_len, cur_len, eff_algo, time.perf_counter() - t_start,

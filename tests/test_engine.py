@@ -483,3 +483,32 @@ def test_judge_buckets_batch_axis_to_bound_compiles():
         scoring_mod.score = orig
     # every claim size landed in the same compiled-shape bucket
     assert seen_batch_sizes == [8, 8, 8, 8]
+
+
+def test_fit_cache_device_stack_reuse_and_invalidation():
+    """Warm ticks reuse the stacked device-resident terminal state (at
+    the daily season width it is ~25 MB of restack+upload per tick);
+    any cache miss — e.g. an evicted entry — must skip the reuse, refit
+    that row, and still produce identical verdicts."""
+    from foremast_tpu.models.cache import ModelCache
+
+    rng = np.random.default_rng(9)
+    cfg = BrainConfig(algorithm="holt_winters", season_steps=24)
+    judge = HealthJudge(cfg)
+    judge.fit_cache = ModelCache(16)
+    tasks = [
+        _hw_task(f"j{i}", rng, spike=(i == 2), fit_key=f"a{i}|m|u{i}")
+        for i in range(4)
+    ]
+    ref = [v.verdict for v in judge.judge(tasks)]  # cold: fills fit cache
+    warm = [v.verdict for v in judge.judge(tasks)]  # builds device stack
+    assert len(judge._state_stacks) == 1
+    again = [v.verdict for v in judge.judge(tasks)]  # reuses it
+    assert ref == warm == again
+    assert ref[2] == UNHEALTHY and ref[0] == HEALTHY
+
+    # evict one entry: the next tick MUST take the miss path (stale
+    # stacked state would be wrong if the refit differed) and match
+    judge.fit_cache.pop((cfg.algorithm, cfg.season_steps, "a1|m|u1"))
+    after = [v.verdict for v in judge.judge(tasks)]
+    assert after == ref
