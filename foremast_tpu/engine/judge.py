@@ -150,8 +150,9 @@ def _gap_steps(tasks: Sequence[MetricTask]) -> np.ndarray:
     The fitted forecaster's phase assumes the current window starts ONE
     step after the history's last point; re-check ticks drift later.
     Tasks without both windows gap 0. Only computed for gap-sensitive
-    algorithms (GAP_SENSITIVE_FITS) — the O(n) step inference never runs
-    for the deployed level-only default."""
+    algorithms (GAP_SENSITIVE_FITS) — the gap is a provable no-op for
+    level-only models, so the deployed default skips even the O(1)
+    subsampled step inference."""
     out = np.zeros(len(tasks), np.int32)
     for i, t in enumerate(tasks):
         ht = t.hist_times

@@ -483,6 +483,7 @@ def fit_forecast(
         "min_mw",
         "min_wilcoxon",
         "min_kruskal",
+        "min_friedman",
     ),
 )
 def score_from_state(

@@ -505,6 +505,7 @@ def fit_auto_univariate(
 
     sse_ma, sse_hw, sse_se = sse(ma), sse(hw), sse(se)
     use_struct = jnp.minimum(sse_hw, sse_se) < AUTO_SSE_RATIO * sse_ma  # [B]
+    prefer_se = sse_se <= sse_hw  # [B]
     if m_len > _HW_UNROLL_MAX:
         # The SSE-ratio gate is blind to SPARSE cycle features: a
         # cron-style burst 10 sigmas high but 10/1440 of the cycle wide
@@ -522,8 +523,14 @@ def fit_auto_univariate(
         z = jnp.abs(hw.season) * jnp.sqrt(jnp.maximum(kcnt, 1.0)) / jnp.maximum(
             hw.scale[:, None], 1e-30
         )
-        use_struct = use_struct | (jnp.max(z, axis=-1) > z_thr)
-    prefer_se = sse_se <= sse_hw  # [B]
+        z_gate = jnp.max(z, axis=-1) > z_thr
+        use_struct = use_struct | z_gate
+        # A z-gated series carries a sharp phase feature only the
+        # phase-means fit can represent — force that candidate even when
+        # a level shift hands the Fourier/changepoint fit the lower SSE
+        # (min-SSE there would re-create the burst false-flags this gate
+        # exists to prevent).
+        prefer_se = prefer_se & ~z_gate
 
     def sel(flag, a_leaf, b_leaf):
         return jnp.where(
@@ -593,27 +600,41 @@ def fit_phase_means(
     if t_len < 2 * m_len:
         return moving_average_all(values, mask)
 
-    # masked linear trend on normalized time (TPU bf16-matmul-safe scale)
+    # Backfit the masked linear trend and the pooled phase means jointly.
+    # Time is NOT orthogonal to the phase dummies (the mean time of phase
+    # p's occurrences grows linearly in p), so a single detrend-then-pool
+    # pass leaves cycle leakage in the slope — on a pure 20-amplitude
+    # daily sine the one-shot slope drifts the level by ~2.7 and inflates
+    # the band ~2x (round-4 regression find). Alternating the two LS fits
+    # contracts that leakage by ~(m/T)^2 per iteration (1/49 at 7 daily
+    # cycles), so 3 iterations are exact to float precision; everything
+    # stays a parallel reduction. Normalized time keeps the Gram terms
+    # TPU bf16-matmul-safe.
     tn = (jnp.arange(t_len, dtype=dtype) / t_len)[None, :]  # [1, T]
     mm = mask.astype(dtype)
     n = jnp.maximum(jnp.sum(mm, axis=-1), 1.0)
     st = jnp.sum(tn * mm, axis=-1)
-    sx = jnp.sum(values * mm, axis=-1)
     stt = jnp.sum(tn * tn * mm, axis=-1)
-    stx = jnp.sum(tn * values * mm, axis=-1)
     denom = stt - st * st / n
-    slope_n = jnp.where(denom > 1e-12, (stx - st * sx / n) / jnp.maximum(denom, 1e-12), 0.0)
-    intercept = sx / n - slope_n * st / n
-    detrended = values - (intercept[:, None] + slope_n[:, None] * tn)
-
-    # per-phase pooled means over whole seasons (pad to a multiple of m)
     n_seasons = -(-t_len // m_len)
     pad = n_seasons * m_len - t_len
-    dv = jnp.pad(detrended * mm, ((0, 0), (0, pad))).reshape(b, n_seasons, m_len)
     k = _phase_counts(mask, m_len, dtype)  # [B, m] observations per phase
-    season = jnp.where(k > 0, jnp.sum(dv, axis=1) / jnp.maximum(k, 1.0), 0.0)
-
     phase_idx = jnp.arange(t_len) % m_len
+    season = jnp.zeros((b, m_len), dtype)
+    for _ in range(3):
+        y = values - jnp.take(season, phase_idx, axis=1)
+        sx = jnp.sum(y * mm, axis=-1)
+        stx = jnp.sum(tn * y * mm, axis=-1)
+        slope_n = jnp.where(
+            denom > 1e-12, (stx - st * sx / n) / jnp.maximum(denom, 1e-12), 0.0
+        )
+        intercept = sx / n - slope_n * st / n
+        detrended = values - (intercept[:, None] + slope_n[:, None] * tn)
+        dv = jnp.pad(detrended * mm, ((0, 0), (0, pad))).reshape(
+            b, n_seasons, m_len
+        )
+        season = jnp.where(k > 0, jnp.sum(dv, axis=1) / jnp.maximum(k, 1.0), 0.0)
+
     pred = (
         intercept[:, None]
         + slope_n[:, None] * tn

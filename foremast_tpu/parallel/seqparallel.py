@@ -20,7 +20,8 @@ Two primitives, both built on `shard_map` + XLA collectives over ICI:
   * `sharded_phase_means` — the daily-seasonal (phase-pooled) fit over a
     time-sharded window: trend moments, per-phase sums/counts, and the
     leave-one-out residual scale are all per-block partial sums, so the
-    whole long-season fit costs three batched psums plus one pmax.
+    whole long-season fit (including the trend<->season backfit rounds)
+    costs a handful of batched psums plus one pmax.
 
 This is the all-to-all/ring-style sequence-parallel design of the scaling
 playbook applied to scans rather than attention: the sequence axis maps to
@@ -156,7 +157,7 @@ def sharded_phase_means(
     year-long 60 s histories (~525k points) no single chip need hold the
     window: every statistic the fit needs — the masked linear trend, the
     per-phase pooled sums/counts, and the centered leave-one-out residual
-    scale — is a per-block partial sum, so the whole fit costs THREE
+    scale — is a per-block partial sum, so the whole fit costs eight
     batched (pytree) psums plus one pmax over ICI. Phase alignment
     requires the local block length to be a multiple of `season_length`
     (asserted; pad the window host-side), which makes every block's phase
@@ -190,42 +191,52 @@ def sharded_phase_means(
         gidx = idx * t_blk + jnp.arange(t_blk)  # global time index, int
         tn = gidx.astype(v.dtype) / t_total  # normalized (bf16-matmul-safe)
         mf = mk.astype(v.dtype)
+        phase = gidx % m_len
 
-        # psum 1 (batched): masked trend moments + value moments
-        n, st, sx, stt, stx, sxx = jax.lax.psum(
+        # psum 1 (batched): mask-only trend moments, raw value moments
+        # (identifiability guard), and per-phase counts — the block is
+        # phase-aligned, so a local reshape gives exact phase columns
+        n, st, stt, sx0, sxx, k = jax.lax.psum(
             (
                 jnp.sum(mf, axis=-1),
                 jnp.sum(tn * mf, axis=-1),
-                jnp.sum(v * mf, axis=-1),
                 jnp.sum(tn * tn * mf, axis=-1),
-                jnp.sum(tn * v * mf, axis=-1),
+                jnp.sum(v * mf, axis=-1),
                 jnp.sum(v * v * mf, axis=-1),
+                jnp.sum(mf.reshape(b, t_blk // m_len, m_len), axis=1),
             ),
             MODEL_AXIS,
         )
         nn = jnp.maximum(n, 1.0)
         denom = stt - st * st / nn
-        slope_n = jnp.where(
-            denom > 1e-12, (stx - st * sx / nn) / jnp.maximum(denom, 1e-12), 0.0
-        )
-        intercept = sx / nn - slope_n * st / nn
-        det = (v - (intercept[:, None] + slope_n[:, None] * tn)) * mf
 
-        # psum 2 (batched, [B, m]): per-phase pooled sums — the block is
-        # phase-aligned, so a local reshape gives exact phase columns
-        ssum, k = jax.lax.psum(
-            (
+        # Backfit trend <-> pooled phase means — same iteration count and
+        # math as `fit_phase_means` (see its cycle/trend-leakage comment);
+        # two batched psums per round, so the whole fit is 8 psums + pmax
+        # (1 moments + 3 rounds x 2 + 1 residual-scale).
+        season = jnp.zeros((b, m_len), v.dtype)
+        for _ in range(3):
+            y = v - jnp.take(season, phase, axis=1)
+            sx, stx = jax.lax.psum(
+                (jnp.sum(y * mf, axis=-1), jnp.sum(tn * y * mf, axis=-1)),
+                MODEL_AXIS,
+            )
+            slope_n = jnp.where(
+                denom > 1e-12,
+                (stx - st * sx / nn) / jnp.maximum(denom, 1e-12),
+                0.0,
+            )
+            intercept = sx / nn - slope_n * st / nn
+            det = (v - (intercept[:, None] + slope_n[:, None] * tn)) * mf
+            ssum = jax.lax.psum(
                 jnp.sum(det.reshape(b, t_blk // m_len, m_len), axis=1),
-                jnp.sum(mf.reshape(b, t_blk // m_len, m_len), axis=1),
-            ),
-            MODEL_AXIS,
-        )
-        season = jnp.where(k > 0, ssum / jnp.maximum(k, 1.0), 0.0)
+                MODEL_AXIS,
+            )
+            season = jnp.where(k > 0, ssum / jnp.maximum(k, 1.0), 0.0)
 
         # centered leave-one-out residual scale (k=1 phases carry zero
         # information and are excluded; degenerate gap patterns fall back
         # to the plain residual std — same rules as fit_phase_means)
-        phase = gidx % m_len
         k_at = jnp.take(k, phase, axis=1)
         pred = (
             intercept[:, None]
@@ -268,7 +279,7 @@ def sharded_phase_means(
         # the global-mean model (fit_phase_means applies the same select
         # via _guard_unidentifiable)
         enough = n >= 2.0 * m_len
-        mean_v = jnp.where(n > 0, sx / nn, 0.0)
+        mean_v = jnp.where(n > 0, sx0 / nn, 0.0)
         var_v = jnp.maximum(sxx / nn - mean_v * mean_v, 0.0)
         season = jnp.where(enough[:, None], season, 0.0)
         level = jnp.where(enough, level, mean_v)

@@ -447,3 +447,28 @@ def test_phase_means_pools_sharp_cycle_and_guards():
         jnp.asarray(v[:, : 2 * m - 1]), jnp.ones((b, 2 * m - 1), bool), m
     )
     assert short.season.shape == (b, 1)  # mean-model fallback
+
+
+def test_auto_z_gate_forces_phase_means_over_min_sse():
+    """A series with BOTH a level shift (which hands the changepoint+
+    Fourier fit the lower SSE) and a sparse cron burst must still route
+    to the phase-means candidate: the z-gate exists because a phase-blind
+    band false-flags every burst occurrence, so min-SSE must not override
+    it (ADVICE r3 item 2)."""
+    from foremast_tpu.ops import fit_auto_univariate
+
+    rng = np.random.default_rng(31)
+    n, m = 10_080, 1440
+    t = np.arange(n)
+    burst = 5.0 * ((t % m >= 100) & (t % m < 110))
+    shift = 3.0 * (t >= n // 2)  # favors the hinge-knot seasonal fit's SSE
+    v = (10 + shift + burst + rng.normal(0, 0.1, n)).astype(np.float32)[None]
+    fc = fit_auto_univariate(jnp.asarray(v), jnp.ones((1, n), bool), season_length=m)
+    h = np.asarray(horizon(fc, m))[0]
+    ph = n % m  # horizon starts at this phase
+    idx = (np.arange(m) + ph) % m
+    in_burst = (idx >= 100) & (idx < 110)
+    # the burst must be carried at its phase: a low-order Fourier fit
+    # (or the mean model) would predict the baseline there and miss by ~5
+    lift = h[in_burst].mean() - h[~in_burst].mean()
+    assert lift > 3.0
