@@ -1,0 +1,213 @@
+"""Fleet-scale END-TO-END worker benchmark: `BrainWorker.tick` measured
+through claim -> fetch -> judge -> write-back.
+
+BASELINE.md's north star is "100k concurrent metric-windows scored/sec"
+— scored by the SYSTEM, not by a kernel. The suite's config 3r measures
+the shipped judge; this module measures the whole worker loop the way
+the reference's brain runs it (`docs/guides/design.md:35-43`): a fake
+job store holding one document per service (4 metric aliases each, the
+reference's 4-metric monitor shape) and an in-memory metric source, so
+the measured time is claim CAS + config decode + window fetch + batch
+pack + device scoring + verdict decode + ES-document write-back — every
+host byte the production loop pays, minus only real network latency.
+
+The re-check loop is the steady state being measured: every document's
+endTime is in the future, so each tick re-judges the same fleet
+(status `preprocess_completed` -> claimable again), exactly like the
+reference brain re-checking until endTime (`design.md:43`).
+
+Usage: python -m benchmarks.worker_bench [--services N] [--ticks K]
+       [--algorithm A] [--season M] [--small]
+Prints one JSON line per phase (cold, warm steady state).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from foremast_tpu.config import BrainConfig
+from foremast_tpu.jobs.models import (
+    STATUS_PREPROCESS_COMPLETED,
+    Document,
+)
+from foremast_tpu.jobs.store import InMemoryStore
+from foremast_tpu.jobs.worker import BrainWorker
+from foremast_tpu.metrics.source import MetricSource
+
+ALIASES = ("latency", "error4xx", "error5xx", "tps")
+
+
+class ArraySource(MetricSource):
+    """Exact-match URL->series map: O(1) fetch, no parsing.
+
+    ReplaySource's substring scan is O(routes) per fetch — fine for
+    tests, quadratic at fleet scale. This source is the fake-Prometheus
+    floor: the benchmark charges the worker for everything EXCEPT real
+    HTTP latency."""
+
+    concurrent_fetch = False
+
+    def __init__(self):
+        self.data: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+
+    def fetch(self, url: str):
+        return self.data[url]
+
+
+def build_fleet(
+    services: int,
+    hist_len: int,
+    cur_len: int,
+    now: float,
+    seed: int = 0,
+):
+    """One document per service x 4 aliases, re-check steady state."""
+    rng = np.random.default_rng(seed)
+    store = InMemoryStore()
+    source = ArraySource()
+    t_now = int(now)
+    ht = t_now - 86_400 * 7 + 60 * np.arange(hist_len, dtype=np.int64)
+    ct = ht[-1] + 60 + 60 * np.arange(cur_len, dtype=np.int64)
+    # endTime one hour out: every tick lands in the keep-re-checking
+    # branch (STATUS_PREPROCESS_COMPLETED), the production steady state
+    end_time = time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime(t_now + 3600)
+    )
+    for s in range(services):
+        cur_parts = []
+        hist_parts = []
+        for a in ALIASES:
+            cur_url = f"http://prom/cur?q={a}:app{s}&end={t_now}&step=60"
+            hist_url = (
+                f"http://prom/hist?q={a}:app{s}"
+                f"&end={ht[-1] + 60}&step=60"
+            )
+            # per-(service, alias) series so fits cannot alias each
+            # other; current rides well inside the fitted band (+-0.5
+            # sigma) so the fleet stays on the healthy re-check path —
+            # Gaussian current tails would turn ~half the fleet
+            # completed_unhealth (terminal) on the first tick
+            hv = rng.normal(1.0, 0.1, hist_len).astype(np.float32)
+            cv = (
+                1.0
+                + 0.05 * np.sin(np.arange(cur_len) / 3.0)
+            ).astype(np.float32)
+            source.data[cur_url] = (ct, cv)
+            source.data[hist_url] = (ht, hv)
+            cur_parts.append(f"{a}== {cur_url}")
+            hist_parts.append(f"{a}== {hist_url}")
+        doc = Document(
+            id=f"job-{s}",
+            app_name=f"app{s}",
+            end_time=end_time,
+            current_config=" ||".join(cur_parts),
+            historical_config=" ||".join(hist_parts),
+            strategy="continuous",
+        )
+        store.create(doc)
+    return store, source
+
+
+def run(
+    services: int,
+    ticks: int,
+    algorithm: str,
+    season: int,
+    hist_len: int,
+    cur_len: int,
+) -> dict:
+    now = 1_760_000_000.0
+    store, source = build_fleet(services, hist_len, cur_len, now)
+    cfg = BrainConfig(
+        algorithm=algorithm,
+        season_steps=season,
+        max_cache_size=4 * services + 64,
+    )
+    worker = BrainWorker(
+        store,
+        source,
+        config=cfg,
+        claim_limit=services,
+        worker_id="bench-worker",
+    )
+    windows = services * len(ALIASES)
+
+    # cold: first tick pays fetch, pack, upload, fit, compile
+    t0 = time.perf_counter()
+    n = worker.tick(now=now + 1)
+    cold_s = time.perf_counter() - t0
+    assert n == services, f"claimed {n} != {services}"
+
+    # warm steady state: same fleet re-checked (hist + fit caches hot)
+    times = []
+    for k in range(ticks):
+        t0 = time.perf_counter()
+        n = worker.tick(now=now + 2 + k)
+        times.append(time.perf_counter() - t0)
+        assert n == services, f"claimed {n} != {services}"
+    warm_s = float(np.median(times))
+    return {
+        "services": services,
+        "windows": windows,
+        "algorithm": algorithm,
+        "cold_tick_seconds": round(cold_s, 3),
+        "cold_windows_per_sec": round(windows / cold_s, 1),
+        "warm_tick_seconds": round(warm_s, 3),
+        "warm_windows_per_sec": round(windows / warm_s, 1),
+        "warm_ticks_measured": ticks,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--services", type=int, default=10_000)
+    ap.add_argument("--ticks", type=int, default=3)
+    ap.add_argument("--algorithm", default="moving_average_all")
+    ap.add_argument("--season", type=int, default=24)
+    ap.add_argument("--hist-len", type=int, default=10_080)
+    ap.add_argument("--cur-len", type=int, default=30)
+    ap.add_argument(
+        "--small", action="store_true", help="CPU smoke shapes (CI)"
+    )
+    ap.add_argument(
+        "--profile",
+        default=None,
+        metavar="OUT.pstats",
+        help="cProfile the warm ticks into OUT.pstats",
+    )
+    args = ap.parse_args(argv)
+    if args.small:
+        args.services = min(args.services, 128)
+        args.hist_len = min(args.hist_len, 512)
+    if args.profile:
+        import cProfile
+
+        # profile everything; cold-tick compile noise is excluded by
+        # enabling only around the warm phase inside run() — simplest
+        # honest alternative: profile a second run() whose compiles are
+        # already cached in-process
+        run(args.services, 1, args.algorithm, args.season,
+            args.hist_len, args.cur_len)
+        prof = cProfile.Profile()
+        prof.enable()
+        result = run(args.services, args.ticks, args.algorithm,
+                     args.season, args.hist_len, args.cur_len)
+        prof.disable()
+        prof.dump_stats(args.profile)
+    else:
+        result = run(args.services, args.ticks, args.algorithm,
+                     args.season, args.hist_len, args.cur_len)
+    result["config"] = "w-shipped-worker-tick"
+    result["metric"] = "warm_windows_per_sec"
+    result["value"] = result["warm_windows_per_sec"]
+    result["unit"] = "windows/s"
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

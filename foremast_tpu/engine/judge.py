@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -26,6 +27,11 @@ from foremast_tpu.ops.windows import MetricWindows
 # shapes total, not one per ragged job (SURVEY.md "hard parts" (b)).
 _MIN_BUCKET = 8
 
+# Max rows per fit sub-batch (see _score_with_fit_cache): bounds peak
+# packing/upload memory on fleet-cold ticks at the 7-day history length
+# (4096 x 10,080 x 5 B ~= 200 MB per chunk).
+_FIT_CHUNK = 4096
+
 
 def bucket_length(n: int) -> int:
     b = _MIN_BUCKET
@@ -34,12 +40,17 @@ def bucket_length(n: int) -> int:
     return b
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass
 class MetricTask:
     """One metric of one job, host-side ragged form.
 
     times/values arrays for historical, current and (optionally) baseline
     windows; metric_type selects the threshold row (error5xx/latency/...).
+
+    A plain (non-frozen) dataclass on purpose: a fleet tick constructs
+    one of these per (job x alias) — 40k+ per tick — and frozen's
+    `object.__setattr__`-per-field init measurably taxes the worker's
+    host budget (the end-to-end loop runs on one CPU core per chip).
     """
 
     job_id: str
@@ -58,13 +69,28 @@ class MetricTask:
     # immutable (its end safely in the past): keys the fitted-forecast
     # cache so re-check ticks skip the history scan (SURVEY hard part (d))
     fit_key: str | None = None
+    # warm-tick fast path: a task whose fit is already cached may carry
+    # EMPTY hist arrays (the worker skips the historical fetch entirely —
+    # no Prometheus round trip, no 10k-pt parse) plus the history's
+    # inferred step and last timestamp so the seasonal gap advance
+    # (_gap_steps) still has its anchors
+    hist_step: float | None = None
+    hist_last_t: float | None = None
+    # the cached fit state itself, attached by the worker at fetch time.
+    # Carrying the ENTRY (not just the key) makes the skip-fetch decision
+    # race-free: a colder bucket's fits in the same tick may LRU-evict
+    # this key from the fit cache before this bucket is judged, and a
+    # key-only task would then be "refit" on its empty history — caching
+    # garbage under the real key. A referenced entry cannot be evicted
+    # out from under the task.
+    fit_entry: tuple | None = None
 
     def __post_init__(self):
         if (self.base_times is None) != (self.base_values is None):
             raise ValueError("base_times and base_values must be set together")
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass
 class MetricVerdict:
     """Judgment for one metric, in wire-friendly form."""
 
@@ -157,10 +183,17 @@ def _gap_steps(tasks: Sequence[MetricTask]) -> np.ndarray:
     for i, t in enumerate(tasks):
         ht = t.hist_times
         ct = t.cur_times
-        if len(ht) == 0 or len(ct) == 0:
+        if len(ct) == 0:
             continue
-        step = infer_step(np.asarray(ht))
-        k = int(round((float(ct[0]) - float(ht[-1])) / max(step, 1.0)))
+        if len(ht) == 0:
+            # warm fast path: the worker skipped the hist fetch but
+            # carried the step/last-time anchors (MetricTask.hist_step)
+            if t.hist_step is None or t.hist_last_t is None:
+                continue
+            step, last = t.hist_step, t.hist_last_t
+        else:
+            step, last = infer_step(np.asarray(ht)), float(ht[-1])
+        k = int(round((float(ct[0]) - last) / max(step, 1.0)))
         out[i] = max(k - 1, 0)
     return out
 
@@ -262,14 +295,27 @@ class HealthJudge:
             (cfg.algorithm, cfg.season_steps, t.fit_key) if t.fit_key else None
             for t in tasks
         ]
-        entries = [self.fit_cache.get(k) if k else None for k in keys]
+        # tasks that carry their entry (worker warm path) skip the lookup;
+        # everything else goes through ONE batched cache get
+        entries = [t.fit_entry for t in tasks]
+        need = [i for i, e in enumerate(entries) if e is None]
+        if need:
+            fetched = self.fit_cache.get_many([keys[i] for i in need])
+            for i, e in zip(need, fetched):
+                entries[i] = e
         miss = [i for i, e in enumerate(entries) if e is None]
-        if miss:
-            rows = bucket_length(len(miss))
-            pad = [miss[0]] * (rows - len(miss))  # repeat a real row:
+        # Fit miss rows in bounded chunks: a fleet-cold tick can miss 40k+
+        # rows at the 10,080-pt history, and one bucket-padded fit batch
+        # would materialize gigabytes of host+device buffers; fixed-size
+        # chunks reuse one compiled fit shape and bound peak memory.
+        for c0 in range(0, len(miss), _FIT_CHUNK):
+            chunk = miss[c0 : c0 + _FIT_CHUNK]
+            rows = bucket_length(len(chunk))
+            pad = [chunk[0]] * (rows - len(chunk))  # repeat a real row:
             hist = MetricWindows.from_ragged(  # bounded compile shapes
-                [(tasks[i].hist_times, tasks[i].hist_values) for i in miss + pad],
+                [(tasks[i].hist_times, tasks[i].hist_values) for i in chunk + pad],
                 th,
+                device_times=False,
             )
             fc = scoring.fit_forecast(
                 hist.values,
@@ -278,13 +324,12 @@ class HealthJudge:
                 season_length=cfg.season_steps,
             )
             n_hist = hist.count().astype(jnp.int32)
-            level = np.asarray(fc.level)
-            trend = np.asarray(fc.trend)
-            season = np.asarray(fc.season)
-            phase = np.asarray(fc.season_phase)
-            scale = np.asarray(fc.scale)
-            nh = np.asarray(n_hist)
-            for j, i in enumerate(miss):
+            # one overlapped D2H (same rationale as the result decode)
+            level, trend, season, phase, scale, nh = jax.device_get(
+                (fc.level, fc.trend, fc.season, fc.season_phase, fc.scale, n_hist)
+            )
+            puts = []
+            for j, i in enumerate(chunk):
                 entry = (
                     float(level[j]),
                     float(trend[j]),
@@ -295,7 +340,9 @@ class HealthJudge:
                 )
                 entries[i] = entry
                 if keys[i] is not None:
-                    self.fit_cache.put(keys[i], entry)
+                    puts.append((keys[i], entry))
+            if puts:
+                self.fit_cache.put_many(puts)
         # Season buffers may mix lengths within one batch: auto fits on a
         # history shorter than two cycles return the mean model's [1] zero
         # buffer (scoring.tile_season documents why tiling is exact).
@@ -341,18 +388,31 @@ class HealthJudge:
         cfg = self.config
         use_cache = self.fit_cache is not None and cfg.algorithm in EXPENSIVE_FITS
         cur = MetricWindows.from_ragged(
-            [(t.cur_times, t.cur_values) for t in tasks], tc
+            [(t.cur_times, t.cur_values) for t in tasks], tc, device_times=False
         )
-        empty = (np.zeros(0, np.int64), np.zeros(0, np.float32))
-        base = MetricWindows.from_ragged(
-            [
-                (t.base_times, t.base_values)
-                if t.base_values is not None
-                else empty
-                for t in tasks
-            ],
-            tc,
-        )
+        if all(t.base_values is None for t in tasks):
+            # baseline-less bucket (the rollingUpdate strategy): an
+            # all-masked baseline fails every pairwise min-points gate,
+            # so skip the 40k-tuple ragged list + pack and ship zeros at
+            # the SAME [B, tc] compiled shape (no extra specialization)
+            b = len(tasks)
+            base = MetricWindows(
+                values=jnp.zeros((b, tc), jnp.float32),
+                mask=jnp.zeros((b, tc), bool),
+                times=None,
+            )
+        else:
+            empty = (np.zeros(0, np.int64), np.zeros(0, np.float32))
+            base = MetricWindows.from_ragged(
+                [
+                    (t.base_times, t.base_values)
+                    if t.base_values is not None
+                    else empty
+                    for t in tasks
+                ],
+                tc,
+                device_times=False,
+            )
         if use_cache:
             # the cached path packs/uploads histories only for cache-miss
             # rows; a fully-warm re-check tick ships zero history bytes
@@ -364,7 +424,9 @@ class HealthJudge:
             )
         else:
             hist = MetricWindows.from_ragged(
-                [(t.hist_times, t.hist_values) for t in tasks], th
+                [(t.hist_times, t.hist_values) for t in tasks],
+                th,
+                device_times=False,
             )
         thr, bound, mlb = cfg.anomaly.gather([t.metric_type for t in tasks])
         batch = scoring.ScoreBatch(
@@ -396,12 +458,23 @@ class HealthJudge:
                 min_kruskal=cfg.pairwise.min_kruskal_points,
                 min_friedman=cfg.pairwise.min_friedman_points,
             )
-        verdicts = np.asarray(res.verdict)
-        anoms = np.asarray(res.anomalies)
-        uppers = np.asarray(res.upper)
-        lowers = np.asarray(res.lower)
-        ps = np.asarray(res.p_value)
-        differs = np.asarray(res.dist_differs)
+        # ONE overlapped device->host fetch for all six result arrays:
+        # a bare np.asarray per jax.Array issues a synchronous round trip
+        # PER ARRAY, and over the TPU tunnel each such round trip carries
+        # a fixed latency in the hundreds of ms (measured: sequential
+        # fetches of 6 small result arrays cost 20-60x more wall-clock
+        # than jax.device_get of the tuple, which starts every
+        # copy_to_host_async before the first blocking read).
+        verdicts, anoms, uppers, lowers, ps, differs = jax.device_get(
+            (
+                res.verdict,
+                res.anomalies,
+                res.upper,
+                res.lower,
+                res.p_value,
+                res.dist_differs,
+            )
+        )
 
         # Decode anomaly positions for the WHOLE batch in one pass (flags
         # are sparse and already mask-gated, so padding never fires); a
@@ -430,8 +503,11 @@ class HealthJudge:
                     alias=t.alias,
                     verdict=int(verdicts[i]),
                     anomaly_pairs=pairs,
-                    upper=uppers[i, :n].copy(),
-                    lower=lowers[i, :n].copy(),
+                    # views into the tick's result buffer (fresh per tick,
+                    # so no aliasing hazard): a per-row .copy() here costs
+                    # ~2 us x 40k tasks on the fleet tick's one host core
+                    upper=uppers[i, :n],
+                    lower=lowers[i, :n],
                     p_value=float(ps[i]),
                     dist_differs=bool(differs[i]),
                 )

@@ -122,6 +122,7 @@ class InMemoryStore(JobStore):
 
     def claim(self, worker_id: str, max_stuck_seconds: float, limit: int = 64):
         now = time.time()
+        stamp = now_rfc3339()  # one strftime per claim, not per doc
         out = []
         with self._lock:
             for doc in self._docs.values():
@@ -132,7 +133,7 @@ class InMemoryStore(JobStore):
                     # claimer sees the doc as taken (not claimable again
                     # until the stuck timeout)
                     doc.status = STATUS_PREPROCESS_INPROGRESS
-                    doc.modified_at = now_rfc3339()
+                    doc.modified_at = stamp
                     doc.processing_content = worker_id
                     out.append(doc)
         return out

@@ -20,6 +20,8 @@ import time
 import uuid
 from typing import Callable
 
+import numpy as np
+
 from foremast_tpu.config import BrainConfig
 from foremast_tpu.engine import (
     HEALTHY,
@@ -52,6 +54,9 @@ log = logging.getLogger("foremast_tpu.worker")
 # ingestion latency with margin, metricsquery.go:53-55).
 HIST_CACHE_ENTRIES = 256
 HIST_SETTLED_SECONDS = 120.0
+
+_EMPTY_TIMES = np.zeros(0, np.int64)
+_EMPTY_VALUES = np.zeros(0, np.float32)
 
 
 def _hist_end_epoch(url: str) -> float | None:
@@ -142,46 +147,138 @@ class BrainWorker:
         uni = getattr(self.judge, "univariate", self.judge)
         if isinstance(uni, HealthJudge):
             uni.fit_cache = self._fit_cache
+        # the algorithm the univariate judge actually fits/caches under
+        # (a multivariate selector rewrites it to its univariate fallback)
+        # ... and the season it caches under: BOTH must come from the
+        # judge actually doing the caching (an injected judge may carry a
+        # different config than the worker's own), or the warm-path probe
+        # key would never match and every tick would refetch histories
+        eff_cfg = uni.config if isinstance(uni, HealthJudge) else self.config
+        self._eff_algo = eff_cfg.algorithm
+        self._eff_season = eff_cfg.season_steps
+        from foremast_tpu.engine.judge import GAP_SENSITIVE_FITS
+
+        self._gap_sensitive = self._eff_algo in GAP_SENSITIVE_FITS
+        # per-document decoded config/endTime metadata (immutable per doc
+        # id — see _doc_meta) and per-fit-key gap anchors (step, last
+        # hist timestamp) for the history-free warm path
+        self._meta_cache = ModelCache(max(4096, 2 * claim_limit))
+        self._gap_meta = ModelCache(max(4096, 8 * claim_limit))
         self.metrics = metrics
 
     # -- preprocess: document -> MetricTasks ----------------------------
 
-    def _fetch_tasks(self, doc: Document, now: float) -> list[MetricTask] | None:
-        """Fetch every window of every alias; None => preprocess failure."""
+    def _doc_meta(self, doc: Document):
+        """Per-document decoded metadata, cached by document id.
+
+        A document's id is the HMAC of its app/times/configs
+        (`elasticsearchstore.go:29`), so the decoded config strings,
+        per-alias metric types, historical end epochs and the parsed
+        endTime are immutable per id — decoding them on every re-check
+        tick is pure per-tick overhead (3 string splits + N substring
+        matches + RFC3339 parses per doc x 10k docs x every tick).
+        Entries: (aliases, end_epoch) where aliases is a list of
+        (alias, cur_url, metric_type, base_url, hist_url, fit_key,
+        hist_end_epoch)."""
+        meta = self._meta_cache.get(doc.id)
+        if meta is not None:
+            return meta
         cur = decode_config(doc.current_config)
         base = decode_config(doc.baseline_config)
         hist = decode_config(doc.historical_config)
-        if not cur:
+        aliases = []
+        for alias, cur_url in cur.items():
+            hist_url = hist.get(alias)
+            aliases.append(
+                (
+                    alias,
+                    cur_url,
+                    infer_metric_type(alias, self.config),
+                    base.get(alias),
+                    hist_url,
+                    # immutable history => the fitted model is immutable
+                    # too; key it per (app, alias, URL)
+                    f"{doc.app_name}|{alias}|{hist_url}" if hist_url else None,
+                    _hist_end_epoch(hist_url) if hist_url else None,
+                )
+            )
+        meta = (aliases, parse_time(doc.end_time))
+        self._meta_cache.put(doc.id, meta)
+        return meta
+
+    def _fetch_tasks(self, doc: Document, now: float) -> list[MetricTask] | None:
+        """Fetch every window of every alias; None => preprocess failure."""
+        aliases, _ = self._doc_meta(doc)
+        if not aliases:
             return None
         tasks = []
+        empty_t = _EMPTY_TIMES
+        empty_v = _EMPTY_VALUES
         try:
-            for alias, cur_url in cur.items():
+            for alias, cur_url, mtype, base_url, hist_url, key, hist_end in aliases:
                 ct, cv = self.source.fetch(cur_url)
                 fit_key = None
-                if alias in hist:
-                    url = hist[alias]
-                    (ht, hv), settled = self._fetch_hist_cached(url, now)
+                step_kw = {}
+                if hist_url is not None:
+                    settled = (
+                        hist_end is not None
+                        and hist_end <= now - HIST_SETTLED_SECONDS
+                    )
                     if settled:
-                        # immutable history => the fitted model is
-                        # immutable too; key it per (app, alias, URL)
-                        fit_key = f"{doc.app_name}|{alias}|{url}"
+                        fit_key = key
+                        entry = self._fit_cache.get(
+                            (self._eff_algo, self._eff_season, key)
+                        )
+                        gap = (
+                            self._gap_meta.get(key)
+                            if self._gap_sensitive
+                            else None
+                        )
+                        if entry is not None and (
+                            gap is not None or not self._gap_sensitive
+                        ):
+                            # warm fast path: the fitted state is cached,
+                            # so the task needs no history at all — skip
+                            # the fetch (no datastore round trip) and
+                            # attach the ENTRY itself (race-free: see
+                            # MetricTask.fit_entry) plus, for seasonal
+                            # fits, the gap anchors
+                            ht, hv = empty_t, empty_v
+                            step_kw = dict(fit_entry=entry)
+                            if gap is not None:
+                                step_kw.update(
+                                    hist_step=gap[0], hist_last_t=gap[1]
+                                )
+                        else:
+                            ht, hv = self._fetch_hist_cached(hist_url, now)
+                            if len(ht) and self._gap_sensitive:
+                                from foremast_tpu.engine.judge import infer_step
+
+                                self._gap_meta.put(
+                                    key, (infer_step(ht), float(ht[-1]))
+                                )
+                    else:
+                        # mutable range: fetch fresh every tick, never
+                        # cache the series or the fit
+                        ht, hv = self.source.fetch(hist_url)
                 else:
                     ht, hv = ct[:0], cv[:0]
                 kw = {}
-                if alias in base:
-                    bt, bv = self.source.fetch(base[alias])
+                if base_url is not None:
+                    bt, bv = self.source.fetch(base_url)
                     kw = dict(base_times=bt, base_values=bv)
                 tasks.append(
                     MetricTask(
                         job_id=doc.id,
                         alias=alias,
-                        metric_type=infer_metric_type(alias, self.config),
+                        metric_type=mtype,
                         hist_times=ht,
                         hist_values=hv,
                         cur_times=ct,
                         cur_values=cv,
                         app=doc.app_name,
                         fit_key=fit_key,
+                        **step_kw,
                         **kw,
                     )
                 )
@@ -191,26 +288,22 @@ class BrainWorker:
         return tasks
 
     def _fetch_hist_cached(self, url: str, now: float):
-        """Fetch a historical window, memoized by URL when the range is
-        provably immutable. Returns ((times, values), settled).
+        """Fetch a settled historical window, memoized by URL.
 
-        The watcher builds historical ranges ending at deploy start, but
-        REST clients may supply arbitrary params — a range whose end
-        lies in the future (or too close to `now` for datastore ingestion
-        to have settled) would freeze a truncated series for the job's
-        lifetime. Such URLs are fetched fresh every tick, and their fits
-        are never cached either (`settled` gates both). `now` is the
-        tick's injectable clock so admission is deterministic in tests.
-        """
+        Only called for provably immutable ranges (the caller checks the
+        range's end against `now` - HIST_SETTLED_SECONDS; the watcher
+        builds historical ranges ending at deploy start, but REST clients
+        may supply arbitrary params — a range whose end lies in the
+        future or too close to `now` for datastore ingestion to have
+        settled is fetched fresh every tick and never cached, series or
+        fit). `now` is the tick's injectable clock so admission is
+        deterministic in tests."""
         cached = self._hist_cache.get(url)
         if cached is not None:
-            return cached, True
+            return cached
         series = self.source.fetch(url)
-        end = _hist_end_epoch(url)
-        settled = end is not None and end <= now - HIST_SETTLED_SECONDS
-        if settled:
-            self._hist_cache.put(url, series)
-        return series, settled
+        self._hist_cache.put(url, series)
+        return series
 
     # -- postprocess: verdicts -> document status -----------------------
 
@@ -218,7 +311,7 @@ class BrainWorker:
         self, doc: Document, verdicts: list[MetricVerdict], now: float
     ) -> Document:
         job_verdict = combine_verdicts(verdicts)
-        end = parse_time(doc.end_time)
+        end = self._doc_meta(doc)[1]  # parsed once per doc, not per tick
         # a missing/unparseable endTime must not make the job immortal:
         # finalize on the first judgment instead of re-checking forever
         past_end = end <= 0 or now >= end
@@ -349,7 +442,11 @@ class BrainWorker:
         all_tasks: list[MetricTask] = []
         failed: list[Document] = []
         ok_docs: list[Document] = []
-        if len(docs) > 1:
+        # ... but only when the source actually blocks on I/O: in-memory
+        # sources (replay/static/tests/benchmarks) declare
+        # concurrent_fetch=False, and threading pure-Python dict lookups
+        # through a pool is pure GIL overhead on the worker's host core
+        if len(docs) > 1 and getattr(self.source, "concurrent_fetch", True):
             from concurrent.futures import ThreadPoolExecutor
             from functools import partial as _partial
 

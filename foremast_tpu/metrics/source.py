@@ -32,6 +32,11 @@ def _empty() -> Series:
 
 
 class MetricSource:
+    # True => fetches block on I/O and the worker may fan a claimed
+    # batch's fetches through a thread pool; in-memory sources say False
+    # so the (single-core) worker skips pure-GIL thread overhead
+    concurrent_fetch = True
+
     def fetch(self, url: str) -> Series:  # pragma: no cover - interface
         raise NotImplementedError
 
@@ -128,6 +133,8 @@ class ReplaySource(MetricSource):
     empty series (the brain then yields UNKNOWN, not a crash).
     """
 
+    concurrent_fetch = False
+
     def __init__(self):
         self._routes: list[tuple[str, Callable[[], Series]]] = []
 
@@ -151,6 +158,8 @@ class ReplaySource(MetricSource):
 
 class StaticSource(MetricSource):
     """alias-keyed direct map (unit tests)."""
+
+    concurrent_fetch = False
 
     def __init__(self, data: Mapping[str, Series]):
         self.data = dict(data)

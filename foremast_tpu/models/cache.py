@@ -40,6 +40,32 @@ class ModelCache:
             while len(self._d) > self.max_size:
                 self._d.popitem(last=False)
 
+    def get_many(self, keys) -> list:
+        """Batched get: ONE lock acquisition for a whole tick's key list
+        (a fleet tick looks up 40k+ fit keys; a per-key lock round trip
+        is measurable on the worker's single host core). None keys (and
+        misses) yield None."""
+        with self._lock:
+            d = self._d
+            out = []
+            for k in keys:
+                if k is not None and k in d:
+                    d.move_to_end(k)
+                    out.append(d[k])
+                else:
+                    out.append(None)
+            return out
+
+    def put_many(self, items) -> None:
+        """Batched put of (key, value) pairs under one lock."""
+        with self._lock:
+            d = self._d
+            for k, v in items:
+                d[k] = v
+                d.move_to_end(k)
+            while len(d) > self.max_size:
+                d.popitem(last=False)
+
     def pop(self, key: Hashable) -> None:
         """Drop an entry if present (e.g. warmup fits that must not
         occupy real capacity)."""

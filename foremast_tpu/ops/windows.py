@@ -29,11 +29,14 @@ class MetricWindows:
             would collapse adjacent 60 s samples. Carried for anomaly
             reporting (the reference returns flat [t1,v1,t2,v2,...] pairs —
             foremast-barrelman `pkg/controller/Barrelman.go:593-620`).
+            May be None (`from_ragged(..., device_times=False)`): no
+            compiled program consumes times, and the shipped judge skips
+            the upload entirely.
     """
 
     values: jax.Array
     mask: jax.Array
-    times: jax.Array
+    times: jax.Array | None
 
     @property
     def batch_shape(self):
@@ -49,12 +52,20 @@ class MetricWindows:
 
     @staticmethod
     def from_ragged(
-        series: Sequence[tuple[np.ndarray, np.ndarray]], length: int | None = None
+        series: Sequence[tuple[np.ndarray, np.ndarray]],
+        length: int | None = None,
+        device_times: bool = True,
     ) -> "MetricWindows":
         """Pack a list of (times, values) ragged series into one padded batch.
 
         Host-side helper (numpy): used by the dispatcher when packing pending
         jobs into fixed-shape batches (bucketing bounds recompiles).
+
+        `device_times=False` skips uploading the packed times (times=None):
+        no compiled scoring program reads them — anomaly timestamps are
+        decoded on the host from each task's own ragged times — and the
+        [B, T] int32 upload is pure tunnel bandwidth on the fleet tick.
+        None is a valid empty pytree, so jit/sharding treewalks skip it.
         """
         if length is None:
             length = max((len(v) for _, v in series), default=1)
@@ -76,7 +87,9 @@ class MetricWindows:
                 times[i, :n] = np.asarray(t, dtype=np.int64)[:n].astype(np.int32)
                 mask[i, :n] = True
         return MetricWindows(
-            values=jnp.asarray(values), mask=jnp.asarray(mask), times=jnp.asarray(times)
+            values=jnp.asarray(values),
+            mask=jnp.asarray(mask),
+            times=jnp.asarray(times) if device_times else None,
         )
 
 
