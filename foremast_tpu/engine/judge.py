@@ -104,24 +104,15 @@ class MetricVerdict:
     dist_differs: bool
 
 
-# Fits whose cost scales with history length (sequential scans, or a
-# full-history read a warm tick can skip shipping): caching their
-# terminal state pays. The plain moving averages are cheaper than the
-# cache round trip.
-EXPENSIVE_FITS = frozenset(
-    {
-        "ewma",
-        "exponential_smoothing",
-        "double_exponential_smoothing",
-        "holtwinters",
-        "holt_winters",
-        "phase_means",
-        "auto_univariate",
-        "seasonal",
-        "prophet",
-        "seasonal_hourly",
-    }
-)
+# Every algorithm caches its terminal state when a fit_key is present —
+# including the plain moving averages. Round 3 exempted them ("cheaper
+# than the cache round trip"), which was true of the fit FLOPs but
+# ignored what the cache actually saves on the shipped path: packing and
+# re-uploading the [B, 10080] history every re-check tick. Measured over
+# the TPU tunnel the history upload dominates the warm tick by orders of
+# magnitude (H2D degrades to tens of MB/s mid-stream — BENCHMARKS.md
+# worker-tick notes), so a cached MA fit turns a ~200 MB/tick upload
+# into a [B] index gather.
 
 
 # Fits whose horizon depends on trend or seasonal phase: only these need
@@ -201,6 +192,57 @@ def _gap_steps(tasks: Sequence[MetricTask]) -> np.ndarray:
 # Empty padding row for batch-axis bucketing: zero windows everywhere
 # (verdict UNKNOWN, dropped on decode); the constant fit key means the
 # empty-history "fit" caches once, so padded warm ticks stay fit-free.
+@jax.jit
+def _compact_min(verdict, anoms):
+    """Minimal result for hook-less columnar ticks: verdicts + bit-packed
+    anomaly flags only — nothing else leaves the device."""
+    return verdict.astype(jnp.int8), jnp.packbits(anoms, axis=1)
+
+
+@jax.jit
+def _compact_result_nopair(verdict, anoms, upper, lower, nidx):
+    """_compact_result without the pairwise outputs — the columnar warm
+    path serves baseline-less re-checks, where (p=1.0, differs=False)
+    are compile-time constants the host fills itself."""
+    b = verdict.shape[0]
+    ar = jnp.arange(b)
+    return (
+        verdict.astype(jnp.int8),
+        jnp.packbits(anoms, axis=1),
+        upper[ar, nidx],
+        lower[ar, nidx],
+    )
+
+
+@jax.jit
+def _compact_result(verdict, anoms, upper, lower, p, differs, nidx):
+    """Shrink a ScoreResult for the device->host hop (band_mode="last").
+
+    The worker's only band consumer is the gauge exporter, which
+    publishes the band's LAST point per metric (observe/gauges.py hook:
+    `v.upper[-1]`); fetching the full [B, Tc] f32 bands plus the [B, Tc]
+    bool anomaly map was the single largest warm-tick cost over the
+    tunnel (~60% of wall-clock at fleet batch). This trivial postlude
+    returns int8 verdicts, bit-packed anomaly flags, and the per-row
+    last-valid band values — ~15x fewer D2H bytes, one device_get.
+    """
+    b = verdict.shape[0]
+    ar = jnp.arange(b)
+    return (
+        verdict.astype(jnp.int8),
+        jnp.packbits(anoms, axis=1),
+        upper[ar, nidx],
+        lower[ar, nidx],
+        p,
+        differs,
+    )
+
+
+# Columnar-path padding: a zero terminal-state entry (n_hist=0 =>
+# UNKNOWN, dropped on decode) under one shared arena key.
+_PAD_ENTRY = (0.0, 0.0, np.zeros(1, np.float32), 0, 0.0, 0)
+_PAD_COL_KEY = "__pad__col__"
+
 _PAD_TASK = MetricTask(
     job_id="__pad__",
     alias="__pad__",
@@ -225,15 +267,19 @@ class HealthJudge:
     def __init__(self, config: BrainConfig | None = None):
         self.config = config or BrainConfig()
         self.fit_cache = None
-        # Device-resident stacked terminal state, keyed by the ordered
-        # tuple of fit-cache keys: re-check ticks re-claim the same job
-        # set, and at the daily season width the [B, 1440] season stack
-        # is ~25 MB of host restacking + upload per tick — measured 1.7 s
-        # -> 0.9 s warm ticks at B=4096 when reused. Small LRU: one entry
-        # per distinct concurrently-live claim set.
-        from foremast_tpu.models.cache import ModelCache
-
-        self._state_stacks = ModelCache(4)
+        # "full": MetricVerdict.upper/lower carry the whole band over the
+        # current window (direct API users, tests, UI shaping).
+        # "last": only the final band point crosses the tunnel (as a
+        # length-1 array, so `v.upper[-1]` consumers work unchanged) and
+        # anomaly flags cross bit-packed — the worker's fleet-tick mode.
+        self.band_mode = "full"
+        # Device-resident state arenas (engine.arena.StateArena), one per
+        # (algorithm, season) the judge has scored: warm rows are
+        # gathered ON DEVICE by row index, so re-check ticks ship zero
+        # state bytes and a churned claim set re-uploads only its changed
+        # rows (round 3's whole-claim-set restack keyed on the ordered
+        # fit-key tuple paid ~25 MB/tick on ANY churn).
+        self._arenas: dict = {}
 
     def judge(self, tasks: Sequence[MetricTask]) -> list[MetricVerdict]:
         """Score a set of metric tasks, batching same-shaped buckets."""
@@ -273,6 +319,43 @@ class HealthJudge:
         """Device-placement hook — identity here (default device);
         parallel.ShardedJudge overrides it to shard over the mesh."""
         return batch
+
+    def _arena_for(self, m_need: int):
+        """The (algorithm, season) arena, grown to season width m_need.
+
+        Widening (a later batch carrying a longer season buffer than any
+        before) rebuilds the arena empty; host fit-cache entries persist,
+        so the next assign simply re-scatters what it needs. Returns None
+        when arenas are disabled (FOREMAST_ARENA_BYTES=0)."""
+        from foremast_tpu.engine.arena import StateArena, _arena_bytes
+
+        if _arena_bytes() <= 0:
+            return None
+        key = (self.config.algorithm, self.config.season_steps)
+        arena = self._arenas.get(key)
+        if arena is None or arena.m < m_need:
+            arena = StateArena(m_need)
+            self._arenas[key] = arena
+        return arena
+
+    def clear_device_state(self) -> None:
+        """Release every arena's device buffers (e.g. after warmup: the
+        synthetic rows must not occupy HBM). The host fit cache is
+        untouched — rows repopulate lazily on the next tick."""
+        for arena in self._arenas.values():
+            arena.clear()
+        self._arenas.clear()
+
+    def device_state_counters(self) -> dict:
+        """Aggregated arena hit/miss/eviction counters (worker
+        self-telemetry; VERDICT r3 asked for the churn cost to be
+        observable rather than silent)."""
+        agg = {"hits": 0, "misses": 0, "evictions": 0, "rows_live": 0}
+        for arena in self._arenas.values():
+            c = arena.counters()
+            for k in agg:
+                agg[k] += c[k]
+        return agg
 
     def _score_with_fit_cache(
         self, batch: scoring.ScoreBatch, tasks: list[MetricTask], th: int
@@ -343,37 +426,12 @@ class HealthJudge:
                     puts.append((keys[i], entry))
             if puts:
                 self.fit_cache.put_many(puts)
-        # Season buffers may mix lengths within one batch: auto fits on a
-        # history shorter than two cycles return the mean model's [1] zero
-        # buffer (scoring.tile_season documents why tiling is exact).
-        # The stacked device arrays are reusable across ticks only when
-        # EVERY row came from the cache (unkeyed rows always land in
-        # `miss`, and entry refreshes always go through the miss path —
-        # either skips the reuse).
-        stack_key = tuple(keys) if not miss else None
-        stacked = self._state_stacks.get(stack_key) if stack_key else None
-        if stacked is None:
-            m = max(len(e[2]) for e in entries)
-            stacked = (
-                jnp.asarray([e[0] for e in entries], jnp.float32),
-                jnp.asarray([e[1] for e in entries], jnp.float32),
-                jnp.asarray(
-                    np.stack([scoring.tile_season(e[2], m) for e in entries])
-                ),
-                jnp.asarray([e[3] for e in entries], jnp.int32),
-                jnp.asarray([e[4] for e in entries], jnp.float32),
-                jnp.asarray([e[5] for e in entries], jnp.int32),
-            )
-            if stack_key:
-                self._state_stacks.put(stack_key, stacked)
-        return scoring.score_from_state(
-            batch,
-            *stacked,
-            gap_steps=(
-                jnp.asarray(_gap_steps(tasks))
-                if cfg.algorithm in GAP_SENSITIVE_FITS
-                else None
-            ),
+        gap = (
+            jnp.asarray(_gap_steps(tasks))
+            if cfg.algorithm in GAP_SENSITIVE_FITS
+            else None
+        )
+        pw = dict(
             pairwise_algorithm=cfg.pairwise.algorithm,
             p_threshold=cfg.pairwise.threshold,
             min_mw=cfg.pairwise.min_mann_white_points,
@@ -381,12 +439,170 @@ class HealthJudge:
             min_kruskal=cfg.pairwise.min_kruskal_points,
             min_friedman=cfg.pairwise.min_friedman_points,
         )
+        return self._arena_score(batch, keys, entries, miss, gap, pw)
+
+    def _arena_score(self, batch, keys, entries, force, gap, pw):
+        """Arena-gathered judgment shared by the object and columnar
+        paths: assign rows, widen-rebuild if a scattered row carries a
+        longer season buffer than the arena was built for, scatter the
+        changed rows, and score via on-device gather. Falls back to a
+        one-off host stack when arenas are disabled or the batch exceeds
+        the byte budget.
+
+        Season buffers may mix lengths within one batch: auto fits on a
+        history shorter than two cycles return the mean model's [1]
+        zero buffer (scoring.tile_season documents why tiling is exact);
+        the arena is sized for the widest and tiles the rest. The
+        max-width scan is O(B) host work, so on warm ticks it runs only
+        over rows actually being scattered (usually none)."""
+        cfg = self.config
+        arena = self._arenas.get((cfg.algorithm, cfg.season_steps))
+        if arena is None:
+            arena = self._arena_for(max(len(e[2]) for e in entries))
+        if arena is not None:
+            assigned = arena.assign(keys, force)
+            if assigned is not None and assigned[1]:
+                m_scat = max(len(entries[i][2]) for i in assigned[1])
+                if m_scat > arena.m:
+                    # wider season than the arena was built for: rebuild
+                    # (empty) at the new width and re-assign everything
+                    arena = self._arena_for(m_scat)
+                    assigned = arena.assign(keys, force)
+                if assigned is not None and assigned[1]:
+                    arena.scatter(assigned[0], assigned[1], entries)
+            if assigned is not None:
+                return scoring.score_from_arena(
+                    batch,
+                    *arena.state,
+                    jnp.asarray(assigned[0]),
+                    gap_steps=gap,
+                    **pw,
+                )
+        # fallback (arena disabled, or batch exceeds the byte budget):
+        # one-off host stack + upload, no cross-tick device reuse
+        return self._stacked_score(batch, entries, gap, pw)
+
+    def _stacked_score(self, batch, entries, gap, pw):
+        """One-off host stack + upload of terminal state (the no-arena
+        path: FOREMAST_ARENA_BYTES=0 or a batch over the byte budget)."""
+        m = max(len(e[2]) for e in entries)
+        stacked = (
+            jnp.asarray([e[0] for e in entries], jnp.float32),
+            jnp.asarray([e[1] for e in entries], jnp.float32),
+            jnp.asarray(
+                np.stack([scoring.tile_season(e[2], m) for e in entries])
+            ),
+            jnp.asarray([e[3] for e in entries], jnp.int32),
+            jnp.asarray([e[4] for e in entries], jnp.float32),
+            jnp.asarray([e[5] for e in entries], jnp.int32),
+        )
+        return scoring.score_from_state(batch, *stacked, gap_steps=gap, **pw)
+
+    def judge_columnar(
+        self,
+        values: np.ndarray,
+        mask: np.ndarray,
+        keys: list,
+        entries: list,
+        nidx: np.ndarray,
+        thr: np.ndarray,
+        bound: np.ndarray,
+        mlb: np.ndarray,
+        gap_steps: np.ndarray | None = None,
+        with_bands: bool = True,
+    ):
+        """Columnar warm-tick scoring: arrays in, compact arrays out.
+
+        The worker's fleet fast path (jobs/worker.py _fast_tick) calls
+        this for re-check ticks where EVERY row already carries a cached
+        fit entry and no baselines exist: no MetricTask/MetricVerdict
+        objects, no ragged packing, no per-task key tuples — per-window
+        host cost is one buffer write and one dict lookup, which is what
+        lets the shipped loop approach the engine's throughput
+        (BASELINE.md's 100k windows/s is a SYSTEM number).
+
+        values/mask: [B, tc] current windows (host numpy, caller-packed);
+        keys/entries: per-row fit-cache key + terminal-state entry (pad
+        rows use the shared _PAD constants); nidx: per-row last-valid
+        index for the band-last gather; thr/bound/mlb: per-row anomaly
+        operands. Returns (verdict int8 [B], anomaly flags bool [B, tc],
+        upper_last [B], lower_last [B]); with_bands=False skips the band
+        fetch entirely (upper/lower come back as None) for callers with
+        no gauge hook.
+        """
+        cfg = self.config
+        b0, tc = values.shape
+        rows_b = bucket_length(b0)
+        if rows_b != b0:
+            pad = rows_b - b0
+            values = np.concatenate(
+                [values, np.zeros((pad, tc), np.float32)]
+            )
+            mask = np.concatenate([mask, np.zeros((pad, tc), bool)])
+            nidx = np.concatenate([nidx, np.zeros(pad, np.int32)])
+            thr = np.concatenate([thr, np.ones(pad, np.float32)])
+            bound = np.concatenate([bound, np.ones(pad, np.int32)])
+            mlb = np.concatenate([mlb, np.zeros(pad, np.float32)])
+            keys = list(keys) + [_PAD_COL_KEY] * pad
+            entries = list(entries) + [_PAD_ENTRY] * pad
+            if gap_steps is not None:
+                gap_steps = np.concatenate(
+                    [gap_steps, np.zeros(pad, np.int32)]
+                )
+        batch = scoring.ScoreBatch(
+            historical=MetricWindows(
+                values=jnp.zeros((rows_b, 0), jnp.float32),
+                mask=jnp.zeros((rows_b, 0), bool),
+                times=None,
+            ),
+            current=MetricWindows(
+                values=jnp.asarray(values), mask=jnp.asarray(mask), times=None
+            ),
+            baseline=MetricWindows(
+                values=jnp.zeros((rows_b, tc), jnp.float32),
+                mask=jnp.zeros((rows_b, tc), bool),
+                times=None,
+            ),
+            threshold=jnp.asarray(thr),
+            bound=jnp.asarray(bound),
+            min_lower_bound=jnp.asarray(mlb),
+            min_points=jnp.full((rows_b,), cfg.min_historical_points, jnp.int32),
+        )
+        batch = self._place(batch)
+        pw = dict(
+            pairwise_algorithm=cfg.pairwise.algorithm,
+            p_threshold=cfg.pairwise.threshold,
+            min_mw=cfg.pairwise.min_mann_white_points,
+            min_wilcoxon=cfg.pairwise.min_wilcoxon_points,
+            min_kruskal=cfg.pairwise.min_kruskal_points,
+            min_friedman=cfg.pairwise.min_friedman_points,
+        )
+        gap = None if gap_steps is None else jnp.asarray(gap_steps)
+        res = self._arena_score(batch, keys, entries, (), gap, pw)
+        if with_bands:
+            v8, packed, ub, lb = jax.device_get(
+                _compact_result_nopair(
+                    res.verdict,
+                    res.anomalies,
+                    res.upper,
+                    res.lower,
+                    jnp.asarray(nidx),
+                )
+            )
+            ub, lb = ub[:b0], lb[:b0]
+        else:
+            v8, packed = jax.device_get(
+                _compact_min(res.verdict, res.anomalies)
+            )
+            ub = lb = None
+        anoms = np.unpackbits(packed, axis=1, count=tc)
+        return v8[:b0], anoms[:b0], ub, lb
 
     def _judge_bucket(
         self, tasks: list[MetricTask], th: int, tc: int
     ) -> list[MetricVerdict]:
         cfg = self.config
-        use_cache = self.fit_cache is not None and cfg.algorithm in EXPENSIVE_FITS
+        use_cache = self.fit_cache is not None
         cur = MetricWindows.from_ragged(
             [(t.cur_times, t.cur_values) for t in tasks], tc, device_times=False
         )
@@ -458,23 +674,44 @@ class HealthJudge:
                 min_kruskal=cfg.pairwise.min_kruskal_points,
                 min_friedman=cfg.pairwise.min_friedman_points,
             )
-        # ONE overlapped device->host fetch for all six result arrays:
-        # a bare np.asarray per jax.Array issues a synchronous round trip
-        # PER ARRAY, and over the TPU tunnel each such round trip carries
-        # a fixed latency in the hundreds of ms (measured: sequential
+        # ONE overlapped device->host fetch for all result arrays: a bare
+        # np.asarray per jax.Array issues a synchronous round trip PER
+        # ARRAY, and over the TPU tunnel each such round trip carries a
+        # fixed latency in the hundreds of ms (measured: sequential
         # fetches of 6 small result arrays cost 20-60x more wall-clock
         # than jax.device_get of the tuple, which starts every
         # copy_to_host_async before the first blocking read).
-        verdicts, anoms, uppers, lowers, ps, differs = jax.device_get(
-            (
-                res.verdict,
-                res.anomalies,
-                res.upper,
-                res.lower,
-                res.p_value,
-                res.dist_differs,
+        compact = self.band_mode == "last"
+        if compact:
+            nidx = np.fromiter(
+                (max(min(len(t.cur_values), tc) - 1, 0) for t in tasks),
+                np.int32,
+                count=len(tasks),
             )
-        )
+            verdicts, packed, ub, lb, ps, differs = jax.device_get(
+                _compact_result(
+                    res.verdict,
+                    res.anomalies,
+                    res.upper,
+                    res.lower,
+                    res.p_value,
+                    res.dist_differs,
+                    jnp.asarray(nidx),
+                )
+            )
+            anoms = np.unpackbits(packed, axis=1, count=tc)
+            uppers = lowers = None
+        else:
+            verdicts, anoms, uppers, lowers, ps, differs = jax.device_get(
+                (
+                    res.verdict,
+                    res.anomalies,
+                    res.upper,
+                    res.lower,
+                    res.p_value,
+                    res.dist_differs,
+                )
+            )
 
         # Decode anomaly positions for the WHOLE batch in one pass (flags
         # are sparse and already mask-gated, so padding never fires); a
@@ -484,6 +721,7 @@ class HealthJudge:
         row_start = np.searchsorted(nz_r, np.arange(len(tasks)))
         row_end = np.searchsorted(nz_r, np.arange(len(tasks)), side="right")
 
+        empty_band = np.zeros(0, np.float32)
         out = []
         for i, t in enumerate(tasks):
             n = len(t.cur_values)
@@ -497,17 +735,26 @@ class HealthJudge:
                 pairs = flat.tolist()
             else:
                 pairs = []
+            if compact:
+                # length-1 band (the last point) so `upper[-1]` consumers
+                # (the gauge exporter) work unchanged; len-0 for empty
+                # windows so the hook's measurability gate still fires
+                up = ub[i : i + 1] if n else empty_band
+                lo = lb[i : i + 1] if n else empty_band
+            else:
+                # views into the tick's result buffer (fresh per tick, so
+                # no aliasing hazard): a per-row .copy() here costs ~2 us
+                # x 40k tasks on the fleet tick's one host core
+                up = uppers[i, :n]
+                lo = lowers[i, :n]
             out.append(
                 MetricVerdict(
                     job_id=t.job_id,
                     alias=t.alias,
                     verdict=int(verdicts[i]),
                     anomaly_pairs=pairs,
-                    # views into the tick's result buffer (fresh per tick,
-                    # so no aliasing hazard): a per-row .copy() here costs
-                    # ~2 us x 40k tasks on the fleet tick's one host core
-                    upper=uppers[i, :n],
-                    lower=lowers[i, :n],
+                    upper=up,
+                    lower=lo,
                     p_value=float(ps[i]),
                     dist_differs=bool(differs[i]),
                 )

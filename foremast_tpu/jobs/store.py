@@ -87,6 +87,13 @@ class JobStore:
     def update(self, doc: Document) -> Document:
         raise NotImplementedError
 
+    def update_many(self, docs: list[Document]) -> None:
+        """Persist a batch of updated docs. Default: loop over update();
+        stores with a cheaper bulk path (one lock, one bulk request)
+        override — a fleet tick writes back thousands of docs."""
+        for doc in docs:
+            self.update(doc)
+
     def list_open(self) -> list[Document]:
         raise NotImplementedError
 
@@ -143,6 +150,13 @@ class InMemoryStore(JobStore):
             doc.modified_at = now_rfc3339()
             self._docs[doc.id] = doc
             return doc
+
+    def update_many(self, docs: list[Document]) -> None:
+        stamp = now_rfc3339()
+        with self._lock:
+            for doc in docs:
+                doc.modified_at = stamp
+                self._docs[doc.id] = doc
 
     def list_open(self):
         with self._lock:
@@ -435,6 +449,39 @@ class ElasticsearchStore(JobStore):
         )
         r.raise_for_status()
         return doc
+
+    def update_many(self, docs: list[Document]) -> None:
+        """One `_bulk` request for a whole tick's write-backs — a fleet
+        tick finalizes thousands of docs, and a PUT per doc would make
+        write-back latency scale with claim size (same rationale as the
+        two-round-trip claim). No CAS here: the docs are owned by this
+        worker's in-progress claim, and last-writer-wins matches the
+        per-doc update() semantics."""
+        if not docs:
+            return
+        import json as _json
+
+        stamp = now_rfc3339()
+        lines = []
+        for doc in docs:
+            doc.modified_at = stamp
+            lines.append(_json.dumps({"index": {"_id": doc.id}}))
+            lines.append(_json.dumps(doc.to_json()))
+        r = self._s.post(
+            self._url("_bulk"),
+            data="\n".join(lines) + "\n",
+            headers={"Content-Type": "application/x-ndjson"},
+            timeout=self.timeout,
+        )
+        r.raise_for_status()
+        body = r.json()
+        if body.get("errors"):
+            for item in body.get("items", []):
+                info = item.get("index", {})
+                if info.get("status", 200) >= 300:
+                    raise RuntimeError(
+                        f"bulk update item failed for {info.get('_id')}: {item}"
+                    )
 
     def list_open(self):
         query = {

@@ -112,7 +112,13 @@ class BrainWorker:
         claim_limit: int = 256,
         on_verdict: Callable[[Document, list[MetricVerdict]], None] | None = None,
         metrics=None,  # observe.gauges.WorkerMetrics (optional)
+        band_mode: str = "last",
     ):
+        """`band_mode` controls how much of the model band each verdict
+        carries back from the device: "last" (default — only the final
+        band point, what the built-in gauge exporter publishes; ~15x
+        fewer D2H bytes per tick) or "full" (whole [Tc] band per metric,
+        for custom on_verdict hooks that consume the band shape)."""
         self.store = store
         self.source = source
         self.config = config or BrainConfig()
@@ -154,8 +160,21 @@ class BrainWorker:
         # different config than the worker's own), or the warm-path probe
         # key would never match and every tick would refetch histories
         eff_cfg = uni.config if isinstance(uni, HealthJudge) else self.config
+        self._uni = uni if isinstance(uni, HealthJudge) else None
+        if self._uni is not None:
+            self._uni.band_mode = band_mode
+        self._eff_cfg = eff_cfg
         self._eff_algo = eff_cfg.algorithm
         self._eff_season = eff_cfg.season_steps
+        from foremast_tpu.engine.multivariate import MULTIVARIATE_ALGOS
+
+        # multivariate selectors route multi-alias jobs to joint models;
+        # only single-alias docs may take the columnar fast path then
+        self._mv = self.config.algorithm in MULTIVARIATE_ALGOS
+        # fast-path admission cache: doc.id -> (end_epoch, rowsinfo,
+        # ops); valid while the fit/gap cache versions are unchanged
+        self._admit: dict = {}
+        self._admit_token = None
         from foremast_tpu.engine.judge import GAP_SENSITIVE_FITS
 
         self._gap_sensitive = self._eff_algo in GAP_SENSITIVE_FITS
@@ -180,42 +199,77 @@ class BrainWorker:
         Entries: (aliases, end_epoch) where aliases is a list of
         (alias, cur_url, metric_type, base_url, hist_url, fit_key,
         hist_end_epoch)."""
-        meta = self._meta_cache.get(doc.id)
+        meta = self._meta_cache.peek(doc.id)
         if meta is not None:
             return meta
         cur = decode_config(doc.current_config)
         base = decode_config(doc.baseline_config)
         hist = decode_config(doc.historical_config)
         aliases = []
-        for alias, cur_url in cur.items():
+        # [3, n] = (threshold, bound, min_lower_bound) per alias — the
+        # fast tick concatenates these per-doc blocks into the batch
+        # operand vectors with one call instead of per-row lookups.
+        # Rules come from the JUDGE's effective config (eff_cfg), the
+        # same source the slow path's _judge_bucket gathers from — an
+        # injected judge with divergent anomaly rules must not produce
+        # different verdicts on warm vs cold ticks.
+        ops = np.empty((3, len(cur)), np.float32)
+        for i, (alias, cur_url) in enumerate(cur.items()):
             hist_url = hist.get(alias)
+            mtype = infer_metric_type(alias, self._eff_cfg)
+            rule = self._eff_cfg.anomaly.rule_for(mtype)
+            ops[0, i] = rule.threshold
+            ops[1, i] = rule.bound
+            ops[2, i] = rule.min_lower_bound
+            key = f"{doc.app_name}|{alias}|{hist_url}" if hist_url else None
             aliases.append(
                 (
                     alias,
                     cur_url,
-                    infer_metric_type(alias, self.config),
+                    mtype,
                     base.get(alias),
                     hist_url,
                     # immutable history => the fitted model is immutable
                     # too; key it per (app, alias, URL)
-                    f"{doc.app_name}|{alias}|{hist_url}" if hist_url else None,
+                    key,
                     _hist_end_epoch(hist_url) if hist_url else None,
+                    # the full fit-cache key, prebuilt once (the fast
+                    # path would otherwise build this tuple per row
+                    # per tick)
+                    (self._eff_algo, self._eff_season, key)
+                    if key
+                    else None,
                 )
             )
-        meta = (aliases, parse_time(doc.end_time))
+        meta = (aliases, parse_time(doc.end_time), ops)
         self._meta_cache.put(doc.id, meta)
         return meta
 
     def _fetch_tasks(self, doc: Document, now: float) -> list[MetricTask] | None:
         """Fetch every window of every alias; None => preprocess failure."""
-        aliases, _ = self._doc_meta(doc)
+        aliases, _, _ = self._doc_meta(doc)
         if not aliases:
             return None
         tasks = []
         empty_t = _EMPTY_TIMES
         empty_v = _EMPTY_VALUES
+        # the history-free warm shortcut only serves the UNIVARIATE
+        # judge: joint models (bivariate/LSTM — multi-alias docs under a
+        # multivariate selector) align histories across metrics and fit
+        # their own state, so an empty-hist task would collapse the
+        # joint fit to zero points
+        may_skip_hist = not self._mv or len(aliases) == 1
         try:
-            for alias, cur_url, mtype, base_url, hist_url, key, hist_end in aliases:
+            for (
+                alias,
+                cur_url,
+                mtype,
+                base_url,
+                hist_url,
+                key,
+                hist_end,
+                fullkey,
+            ) in aliases:
                 ct, cv = self.source.fetch(cur_url)
                 fit_key = None
                 step_kw = {}
@@ -226,8 +280,10 @@ class BrainWorker:
                     )
                     if settled:
                         fit_key = key
-                        entry = self._fit_cache.get(
-                            (self._eff_algo, self._eff_season, key)
+                        entry = (
+                            self._fit_cache.get(fullkey)
+                            if may_skip_hist
+                            else None
                         )
                         gap = (
                             self._gap_meta.get(key)
@@ -307,11 +363,19 @@ class BrainWorker:
 
     # -- postprocess: verdicts -> document status -----------------------
 
-    def _write_back(
-        self, doc: Document, verdicts: list[MetricVerdict], now: float
-    ) -> Document:
-        job_verdict = combine_verdicts(verdicts)
-        end = self._doc_meta(doc)[1]  # parsed once per doc, not per tick
+    def _decide_status(
+        self,
+        doc: Document,
+        job_verdict: int,
+        anomaly_values: dict,
+        now: float,
+        end: float,
+    ) -> None:
+        """Shared status transition for the object and columnar paths —
+        one source of truth for the reference's state machine
+        (`converter.go:13-26`, fail-fast per `design.md:43`). Mutates the
+        doc; the caller persists (per-doc update or batched
+        update_many)."""
         # a missing/unparseable endTime must not make the job immortal:
         # finalize on the first judgment instead of re-checking forever
         past_end = end <= 0 or now >= end
@@ -321,10 +385,7 @@ class BrainWorker:
             doc.status_code = "200"
             doc.reason = "anomaly detected"
             doc.anomaly_info = AnomalyInfo(
-                tags="",
-                values={
-                    v.alias: v.anomaly_pairs for v in verdicts if v.anomaly_pairs
-                },
+                tags="", values=anomaly_values
             ).to_json()
         elif past_end:
             # window closed with no anomaly: healthy unless nothing measured
@@ -338,6 +399,18 @@ class BrainWorker:
         else:
             # keep re-checking until endTime (incremental re-check loop)
             doc.status = STATUS_PREPROCESS_COMPLETED
+
+    def _write_back(
+        self, doc: Document, verdicts: list[MetricVerdict], now: float
+    ) -> Document:
+        job_verdict = combine_verdicts(verdicts)
+        end = self._doc_meta(doc)[1]  # parsed once per doc, not per tick
+        values = {}
+        if job_verdict == UNHEALTHY:
+            values = {
+                v.alias: v.anomaly_pairs for v in verdicts if v.anomaly_pairs
+            }
+        self._decide_status(doc, job_verdict, values, now, end)
         return self.store.update(doc)
 
     def warmup(self, hist_len: int = 10_080, cur_len: int = 30) -> None:
@@ -357,11 +430,8 @@ class BrainWorker:
         judged twice so the warm `score_from_state` replay compiles too,
         and the warmup fits are evicted afterwards — they must not
         occupy real cache capacity."""
-        import numpy as np
-
         from foremast_tpu.engine.judge import (
             _MIN_BUCKET,
-            EXPENSIVE_FITS,
             HealthJudge,
             bucket_length,
         )
@@ -370,12 +440,7 @@ class BrainWorker:
         # multivariate selector (auto/bivariate/lstm) rewrites it to its
         # univariate fallback (multivariate.MultivariateJudge.__init__)
         uni = getattr(self.judge, "univariate", self.judge)
-        eff_algo = (
-            uni.config.algorithm
-            if isinstance(uni, HealthJudge)
-            else self.config.algorithm
-        )
-        expensive = eff_algo in EXPENSIVE_FITS
+        eff_algo = self._eff_algo
         b_max = bucket_length(max(self.claim_limit, 1))
         rng = np.random.default_rng(0)
         t0 = int(time.time()) - 86_400 * 8
@@ -401,23 +466,271 @@ class BrainWorker:
         rows = _MIN_BUCKET
         while rows <= b_max:
             self.judge.judge(tasks[:rows])
-            if expensive:
-                self.judge.judge(tasks[:rows])  # warm replay program
+            # every algorithm caches now, so always compile the warm
+            # arena-replay program too
+            self.judge.judge(tasks[:rows])
             buckets.append(rows)
             rows *= 2
-        if expensive:
-            for i in range(b_max):
-                self._fit_cache.pop(
-                    (eff_algo, self.config.season_steps, f"__warmup__|{i}")
-                )
-            # the warm-replay passes also cached stacked device state for
-            # the warmup claim sets (~25 MB each at daily width) — release
-            if isinstance(uni, HealthJudge):
-                uni._state_stacks.clear()
+        for i in range(b_max):
+            self._fit_cache.pop(
+                (eff_algo, self._eff_season, f"__warmup__|{i}")
+            )
+        # the warm passes also scattered synthetic rows into the device
+        # arena — release the HBM; real rows repopulate on the first tick
+        if isinstance(uni, HealthJudge):
+            uni.clear_device_state()
         log.info(
             "warmup compiled batch buckets %s (Th=%d Tc=%d, algorithm=%s) in %.1fs",
             buckets, hist_len, cur_len, eff_algo, time.perf_counter() - t_start,
         )
+
+    # -- columnar fast path ---------------------------------------------
+
+    def _fast_tick(self, docs, now: float):
+        """Columnar processing of the all-warm re-check subset.
+
+        The steady state of the whole system is: a stable fleet of jobs
+        re-checked every tick against cached fits, no baselines (the
+        continuous/rollingUpdate strategies), new data only in the
+        ~30-point current windows. For that subset this path skips every
+        per-task object the slow path builds — no MetricTask, no
+        MetricVerdict (unless a hook wants them), no ragged packing, no
+        per-task cache tuples — writing current windows straight into
+        [B, tc] buffers and decoding verdicts with segment reductions.
+        Docs that don't qualify (baselines, unsettled or absent
+        histories, cold fits, joint-model routing) are returned for the
+        slow path. Returns (n_processed, slow_docs).
+
+        Admission (which docs qualify, with their entry/gap references)
+        is itself cached per doc and revalidated with one integer
+        compare: ModelCache.version changes on any fit-cache or
+        gap-anchor mutation, and doc metadata is immutable per id, so a
+        version-stable tick re-walks nothing.
+        """
+        uni = self._uni
+        fit_cache = self._fit_cache
+        gap_sensitive = self._gap_sensitive
+        token = (fit_cache.version, self._gap_meta.version)
+        admit = self._admit
+        if self._admit_token != token:
+            admit.clear()
+            self._admit_token = token
+        elif len(admit) > 8 * max(self.claim_limit, 512):
+            admit.clear()  # crude bound; repopulates from caches
+        fast = []  # (doc, end_epoch, rowsinfo, ops)
+        slow = []
+        for doc in docs:
+            cached = admit.get(doc.id)
+            if cached is not None:
+                fast.append((doc, cached[0], cached[1], cached[2]))
+                continue
+            aliases, end_epoch, ops = self._doc_meta(doc)
+            if not aliases or (self._mv and len(aliases) != 1):
+                slow.append(doc)
+                continue
+            rowsinfo = []
+            for (
+                alias,
+                cur_url,
+                mtype,
+                base_url,
+                hist_url,
+                key,
+                hist_end,
+                fullkey,
+            ) in aliases:
+                if (
+                    base_url is not None
+                    or hist_url is None
+                    or hist_end is None
+                    or hist_end > now - HIST_SETTLED_SECONDS
+                ):
+                    rowsinfo = None
+                    break
+                entry = fit_cache.peek(fullkey)
+                if entry is None:
+                    rowsinfo = None
+                    break
+                gap = None
+                if gap_sensitive:
+                    gap = self._gap_meta.peek(key)
+                    if gap is None:
+                        rowsinfo = None
+                        break
+                rowsinfo.append((alias, cur_url, fullkey, entry, gap))
+            if rowsinfo is None:
+                slow.append(doc)
+            else:
+                admit[doc.id] = (end_epoch, rowsinfo, ops)
+                fast.append((doc, end_epoch, rowsinfo, ops))
+        if not fast:
+            return 0, slow
+
+        # fetch current windows (thread pool only for blocking sources)
+        def fetch_doc(item):
+            try:
+                return [self.source.fetch(r[1]) for r in item[2]]
+            except Exception as e:
+                log.warning("preprocess failed for %s: %s", item[0].id, e)
+                return None
+
+        if len(fast) > 1 and getattr(self.source, "concurrent_fetch", True):
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(16, len(fast))) as pool:
+                series = list(pool.map(fetch_doc, fast))
+        else:
+            series = [fetch_doc(item) for item in fast]
+
+        failed = []
+        ok_items = []
+        for item, s in zip(fast, series):
+            if s is None:
+                doc = item[0]
+                doc.status = STATUS_PREPROCESS_FAILED
+                doc.status_code = "500"
+                doc.reason = "metric fetch failed"
+                self.store.update(doc)
+                failed.append(doc)
+            else:
+                ok_items.append((item, s))
+        if self.metrics:
+            for doc in failed:
+                self.metrics.observe_doc(doc.status, 0)
+        if not ok_items:
+            return len(failed), slow
+
+        # columnar fill: one [B, tc] buffer pair, no per-row objects
+        from foremast_tpu.engine.judge import bucket_length
+
+        cv_flat = [cv for _, s in ok_items for _, cv in s]
+        n_rows = len(cv_flat)
+        lens = np.fromiter((len(cv) for cv in cv_flat), np.int64, count=n_rows)
+        n_max = int(lens.max(initial=1))
+        tc = bucket_length(max(n_max, 1))
+        nidx = np.maximum(lens - 1, 0).astype(np.int32)
+        values = np.zeros((n_rows, tc), np.float32)
+        maskarr = np.zeros((n_rows, tc), bool)
+        n_min = int(lens.min(initial=0))
+        if n_min == n_max and n_min > 0:
+            # uniform window length (the common steady state): ONE
+            # C-level stack instead of a per-row assignment loop
+            values[:, :n_max] = np.stack(cv_flat)
+            maskarr[:, :n_max] = True
+        else:
+            for i, cv in enumerate(cv_flat):
+                n = min(len(cv), tc)
+                if n:
+                    values[i, :n] = cv[:n]
+                    maskarr[i, :n] = True
+        opcat = np.concatenate([item[3] for item, _ in ok_items], axis=1)
+        thr = opcat[0]
+        bnd = opcat[1].astype(np.int32)
+        mlb = opcat[2]
+        keys = [r[2] for item, s in ok_items for r in item[2]]
+        entries = [r[3] for item, s in ok_items for r in item[2]]
+        gaps = None
+        rows_meta = None
+        if gap_sensitive:
+            gaps = np.zeros(n_rows, np.int32)
+            i = 0
+            for item, s in ok_items:
+                for r, (ct, cv) in zip(item[2], s):
+                    gap = r[4]
+                    if gap is not None and len(ct):
+                        k = int(
+                            round((float(ct[0]) - gap[1]) / max(gap[0], 1.0))
+                        )
+                        gaps[i] = max(k - 1, 0)
+                    i += 1
+
+        with_bands = self.on_verdict is not None
+        v8, anoms, ub, lb = uni.judge_columnar(
+            values,
+            maskarr,
+            keys,
+            entries,
+            nidx,
+            thr,
+            bnd,
+            mlb,
+            gap_steps=gaps,
+            with_bands=with_bands,
+        )
+
+        # decode: segment reductions over per-doc row ranges
+        counts = np.fromiter(
+            (len(s) for _, s in ok_items), np.int64, count=len(ok_items)
+        )
+        starts = np.zeros(len(ok_items), np.int64)
+        np.cumsum(counts[:-1], out=starts[1:])
+        is_unh = v8 == UNHEALTHY
+        seg_unh = np.maximum.reduceat(is_unh, starts)
+        seg_min = np.minimum.reduceat(v8, starts)
+        nz_r, nz_c = np.nonzero(anoms)
+        hook = self.on_verdict
+
+        def pairs_for(r, s_local, k2):
+            lo_i = np.searchsorted(nz_r, r)
+            hi_i = np.searchsorted(nz_r, r, side="right")
+            cols = nz_c[lo_i:hi_i]
+            if not len(cols):
+                return []
+            ct, cv = s_local[k2]
+            flat = np.empty(2 * len(cols), np.float64)
+            flat[0::2] = np.asarray(ct)[cols]
+            flat[1::2] = np.asarray(cv)[cols]
+            return flat.tolist()
+
+        updated = []
+        observe = self.metrics.observe_doc if self.metrics else None
+        for j, ((doc, end_epoch, rowsinfo, _), s) in enumerate(ok_items):
+            if seg_unh[j]:
+                jv = UNHEALTHY
+            elif seg_min[j] == UNKNOWN:
+                jv = UNKNOWN
+            else:
+                jv = HEALTHY
+            a = int(starts[j])
+            values_map = {}
+            if jv == UNHEALTHY:
+                for k2 in range(len(s)):
+                    p = pairs_for(a + k2, s, k2)
+                    if p:
+                        values_map[rowsinfo[k2][0]] = p
+            self._decide_status(doc, jv, values_map, now, end_epoch)
+            updated.append(doc)
+            if observe:
+                observe(doc.status, len(s))
+            if hook:
+                vs = []
+                for k2, ((alias, _, _, _, _), (ct, cv)) in enumerate(
+                    zip(rowsinfo, s)
+                ):
+                    r = a + k2
+                    n = min(len(cv), tc)
+                    vs.append(
+                        MetricVerdict(
+                            job_id=doc.id,
+                            alias=alias,
+                            verdict=int(v8[r]),
+                            anomaly_pairs=pairs_for(r, s, k2),
+                            upper=ub[r : r + 1] if n else _EMPTY_VALUES,
+                            lower=lb[r : r + 1] if n else _EMPTY_VALUES,
+                            # baseline-less by construction (fast-path
+                            # admission): the pairwise decision is the
+                            # all-gates-failed constant
+                            p_value=1.0,
+                            dist_differs=False,
+                        )
+                    )
+                try:
+                    hook(doc, vs)
+                except Exception:
+                    log.exception("on_verdict hook failed for %s", doc.id)
+        self.store.update_many(updated)
+        return len(ok_items) + len(failed), slow
+
 
     # -- main cycle ------------------------------------------------------
 
@@ -434,6 +747,23 @@ class BrainWorker:
             if self.metrics:
                 self.metrics.tick_seconds.observe(time.perf_counter() - t0)
             return 0
+
+        # the all-warm re-check subset takes the columnar fast path;
+        # whatever it returns (cold fits, baselines, joint models,
+        # unsettled histories) flows through the object path below
+        n_fast = 0
+        if self._uni is not None:
+            n_fast, docs = self._fast_tick(docs, now)
+            if not docs:
+                if self.metrics:
+                    if hasattr(self.metrics, "observe_arena"):
+                        self.metrics.observe_arena(
+                            self._uni.device_state_counters()
+                        )
+                    self.metrics.tick_seconds.observe(
+                        time.perf_counter() - t0
+                    )
+                return n_fast
 
         # Fetch every claimed doc's windows concurrently: the fetches are
         # HTTP round trips to Prometheus (latency-bound), and a tick may
@@ -485,8 +815,12 @@ class BrainWorker:
         if self.metrics:
             for doc in failed:
                 self.metrics.observe_doc(doc.status, 0)
+            if self._uni is not None and hasattr(
+                self.metrics, "observe_arena"
+            ):
+                self.metrics.observe_arena(self._uni.device_state_counters())
             self.metrics.tick_seconds.observe(time.perf_counter() - t0)
-        return len(docs)
+        return n_fast + len(docs)
 
     def run(
         self,

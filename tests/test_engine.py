@@ -307,16 +307,30 @@ def test_fit_cache_mixed_keyed_and_unkeyed_batch():
         assert a.anomaly_pairs == b.anomaly_pairs
 
 
-def test_fit_cache_not_used_for_cheap_fits():
-    """moving_average_all is cheaper than the cache round trip: the cache
-    stays empty even when keys are present."""
+def test_fit_cache_caches_cheap_fits_too():
+    """The deployed default (moving_average_all) caches terminal state
+    like every other algorithm: the fit FLOPs are trivial, but a cached
+    fit is what lets a warm re-check tick skip packing and uploading the
+    [B, 10080] history (the dominant warm-tick cost on the shipped
+    path). Cached verdicts must equal fresh-fit verdicts exactly."""
     from foremast_tpu.models.cache import ModelCache
 
     rng = np.random.default_rng(2)
     judge = HealthJudge(BrainConfig())  # default moving_average_all
     judge.fit_cache = ModelCache(8)
-    judge.judge([_hw_task("j", rng, fit_key="app|latency|u1")])
-    assert len(judge.fit_cache) == 0
+    task = _hw_task("j", rng, spike=True, fit_key="app|latency|u1")
+    ref = HealthJudge(BrainConfig()).judge([task])
+    got1 = judge.judge([task])
+    real = [k for k in judge.fit_cache._d if k[-1] != "__pad__"]
+    assert len(real) == 1  # + the constant batch-padding entry
+    got2 = judge.judge([task])  # warm: arena replay path
+    for a, b in zip(ref, got1):
+        assert a.verdict == b.verdict
+        assert a.anomaly_pairs == b.anomaly_pairs
+        np.testing.assert_allclose(a.upper, b.upper, rtol=1e-6)
+    for a, b in zip(got1, got2):
+        assert a.verdict == b.verdict
+        assert a.anomaly_pairs == b.anomaly_pairs
 
 
 def test_worker_sets_fit_key_only_for_settled_histories():
@@ -485,11 +499,11 @@ def test_judge_buckets_batch_axis_to_bound_compiles():
     assert seen_batch_sizes == [8, 8, 8, 8]
 
 
-def test_fit_cache_device_stack_reuse_and_invalidation():
-    """Warm ticks reuse the stacked device-resident terminal state (at
-    the daily season width it is ~25 MB of restack+upload per tick);
-    any cache miss — e.g. an evicted entry — must skip the reuse, refit
-    that row, and still produce identical verdicts."""
+def test_fit_cache_arena_reuse_and_invalidation():
+    """Warm ticks gather device-resident arena rows (zero state upload);
+    any fit-cache miss — e.g. an evicted entry — must refit that row and
+    force-scatter it over the stale device row, producing identical
+    verdicts."""
     from foremast_tpu.models.cache import ModelCache
 
     rng = np.random.default_rng(9)
@@ -500,15 +514,61 @@ def test_fit_cache_device_stack_reuse_and_invalidation():
         _hw_task(f"j{i}", rng, spike=(i == 2), fit_key=f"a{i}|m|u{i}")
         for i in range(4)
     ]
-    ref = [v.verdict for v in judge.judge(tasks)]  # cold: fills fit cache
-    warm = [v.verdict for v in judge.judge(tasks)]  # builds device stack
-    assert len(judge._state_stacks) == 1
-    again = [v.verdict for v in judge.judge(tasks)]  # reuses it
+    ref = [v.verdict for v in judge.judge(tasks)]  # cold: fit + scatter
+    (arena,) = judge._arenas.values()
+    rows_after_cold = dict(arena.rows)
+    scattered_cold = arena.misses
+    warm = [v.verdict for v in judge.judge(tasks)]  # pure gather
+    assert arena.misses == scattered_cold  # nothing re-scattered
+    assert arena.rows == rows_after_cold  # stable row assignment
+    again = [v.verdict for v in judge.judge(tasks)]
     assert ref == warm == again
     assert ref[2] == UNHEALTHY and ref[0] == HEALTHY
+    hits_before = arena.hits
 
-    # evict one entry: the next tick MUST take the miss path (stale
-    # stacked state would be wrong if the refit differed) and match
+    # evict one entry: the next tick MUST refit that row and overwrite
+    # the stale device row (a silent gather of it would be wrong if the
+    # refit differed), while the other rows stay warm gathers
     judge.fit_cache.pop((cfg.algorithm, cfg.season_steps, "a1|m|u1"))
     after = [v.verdict for v in judge.judge(tasks)]
     assert after == ref
+    assert arena.misses == scattered_cold + 1  # exactly the evicted row
+    assert arena.hits > hits_before  # the rest were gathers
+
+
+def test_arena_churn_rescatters_only_changed_rows():
+    """VERDICT r3 item 3: a churned claim set (jobs finishing/arriving,
+    claim-order jitter) must re-upload only the CHANGED rows — round 3's
+    ordered-tuple stack key silently re-paid the full restack on any
+    churn. Also pins verdict correctness under rotation + reordering."""
+    from foremast_tpu.models.cache import ModelCache
+
+    rng = np.random.default_rng(11)
+    cfg = BrainConfig(algorithm="holt_winters", season_steps=24)
+    judge = HealthJudge(cfg)
+    judge.fit_cache = ModelCache(64)
+    tasks = [
+        _hw_task(f"j{i}", rng, spike=(i == 2), fit_key=f"a{i}|m|u{i}")
+        for i in range(10)
+    ]
+    ref = {v.job_id: v.verdict for v in judge.judge(tasks)}
+    (arena,) = judge._arenas.values()
+    base_misses = arena.misses
+
+    # 10% churn: one job leaves, one arrives, order shuffles
+    newcomer = _hw_task("j10", rng, fit_key="a10|m|u10")
+    churned = tasks[1:] + [newcomer]
+    rng.shuffle(churned)
+    got = {v.job_id: v.verdict for v in judge.judge(churned)}
+    # ONLY the newcomer's row was scattered (plus nothing for survivors)
+    assert arena.misses == base_misses + 1
+    for t in tasks[1:]:
+        assert got[t.job_id] == ref[t.job_id]
+    assert got["j10"] == HEALTHY
+
+    # the departed job's row still exists until evicted by pressure;
+    # re-claiming it later is a pure gather, not a refit
+    before = arena.misses
+    got2 = {v.job_id: v.verdict for v in judge.judge(tasks)}
+    assert arena.misses == before
+    assert got2 == ref

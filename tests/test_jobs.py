@@ -577,7 +577,7 @@ def test_worker_warmup_precompiles_without_polluting_caches():
     worker.warmup(hist_len=256, cur_len=10)  # CPU-sized shapes
     assert len(worker._fit_cache) == 0
     uni = worker.judge.univariate
-    assert len(uni._state_stacks) == 0  # device stacks released too
+    assert uni._arenas == {}  # device arena HBM released too
     assert store.list_open() == []  # nothing written anywhere
 
     # real work still flows after warmup
