@@ -349,6 +349,175 @@ def joint_clean_false_alarms(b: int, th: int, tc: int) -> tuple[int, int]:
 JOINT_SCENARIOS = ("bivariate", "lstm", "lstm-break")
 
 
+# -- mixed univariate + joint WORKER tick (VERDICT r4 #5) --------------------
+
+
+def _unspike(cur: np.ndarray, truth: np.ndarray, kind: str) -> np.ndarray:
+    """Exact clean twin of a generated current window: the injection
+    constants are known, so subtracting them at the truth positions
+    reconstructs the pre-spike draw bit for bit."""
+    clean = cur.copy()
+    if kind == "bivariate":
+        # gen_correlated_pair: x +2.5*0.2, y -2.5*0.3 at truth
+        for i in range(cur.shape[0]):
+            clean[i, 0, truth[i]] -= 2.5 * 0.2
+            clean[i, 1, truth[i]] += 2.5 * 0.3
+    elif kind == "lstm":
+        for i in range(cur.shape[0]):
+            clean[i, :, truth[i]] -= 0.6
+    else:  # univariate kinds ([B, 1, Tc]): SPIKE_SIGMA * NOISE at truth
+        view = clean[:, 0, :]
+        view[truth] -= SPIKE_SIGMA * NOISE
+    return clean
+
+
+def mixed_fleet_tick(per_uni: int, per_joint: int, th: int, tc: int,
+                     seed: int = 0):
+    """One WORKER claim set mixing every univariate shape AND joint jobs.
+
+    The production condition no prior round tested: a single
+    `BrainWorker.tick` under the `auto` multivariate selector carries
+    single-alias docs (routed to the univariate fallback — and, when
+    warm, the columnar fast path) NEXT TO 2-alias bivariate and 4-alias
+    LSTM-hybrid docs (routed to joint models on the slow path).
+
+    Tick 1 runs CLEAN currents (everything healthy — fits + model
+    caches warm up); anomalies are then injected into the current
+    windows and tick 2 judges the whole mixed fleet warm. Per-kind
+    point F1 is computed from the persisted anomaly_info, and one clean
+    doc per kind must stay healthy through both ticks (the
+    cross-contamination guard). Returns {kind: (f1, n_docs)} plus the
+    false-alarm count."""
+    import dataclasses
+
+    from foremast_tpu.config import BrainConfig
+    from foremast_tpu.jobs.models import (
+        STATUS_COMPLETED_UNHEALTH,
+        STATUS_PREPROCESS_COMPLETED,
+        Document,
+    )
+    from foremast_tpu.jobs.store import InMemoryStore
+    from foremast_tpu.jobs.worker import BrainWorker
+    from foremast_tpu.metrics.source import MetricSource
+
+    _register_models()
+
+    class _Src(MetricSource):
+        concurrent_fetch = False
+
+        def __init__(self):
+            self.data = {}
+
+        def fetch(self, url):
+            return self.data[url]
+
+    store, source = InMemoryStore(), _Src()
+    t0 = 1_700_000_000
+    ht = t0 + 60 * np.arange(th, dtype=np.int64)
+    ct = t0 + 60 * (th + np.arange(tc, dtype=np.int64))
+    now = float(ct[-1]) + 600.0  # hist settled, endTime still ahead
+    end_time = str(int(now) + 3600)
+
+    uni_kinds = ("flat", "seasonal", "trend", "shift", "sharp-seasonal")
+    fleets = {}  # kind -> (cur_clean [B,F,Tc], cur_spiked, truth [B,Tc])
+    for j, kind in enumerate(uni_kinds):
+        h, c, tr = gen(kind, per_uni + 1, th, tc, seed=seed + j)
+        fleets[kind] = (h[:, None, :], c[:, None, :], tr)
+    hb, cb, trb = gen_correlated_pair(per_joint + 1, th, tc, seed=seed + 7)
+    fleets["bivariate"] = (hb, cb, trb)
+    hl, cl, trl = gen_joint_lstm(per_joint + 1, 4, th, tc, seed=seed + 8)
+    fleets["lstm"] = (hl, cl, trl)
+
+    doc_kind = {}
+    doc_truth = {}
+    clean_docs = set()
+    for kind, (hist, cur, truth) in fleets.items():
+        b, f, _ = hist.shape
+        clean = _unspike(
+            cur, truth,
+            kind if kind in ("bivariate", "lstm") else "uni",
+        )
+        for i in range(b):
+            doc_id = f"{kind}-{i}"
+            cur_parts, hist_parts = [], []
+            for m in range(f):
+                cu = f"http://prom/cur?q=m{m}:{doc_id}&step=60"
+                hu = (
+                    f"http://prom/hist?q=m{m}:{doc_id}"
+                    f"&end={int(ht[-1]) + 60}&step=60"
+                )
+                source.data[cu] = (ct, clean[i, m])
+                source.data[hu] = (ht, hist[i, m])
+                cur_parts.append(f"m{m}== {cu}")
+                hist_parts.append(f"m{m}== {hu}")
+            store.create(
+                Document(
+                    id=doc_id,
+                    app_name=doc_id,
+                    end_time=end_time,
+                    current_config=" ||".join(cur_parts),
+                    historical_config=" ||".join(hist_parts),
+                    strategy="continuous",
+                )
+            )
+            doc_kind[doc_id] = kind
+            if i == b - 1:
+                clean_docs.add(doc_id)  # stays clean on tick 2
+            else:
+                doc_truth[doc_id] = truth[i]
+
+    cfg = BrainConfig(algorithm="auto", season_steps=PERIOD)
+    cfg = dataclasses.replace(
+        cfg, anomaly=dataclasses.replace(cfg.anomaly, threshold=4.0, rules=())
+    )
+    n_docs = len(doc_kind)
+    worker = BrainWorker(
+        store, source, config=cfg, claim_limit=n_docs, worker_id="mix-w"
+    )
+    assert worker.tick(now=now) == n_docs
+    healthy_after_1 = sum(
+        1 for d in store._docs.values()
+        if d.status == STATUS_PREPROCESS_COMPLETED
+    )
+    assert healthy_after_1 == n_docs, (
+        f"tick 1 must be all-healthy, got {healthy_after_1}/{n_docs}"
+    )
+
+    # inject the anomalies for the warm mixed tick
+    for kind, (hist, cur, truth) in fleets.items():
+        b, f, _ = hist.shape
+        for i in range(b - 1):  # last doc per kind stays clean
+            doc_id = f"{kind}-{i}"
+            for m in range(f):
+                cu = f"http://prom/cur?q=m{m}:{doc_id}&step=60"
+                source.data[cu] = (ct, cur[i, m])
+    assert worker.tick(now=now + 60) == n_docs
+
+    tp = {k: 0 for k in fleets}
+    fp = dict(tp)
+    fn = dict(tp)
+    false_alarms = 0
+    for doc_id, kind in doc_kind.items():
+        doc = store._docs[doc_id]
+        if doc_id in clean_docs:
+            if doc.status != STATUS_PREPROCESS_COMPLETED:
+                false_alarms += 1
+            continue
+        truth = doc_truth[doc_id]
+        want = {float(t) for t, is_a in zip(ct, truth) if is_a}
+        got = set()
+        if doc.status == STATUS_COMPLETED_UNHEALTH:
+            for pairs in doc.anomaly_info["values"].values():
+                got.update(pairs[0::2])
+        tp[kind] += len(got & want)
+        fp[kind] += len(got - want)
+        fn[kind] += len(want - got)
+    by_kind = {
+        k: (prf1(tp[k], fp[k], fn[k])[2], tp[k] + fn[k]) for k in fleets
+    }
+    return by_kind, false_alarms
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--small", action="store_true")
@@ -414,6 +583,27 @@ def main(argv=None):
                 "precision": round(mp, 3),
                 "recall": round(mr, 3),
                 "per_kind_f1": by_kind,
+            }
+        ),
+        flush=True,
+    )
+    # mixed WORKER tick: every univariate shape + bivariate + LSTM jobs
+    # in ONE claim set under the `auto` selector (VERDICT r4 #5)
+    mixed_by_kind, mixed_fa = mixed_fleet_tick(
+        4 if args.small else 12,
+        3 if args.small else 8,
+        th,
+        tc,
+    )
+    print(
+        json.dumps(
+            {
+                "scenario": "mixed-worker-tick",
+                "algorithm": "auto",
+                "per_kind_f1": {
+                    k: round(v[0], 3) for k, v in mixed_by_kind.items()
+                },
+                "clean_doc_false_alarms": mixed_fa,
             }
         ),
         flush=True,

@@ -197,70 +197,121 @@ def cmd_worker(args: argparse.Namespace) -> int:
     # claims, warmup, checkpoint, takeovers — are info-level)
     native.ensure_built()  # startup-time compile, never in the hot path
     config = BrainConfig.from_env()
-    store = _make_store(args.elastic_url)
 
     from foremast_tpu.engine.multivariate import MultivariateJudge
 
     univariate = None
+    pod_mode = False
     if args.sharded:
         from foremast_tpu.parallel import ShardedJudge, init_distributed, make_global_mesh
 
         # MUST run before any jax computation — including an orbax restore
         init_distributed()  # no-op single-host; JAX_COORDINATOR_* envs for pods
         univariate = ShardedJudge(config, mesh=make_global_mesh())
+        import jax as _jax_sh
+
+        pod_mode = _jax_sh.process_count() > 1
     judge = MultivariateJudge(config, univariate=univariate)
 
+    if pod_mode:
+        # followers never dial ES/Prometheus: only the leader needs
+        # credentials and reachability (docs/operations.md pod mode)
+        import jax as _jax_pm
+
+        store = (
+            _make_store(args.elastic_url)
+            if _jax_pm.process_index() == 0
+            else None
+        )
+    else:
+        store = _make_store(args.elastic_url)
+
     ckpt_path = None
+    ckpt_save = None
     if args.model_cache_dir:
+        import os as _os
+
         import jax as _jax
 
         if _jax.process_count() > 1:
-            # orbax save/restore is a cross-process collective; each host's
-            # cache is independent (shared-nothing job claims), so a shared
-            # checkpoint would both collide and deadlock the idle barrier
-            print(
-                "model-cache checkpointing disabled under multi-host "
-                "(per-host caches stay in memory)",
-                file=sys.stderr,
+            # Per-host checkpoint files (VERDICT r4 #1): each host's cache
+            # is independent state (shared-nothing job claims), and
+            # orbax's save/restore is a cross-process collective whose
+            # sync barrier would deadlock hosts checkpointing at
+            # different tick cadences — so every host writes its own
+            # host-local pickle via ModelCache.save_local.
+            ckpt_path = _os.path.abspath(
+                _os.path.join(
+                    args.model_cache_dir,
+                    f"model_cache.host{_jax.process_index()}",
+                )
             )
+            ckpt_save = judge.cache.save_local
+            ckpt_load = judge.cache.load_local
         else:
             import ast
-            import os as _os
 
             ckpt_path = _os.path.abspath(
                 _os.path.join(args.model_cache_dir, "model_cache")
             )
-            if _os.path.exists(ckpt_path):
-                try:
-                    n = judge.cache.load(ckpt_path, key_parser=ast.literal_eval)
-                    print(
-                        f"restored {n} cached models from {ckpt_path}",
-                        file=sys.stderr,
-                    )
-                except Exception as e:  # noqa: BLE001 - stale/corrupt checkpoint
-                    print(
-                        f"model-cache restore failed ({e}); starting cold",
-                        file=sys.stderr,
-                    )
+            ckpt_save = judge.cache.save
+
+            def ckpt_load(path):
+                return judge.cache.load(path, key_parser=ast.literal_eval)
+
+        if _os.path.exists(ckpt_path):
+            try:
+                n = ckpt_load(ckpt_path)
+                print(
+                    f"restored {n} cached models from {ckpt_path}",
+                    file=sys.stderr,
+                )
+            except Exception as e:  # noqa: BLE001 - stale/corrupt checkpoint
+                print(
+                    f"model-cache restore failed ({e}); starting cold",
+                    file=sys.stderr,
+                )
 
     on_verdict = None
     worker_metrics = None
-    if args.gauge_port:
+    # pod mode: telemetry is leader-only — every process executes the
+    # full tick over the IDENTICAL broadcast fleet, so follower gauges
+    # would multiply all job/verdict/arena counts by process_count
+    leader = store is not None if pod_mode else True
+    if args.gauge_port and leader:
         from foremast_tpu.observe.gauges import WorkerMetrics
 
         gauges = BrainGauges()
         worker_metrics = WorkerMetrics()
         start_metrics_server(args.gauge_port)
         on_verdict = make_verdict_hook(gauges)
-    worker = BrainWorker(
-        store,
-        PrometheusSource(),
-        config=config,
-        judge=judge,
-        claim_limit=args.claim_limit,
-        on_verdict=on_verdict,
-        metrics=worker_metrics,
-    )
+    if pod_mode:
+        # One logical worker spanning the jax.distributed cluster: the
+        # leader claims/fetches/writes, everything is broadcast, the
+        # judgment runs SPMD over the global mesh. Plain BrainWorkers
+        # must NOT share a global mesh — each would claim different
+        # docs into one SPMD program (docs/operations.md runbook).
+        from foremast_tpu.parallel import LeaderSource, LeaderStore, PodWorker
+
+        worker = PodWorker(
+            LeaderStore(store),
+            LeaderSource(PrometheusSource() if store is not None else None),
+            config=config,
+            judge=judge,
+            claim_limit=args.claim_limit,
+            on_verdict=on_verdict,
+            metrics=worker_metrics,
+        )
+    else:
+        worker = BrainWorker(
+            store,
+            PrometheusSource(),
+            config=config,
+            judge=judge,
+            claim_limit=args.claim_limit,
+            on_verdict=on_verdict,
+            metrics=worker_metrics,
+        )
 
     after_tick = None
     if ckpt_path:
@@ -272,7 +323,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
             if n > 0:
                 _state["dirty"] = True
             elif _state["dirty"]:
-                judge.cache.save(ckpt_path)
+                ckpt_save(ckpt_path)
                 _state["dirty"] = False
 
     # graceful pod shutdown: k8s sends SIGTERM; finish the in-flight tick
@@ -297,7 +348,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
         after_tick=after_tick,
     )
     if ckpt_path and len(judge.cache):
-        judge.cache.save(ckpt_path)  # final checkpoint on the way out
+        ckpt_save(ckpt_path)  # final checkpoint on the way out
     return 0
 
 

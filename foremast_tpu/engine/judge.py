@@ -13,6 +13,7 @@ flat `[t1, v1, t2, v2, ...]` pairs (decoded by the Go side at
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Sequence
 
 import jax
@@ -22,6 +23,8 @@ import numpy as np
 from foremast_tpu.config import BrainConfig
 from foremast_tpu.engine import scoring
 from foremast_tpu.ops.windows import MetricWindows
+
+log = logging.getLogger("foremast_tpu.judge")
 
 # Bucket window lengths to powers of two >= 8 so XLA compiles a handful of
 # shapes total, not one per ragged job (SURVEY.md "hard parts" (b)).
@@ -200,6 +203,16 @@ def _compact_min(verdict, anoms):
 
 
 @jax.jit
+def _compact_full_nopair(verdict, anoms, upper, lower):
+    """Columnar result with FULL [B, Tc] bands (band_mode="full"): only
+    the verdict/anomaly compaction is applied; hooks that consume the
+    band shape get the same band the object path's "full" mode carries
+    (ADVICE r4: the fast path must not silently truncate bands once fits
+    warm up)."""
+    return verdict.astype(jnp.int8), jnp.packbits(anoms, axis=1), upper, lower
+
+
+@jax.jit
 def _compact_result_nopair(verdict, anoms, upper, lower, nidx):
     """_compact_result without the pairwise outputs — the columnar warm
     path serves baseline-less re-checks, where (p=1.0, differs=False)
@@ -280,6 +293,17 @@ class HealthJudge:
         # rows (round 3's whole-claim-set restack keyed on the ordered
         # fit-key tuple paid ~25 MB/tick on ANY churn).
         self._arenas: dict = {}
+        # Counters of arenas retired by clear_device_state / widen
+        # rebuilds: device_state_counters() stays MONOTONE across arena
+        # lifetimes so the gauge exporter never needs a re-baseline
+        # heuristic (ADVICE r4: the heuristic dropped or double-counted
+        # events around rebuilds).
+        self._counters_base = {
+            "hits": 0,
+            "misses": 0,
+            "evictions": 0,
+            "fallbacks": 0,
+        }
 
     def judge(self, tasks: Sequence[MetricTask]) -> list[MetricVerdict]:
         """Score a set of metric tasks, batching same-shaped buckets."""
@@ -334,26 +358,52 @@ class HealthJudge:
         key = (self.config.algorithm, self.config.season_steps)
         arena = self._arenas.get(key)
         if arena is None or arena.m < m_need:
-            arena = StateArena(m_need)
+            if arena is not None:
+                self._retire_counters(arena)
+            arena = StateArena(m_need, sharding=self._arena_sharding())
             self._arenas[key] = arena
         return arena
+
+    def _arena_sharding(self):
+        """Placement for arena device buffers — None (default device)
+        here; ShardedJudge replicates over its mesh so the warm gather
+        never crosses devices (deliberate choice, VERDICT r4 weak #4)."""
+        return None
+
+    def _fetch(self, tree):
+        """Device->host fetch for result decode — one overlapped
+        device_get; ShardedJudge under multi-controller overrides this
+        with a process_allgather (sharded outputs are not fully
+        addressable from any single process)."""
+        return jax.device_get(tree)
+
+    def _retire_counters(self, arena) -> None:
+        """Fold a dying arena's event counters into the monotone base so
+        device_state_counters() never moves backwards across rebuilds."""
+        c = arena.counters()
+        for k in ("hits", "misses", "evictions"):
+            self._counters_base[k] += c[k]
 
     def clear_device_state(self) -> None:
         """Release every arena's device buffers (e.g. after warmup: the
         synthetic rows must not occupy HBM). The host fit cache is
-        untouched — rows repopulate lazily on the next tick."""
+        untouched — rows repopulate lazily on the next tick. Event
+        counters are folded into the monotone base first."""
         for arena in self._arenas.values():
+            self._retire_counters(arena)
             arena.clear()
         self._arenas.clear()
 
     def device_state_counters(self) -> dict:
-        """Aggregated arena hit/miss/eviction counters (worker
+        """Aggregated arena hit/miss/eviction/fallback counters (worker
         self-telemetry; VERDICT r3 asked for the churn cost to be
-        observable rather than silent)."""
-        agg = {"hits": 0, "misses": 0, "evictions": 0, "rows_live": 0}
+        observable rather than silent). MONOTONE across arena rebuilds:
+        retired arenas' events are kept in a base accumulator, so the
+        gauge exporter can export plain deltas (ADVICE r4)."""
+        agg = dict(self._counters_base, rows_live=0)
         for arena in self._arenas.values():
             c = arena.counters()
-            for k in agg:
+            for k in ("hits", "misses", "evictions", "rows_live"):
                 agg[k] += c[k]
         return agg
 
@@ -408,7 +458,7 @@ class HealthJudge:
             )
             n_hist = hist.count().astype(jnp.int32)
             # one overlapped D2H (same rationale as the result decode)
-            level, trend, season, phase, scale, nh = jax.device_get(
+            level, trend, season, phase, scale, nh = self._fetch(
                 (fc.level, fc.trend, fc.season, fc.season_phase, fc.scale, n_hist)
             )
             puts = []
@@ -478,8 +528,21 @@ class HealthJudge:
                     gap_steps=gap,
                     **pw,
                 )
-        # fallback (arena disabled, or batch exceeds the byte budget):
-        # one-off host stack + upload, no cross-tick device reuse
+        # fallback (arena disabled, or batch exceeds even the hard byte
+        # cap): one-off host stack + upload, no cross-tick device reuse.
+        # COUNTED and logged — a fleet living on this path re-pays its
+        # whole state upload every tick, which must never be silent
+        # (VERDICT r4: the daily-season cliff).
+        if arena is not None:
+            self._counters_base["fallbacks"] += 1
+            log.warning(
+                "arena fallback: batch of %d rows exceeds the hard cap "
+                "(%d rows at season_len=%d) — full state restack this "
+                "tick; raise FOREMAST_ARENA_MAX_BYTES",
+                len(keys),
+                arena.hard_rows,
+                arena.m,
+            )
         return self._stacked_score(batch, entries, gap, pw)
 
     def _stacked_score(self, batch, entries, gap, pw):
@@ -579,8 +642,17 @@ class HealthJudge:
         )
         gap = None if gap_steps is None else jnp.asarray(gap_steps)
         res = self._arena_score(batch, keys, entries, (), gap, pw)
-        if with_bands:
-            v8, packed, ub, lb = jax.device_get(
+        if with_bands and self.band_mode == "full":
+            # full [B, tc] bands for custom hooks (parity with the object
+            # path's "full" mode — same band shape on warm and cold ticks)
+            v8, packed, ub, lb = self._fetch(
+                _compact_full_nopair(
+                    res.verdict, res.anomalies, res.upper, res.lower
+                )
+            )
+            ub, lb = ub[:b0], lb[:b0]
+        elif with_bands:
+            v8, packed, ub, lb = self._fetch(
                 _compact_result_nopair(
                     res.verdict,
                     res.anomalies,
@@ -591,7 +663,7 @@ class HealthJudge:
             )
             ub, lb = ub[:b0], lb[:b0]
         else:
-            v8, packed = jax.device_get(
+            v8, packed = self._fetch(
                 _compact_min(res.verdict, res.anomalies)
             )
             ub = lb = None
@@ -688,7 +760,7 @@ class HealthJudge:
                 np.int32,
                 count=len(tasks),
             )
-            verdicts, packed, ub, lb, ps, differs = jax.device_get(
+            verdicts, packed, ub, lb, ps, differs = self._fetch(
                 _compact_result(
                     res.verdict,
                     res.anomalies,
@@ -702,7 +774,7 @@ class HealthJudge:
             anoms = np.unpackbits(packed, axis=1, count=tc)
             uppers = lowers = None
         else:
-            verdicts, anoms, uppers, lowers, ps, differs = jax.device_get(
+            verdicts, anoms, uppers, lowers, ps, differs = self._fetch(
                 (
                     res.verdict,
                     res.anomalies,

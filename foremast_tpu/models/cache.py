@@ -121,3 +121,43 @@ class ModelCache:
         for k, v in zip(keys, values):
             self.put(key_parser(k) if key_parser else k, v)
         return len(keys)
+
+    # -- host-local durability (multi-host workers) ---------------------
+
+    def save_local(self, path: str) -> None:
+        """Host-local checkpoint (pickle, atomic rename): unlike save(),
+        performs NO cross-process coordination. Under jax.distributed,
+        orbax's save is a collective (its sync barrier would deadlock
+        hosts that checkpoint at different tick cadences), while each
+        host's model cache is independent state (shared-nothing job
+        claims, design.md:35-43) — so multi-host workers each write
+        their own `model_cache.host{i}` file with this."""
+        import os
+        import pickle
+        import tempfile
+
+        with self._lock:
+            items = dict(self._d)
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".model_cache.")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(items, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def load_local(self, path: str) -> int:
+        """Restore a save_local checkpoint (keys round-trip natively).
+        Returns the number of entries loaded."""
+        import pickle
+
+        with open(path, "rb") as f:
+            items = pickle.load(f)
+        self.put_many(items.items())
+        return len(items)

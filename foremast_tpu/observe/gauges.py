@@ -205,7 +205,12 @@ class WorkerMetrics:
             ["event"],
             registry=reg,
         )
-        self._arena_last = {"hits": 0, "misses": 0, "evictions": 0}
+        self._arena_last = {
+            "hits": 0,
+            "misses": 0,
+            "evictions": 0,
+            "fallbacks": 0,
+        }
 
     def observe_doc(self, status: str, n_windows: int) -> None:
         self.jobs.labels(status=status).inc()
@@ -213,16 +218,14 @@ class WorkerMetrics:
 
     def observe_arena(self, counters: dict) -> None:
         """Feed cumulative judge.device_state_counters(); deltas are
-        exported so the Prometheus counters stay monotone. Arena
-        rebuilds (season widening, clear_device_state) reset the source
-        counters to zero — a negative delta re-baselines the watermark
-        and counts the new cumulative value, so the churn signal is
-        never silently frozen behind a stale high-water mark."""
-        for event in ("hits", "misses", "evictions"):
+        exported so the Prometheus counters stay monotone. The source is
+        itself monotone across arena rebuilds (retired arenas fold into
+        HealthJudge._counters_base), so no re-baseline heuristic is
+        needed — a negative delta can only mean a new judge instance and
+        is clamped to zero rather than guessed at."""
+        for event in ("hits", "misses", "evictions", "fallbacks"):
             cur = counters.get(event, 0)
             delta = cur - self._arena_last[event]
-            if delta < 0:
-                delta = cur  # source reset: everything since is new
             if delta > 0:
                 self.arena.labels(event=event).inc(delta)
             self._arena_last[event] = cur

@@ -17,6 +17,12 @@ from foremast_tpu.parallel.batch import (
     shard_batch,
     throughput_batch,
 )
+from foremast_tpu.parallel.distributed import (
+    LeaderSource,
+    LeaderStore,
+    PodWorker,
+    broadcast_obj,
+)
 from foremast_tpu.parallel.seqparallel import (
     score_time_sharded,
     sharded_ewma,
@@ -37,6 +43,10 @@ __all__ = [
     "replicated",
     "shard_leading",
     "ShardedJudge",
+    "LeaderSource",
+    "LeaderStore",
+    "PodWorker",
+    "broadcast_obj",
     "pad_batch",
     "shard_batch",
     "throughput_batch",

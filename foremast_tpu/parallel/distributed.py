@@ -1,0 +1,172 @@
+"""Pod-spanning worker mode: leader claim + broadcast + SPMD judgment.
+
+The reference scales the brain by running N shared-nothing pods against
+the shared ES store (`docs/guides/design.md:35-43`); that mode works here
+unchanged (independent `BrainWorker`s, CAS claims — no jax.distributed
+involved). This module adds the mode the reference cannot express: ONE
+logical worker spanning every host of a multi-host slice, its judgment
+partitioned over the global (data, model) mesh.
+
+The multi-controller contract is that every process must execute the
+same program over the same global batch, while exactly one process may
+talk to the outside world. The adapters enforce that split:
+
+  * `broadcast_obj`   — pickle-broadcast any host object from process 0
+    (two `broadcast_one_to_all` collectives: size, then payload);
+  * `LeaderStore`     — JobStore adapter: process 0 claims/writes against
+    the real store and broadcasts the claim set, so all processes tick
+    over IDENTICAL documents; follower writes are no-ops;
+  * `LeaderSource`    — MetricSource adapter: process 0 fetches, results
+    broadcast. `concurrent_fetch = False` is load-bearing: fetches are
+    collectives, so their ORDER must be identical on every process — a
+    thread pool would interleave them nondeterministically and deadlock;
+  * `PodWorker`       — BrainWorker whose tick clock is broadcast (the
+    settled-history admission gates compare against `now`; divergent
+    clocks near a boundary would route the same doc down different code
+    paths on different processes, desynchronizing the SPMD program).
+
+Determinism argument for everything else: given identical docs, series,
+clock and caches, the worker's control flow is a pure function, so fit
+caches, gap anchors and arena row assignment evolve identically on every
+process — which is what lets the arena stay REPLICATED over the mesh
+(see engine/arena.py `sharding`) with each process scattering identical
+rows.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import time
+
+import jax
+import numpy as np
+
+from foremast_tpu.jobs.store import JobStore
+from foremast_tpu.jobs.worker import BrainWorker
+from foremast_tpu.metrics.source import MetricSource
+
+log = logging.getLogger("foremast_tpu.parallel.distributed")
+
+
+def is_leader() -> bool:
+    return jax.process_index() == 0
+
+
+def broadcast_obj(obj=None):
+    """Broadcast a picklable host object from process 0 to every process.
+
+    Followers pass anything (ignored) and receive the leader's object.
+    Single-process: returns `obj` unchanged with zero collectives.
+    """
+    if jax.process_count() == 1:
+        return obj
+    from jax.experimental import multihost_utils as mhu
+
+    leader = is_leader()
+    if leader:
+        payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+        size = np.array([payload.size], np.int64)
+    else:
+        payload = None
+        size = np.zeros(1, np.int64)
+    size = mhu.broadcast_one_to_all(size)
+    buf = payload if leader else np.zeros(int(size[0]), np.uint8)
+    buf = mhu.broadcast_one_to_all(buf)
+    return obj if leader else pickle.loads(buf.tobytes())
+
+
+class LeaderStore(JobStore):
+    """Only process 0 talks to the real store; claims are broadcast.
+
+    Followers construct this with `inner=None` — they never need a real
+    connection, which also means ES credentials only have to exist on
+    the leader."""
+
+    def __init__(self, inner: JobStore | None):
+        if is_leader() and inner is None:
+            raise ValueError("process 0 needs the real store")
+        self.inner = inner
+
+    def claim(self, worker_id, max_stuck_seconds, limit=64):
+        docs = (
+            self.inner.claim(worker_id, max_stuck_seconds, limit)
+            if is_leader()
+            else None
+        )
+        return broadcast_obj(docs)
+
+    def update(self, doc):
+        if is_leader():
+            return self.inner.update(doc)
+        return doc
+
+    def update_many(self, docs):
+        if is_leader():
+            self.inner.update_many(docs)
+
+    def create(self, doc):
+        if not is_leader():
+            raise RuntimeError("create() is leader-only in pod mode")
+        return self.inner.create(doc)
+
+    def get(self, doc_id):
+        return broadcast_obj(
+            self.inner.get(doc_id) if is_leader() else None
+        )
+
+    def list_open(self):
+        return broadcast_obj(
+            self.inner.list_open() if is_leader() else None
+        )
+
+
+class LeaderSource(MetricSource):
+    """Only process 0 performs metric fetches; series are broadcast.
+
+    Every fetch is a collective, so ordering must be deterministic —
+    `concurrent_fetch = False` forces the worker's serial fetch loop
+    (doc order is broadcast-identical, alias order is config order).
+    A leader-side fetch error must not desynchronize the cluster: the
+    exception itself is broadcast and re-raised on every process, so
+    all of them take the preprocess-failure branch together."""
+
+    concurrent_fetch = False
+
+    def __init__(self, inner: MetricSource | None):
+        if is_leader() and inner is None:
+            raise ValueError("process 0 needs the real source")
+        self.inner = inner
+
+    def fetch(self, url: str):
+        if is_leader():
+            try:
+                out = self.inner.fetch(url)
+            except Exception as e:  # noqa: BLE001 — must cross processes
+                out = _FetchError(repr(e))
+        else:
+            out = None
+        out = broadcast_obj(out)
+        if isinstance(out, _FetchError):
+            raise RuntimeError(out.msg)
+        return out
+
+
+class _FetchError:
+    def __init__(self, msg: str):
+        self.msg = msg
+
+
+class PodWorker(BrainWorker):
+    """BrainWorker for the pod-spanning mode: broadcast tick clock.
+
+    Construct with a LeaderStore/LeaderSource pair and a judge whose
+    univariate engine is a ShardedJudge over `make_global_mesh()`. The
+    claim set, series, and clock are leader-broadcast, the judgment runs
+    SPMD over the global mesh, and only the leader persists results.
+    """
+
+    def tick(self, now: float | None = None) -> int:
+        if now is None:
+            now = broadcast_obj(time.time() if is_leader() else None)
+        return super().tick(now=now)

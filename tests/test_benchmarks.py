@@ -82,3 +82,43 @@ def test_quality_benchmark_structured_beats_flat_on_seasonal(capsys):
     assert f1(("daily-1440-sharp", "auto_univariate")) >= 0.99
     assert f1(("daily-1440-sharp", "seasonal")) < 0.7  # Fourier can't
     assert f1(("daily-1440-sharp", "moving_average_all")) < 0.7
+
+
+def test_worker_bench_churn_mode_small():
+    """Churn machinery (VERDICT r4 #4): each warm tick retires and
+    admits 10% of services; every tick must still process the full
+    fleet, the columnar fast path must keep serving the warm majority
+    (per-key admission revalidation — no wholesale re-walk), and no
+    arena fallbacks may fire."""
+    from benchmarks.worker_bench import run
+
+    out = run(
+        services=20,
+        ticks=3,
+        algorithm="moving_average_all",
+        season=24,
+        hist_len=256,
+        cur_len=30,
+        churn=0.1,
+    )
+    assert out["churn_per_tick"] == 2
+    assert out["arena_fallbacks"] == 0
+    assert out["warm_windows_per_sec"] > 0
+    assert out["cold_first_verdict_seconds"] <= out["cold_tick_seconds"]
+
+
+def test_mixed_univariate_joint_worker_tick():
+    """VERDICT r4 #5: ONE worker claim set mixing all five univariate
+    shapes with bivariate + LSTM-hybrid joint jobs under the `auto`
+    selector; tick 1 warms every model clean, tick 2 judges the spiked
+    fleet warm (univariate docs on the columnar fast path, joint docs on
+    the slow path — in the same tick). Small CI shapes; at benchmark
+    size (per_uni=24, per_joint=4) every kind measures F1 = 1.0 with 0
+    false alarms (BENCHMARKS.md mixed-tick row)."""
+    from benchmarks.quality import mixed_fleet_tick
+
+    by_kind, false_alarms = mixed_fleet_tick(4, 2, 240, 30)
+    assert false_alarms == 0  # clean docs stay healthy: no contamination
+    for kind, (f1, points) in by_kind.items():
+        floor = 1.0 if kind in ("bivariate", "lstm") else 0.93
+        assert f1 >= floor, (kind, f1, points)

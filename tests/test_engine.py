@@ -572,3 +572,87 @@ def test_arena_churn_rescatters_only_changed_rows():
     got2 = {v.job_id: v.verdict for v in judge.judge(tasks)}
     assert arena.misses == before
     assert got2 == ref
+
+
+def test_arena_auto_grows_past_soft_budget(monkeypatch):
+    """VERDICT r4 #3 (the daily-season cliff): a batch larger than the
+    soft byte budget must GROW the arena toward the hard cap instead of
+    silently falling back to a per-tick full restack — an LRU arena
+    smaller than the working set thrashes (every access misses)."""
+    from foremast_tpu.engine.arena import StateArena, _row_bytes
+
+    monkeypatch.setenv("FOREMAST_ARENA_BYTES", str(8 * _row_bytes(24)))
+    monkeypatch.setenv(
+        "FOREMAST_ARENA_MAX_BYTES", str(32 * _row_bytes(24))
+    )
+    a = StateArena(24)
+    assert a.max_rows == 8 and a.hard_rows == 32
+    got = a.assign([f"k{i}" for i in range(16)], range(16))
+    assert got is not None, "must auto-grow, not refuse"
+    assert a.max_rows == 16
+    # past the hard cap: refuse up front (counted by the judge), with no
+    # partial row mutation
+    rows_before = dict(a.rows)
+    assert a.assign([f"x{i}" for i in range(64)], range(64)) is None
+    assert a.rows == rows_before
+
+
+def test_arena_fallback_is_counted_and_verdicts_survive(monkeypatch):
+    """When a batch exceeds even the hard cap, the judge falls back to a
+    one-off stacked score: verdicts must be unchanged and the fallback
+    must be COUNTED (VERDICT r4: the silent-fallback cliff)."""
+    from foremast_tpu.models.cache import ModelCache
+
+    rng = np.random.default_rng(13)
+    cfg = BrainConfig(algorithm="holt_winters", season_steps=24)
+    ref_judge = HealthJudge(cfg)
+    ref_judge.fit_cache = ModelCache(64)
+    tasks = [
+        _hw_task(f"j{i}", rng, spike=(i == 2), fit_key=f"a{i}|m|u{i}")
+        for i in range(12)
+    ]
+    ref = [v.verdict for v in ref_judge.judge(tasks)]
+
+    from foremast_tpu.engine.arena import _row_bytes
+
+    monkeypatch.setenv("FOREMAST_ARENA_BYTES", str(8 * _row_bytes(24)))
+    monkeypatch.setenv("FOREMAST_ARENA_MAX_BYTES", str(8 * _row_bytes(24)))
+    judge = HealthJudge(cfg)
+    judge.fit_cache = ModelCache(64)
+    got = [v.verdict for v in judge.judge(tasks)]  # 12 -> 16-row bucket
+    assert got == ref
+    c = judge.device_state_counters()
+    assert c["fallbacks"] >= 1
+    got2 = [v.verdict for v in judge.judge(tasks)]
+    assert got2 == ref
+    assert judge.device_state_counters()["fallbacks"] > c["fallbacks"]
+
+
+def test_device_state_counters_monotone_across_rebuilds():
+    """ADVICE r4: clear_device_state / widen rebuilds must not move the
+    cumulative counters backwards — retired arenas fold into a base so
+    the gauge exporter can export plain deltas."""
+    from foremast_tpu.models.cache import ModelCache
+
+    rng = np.random.default_rng(17)
+    cfg = BrainConfig(algorithm="holt_winters", season_steps=24)
+    judge = HealthJudge(cfg)
+    judge.fit_cache = ModelCache(64)
+    tasks = [
+        _hw_task(f"j{i}", rng, fit_key=f"a{i}|m|u{i}") for i in range(4)
+    ]
+    judge.judge(tasks)
+    judge.judge(tasks)  # warm: hits accumulate
+    before = judge.device_state_counters()
+    assert before["hits"] > 0 and before["misses"] > 0
+
+    judge.clear_device_state()
+    after_clear = judge.device_state_counters()
+    for k in ("hits", "misses", "evictions"):
+        assert after_clear[k] == before[k]  # nothing lost
+    assert after_clear["rows_live"] == 0
+
+    judge.judge(tasks)  # rebuilt arena: counters keep rising
+    final = judge.device_state_counters()
+    assert final["misses"] > after_clear["misses"]
+    assert final["rows_live"] > 0
