@@ -234,20 +234,43 @@ def cmd_worker(args: argparse.Namespace) -> int:
         import jax as _jax
 
         if _jax.process_count() > 1:
-            # Per-host checkpoint files (VERDICT r4 #1): each host's cache
-            # is independent state (shared-nothing job claims), and
-            # orbax's save/restore is a cross-process collective whose
-            # sync barrier would deadlock hosts checkpointing at
-            # different tick cadences — so every host writes its own
-            # host-local pickle via ModelCache.save_local.
+            # Pod mode (VERDICT r4 #1): the determinism contract
+            # (parallel/distributed.py) requires IDENTICAL caches on
+            # every process — a host restoring fewer fits than its peers
+            # would route docs down different code paths and desync the
+            # SPMD program. So only the LEADER touches disk (host-local
+            # pickle — orbax's save is itself a cross-process collective
+            # and would barrier-deadlock), the restore decision and the
+            # restored entries are broadcast to every process, and
+            # follower saves are no-ops.
+            from foremast_tpu.parallel import broadcast_obj
+
+            leader_pm = _jax.process_index() == 0
             ckpt_path = _os.path.abspath(
-                _os.path.join(
-                    args.model_cache_dir,
-                    f"model_cache.host{_jax.process_index()}",
-                )
+                _os.path.join(args.model_cache_dir, "model_cache.pod")
             )
-            ckpt_save = judge.cache.save_local
-            ckpt_load = judge.cache.load_local
+            ckpt_save = (
+                judge.cache.save_local if leader_pm else (lambda path: None)
+            )
+            restored = None
+            if leader_pm and _os.path.exists(ckpt_path):
+                try:
+                    judge.cache.load_local(ckpt_path)
+                    restored = dict(judge.cache._d)
+                except Exception as e:  # noqa: BLE001 - stale/corrupt
+                    print(
+                        f"model-cache restore failed ({e}); starting cold",
+                        file=sys.stderr,
+                    )
+            items = broadcast_obj(restored)
+            if items:
+                if not leader_pm:
+                    judge.cache.put_many(items.items())
+                print(
+                    f"restored {len(items)} cached models pod-wide from "
+                    f"{ckpt_path}",
+                    file=sys.stderr,
+                )
         else:
             import ast
 
@@ -256,21 +279,20 @@ def cmd_worker(args: argparse.Namespace) -> int:
             )
             ckpt_save = judge.cache.save
 
-            def ckpt_load(path):
-                return judge.cache.load(path, key_parser=ast.literal_eval)
-
-        if _os.path.exists(ckpt_path):
-            try:
-                n = ckpt_load(ckpt_path)
-                print(
-                    f"restored {n} cached models from {ckpt_path}",
-                    file=sys.stderr,
-                )
-            except Exception as e:  # noqa: BLE001 - stale/corrupt checkpoint
-                print(
-                    f"model-cache restore failed ({e}); starting cold",
-                    file=sys.stderr,
-                )
+            if _os.path.exists(ckpt_path):
+                try:
+                    n = judge.cache.load(
+                        ckpt_path, key_parser=ast.literal_eval
+                    )
+                    print(
+                        f"restored {n} cached models from {ckpt_path}",
+                        file=sys.stderr,
+                    )
+                except Exception as e:  # noqa: BLE001 - stale/corrupt
+                    print(
+                        f"model-cache restore failed ({e}); starting cold",
+                        file=sys.stderr,
+                    )
 
     on_verdict = None
     worker_metrics = None

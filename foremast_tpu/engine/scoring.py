@@ -589,6 +589,100 @@ def score_from_arena(
     )
 
 
+# -- anchor-shifted bf16-delta history storage (FOREMAST_BF16_DELTA) ---------
+#
+# The headline kernel is HBM-bound on the [B, 10080] f32 history read
+# (BENCHMARKS.md roofline). Raw bf16 storage was measured and refused in
+# round 3: XLA materialized the fp32 upcast AND bf16's 8-bit mantissa
+# quantizes low-CV series (100 +- 0.1 has ulp 0.5). This is the principled
+# variant flagged there: store each window as (f32 anchor, bf16 DELTAS
+# from the anchor). Deviations keep ~3 significant digits relative to the
+# window's own range (what the band width is made of), and the
+# moving-average moments never reconstruct values at all —
+# E[v] = anchor + E[d], Var[v] = Var[d] — so the program reads half the
+# bytes with f32 accumulation. Only meaningful where the history RESIDES
+# in bf16 across reads (steady-state scoring); the shipped warm worker
+# path reads no history at all.
+
+
+@jax.jit
+def pack_hist_bf16_delta(values: jax.Array, mask: jax.Array):
+    """[B, T] f32 history -> (anchor [B] f32, delta [B, T] bf16).
+
+    anchor = first masked value per row (a member of the sample, so
+    deltas are bounded by the window range — same conditioning argument
+    as windows.masked_moments); masked slots pack as exact 0."""
+    first_idx = jnp.argmax(mask, axis=-1)
+    c = jnp.take_along_axis(values, first_idx[..., None], axis=-1)[..., 0]
+    c = jnp.where(mask.any(axis=-1), c, 0.0)
+    d = ((values - c[..., None]) * mask).astype(jnp.bfloat16)
+    return c, d
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "pairwise_algorithm",
+        "p_threshold",
+        "min_mw",
+        "min_wilcoxon",
+        "min_kruskal",
+        "min_friedman",
+    ),
+)
+def score_bf16_delta(
+    batch: ScoreBatch,
+    anchor: jax.Array,
+    delta: jax.Array,
+    pairwise_algorithm: str = PAIRWISE_ALL,
+    p_threshold: float = 0.05,
+    min_mw: int = 20,
+    min_wilcoxon: int = 20,
+    min_kruskal: int = 5,
+    min_friedman: int = 20,
+) -> ScoreResult:
+    """moving_average_all judgment from bf16-delta history storage.
+
+    `batch.historical` carries only the mask (values may be [B, 0]); the
+    moments come from the bf16 deltas with f32 accumulation. Semantics
+    match `_score_xla(algorithm="moving_average_all")` up to bf16
+    rounding of the deviations (pinned by test + quality gate)."""
+    mask = batch.historical.mask
+    m = mask.astype(jnp.float32)
+    n = jnp.sum(m, axis=-1)
+    # deltas were packed masked (exact zeros in masked slots), so plain
+    # sums ARE the masked sums; accumulate in f32 off the bf16 reads
+    s1 = jnp.sum(delta, axis=-1, dtype=jnp.float32)
+    d32 = delta.astype(jnp.float32)
+    s2 = jnp.sum(d32 * d32, axis=-1)
+    nn = jnp.maximum(n, 1.0)
+    mean_d = s1 / nn
+    mean = jnp.where(n > 0, anchor + mean_d, 0.0)
+    var = jnp.where(n > 0, jnp.maximum(s2 / nn - mean_d * mean_d, 0.0), 0.0)
+    b = mean.shape[0]
+    fc = Forecast(
+        pred=jnp.zeros((b, 0), jnp.float32),
+        scale=jnp.sqrt(var),
+        level=mean,
+        trend=jnp.zeros_like(mean),
+        season=jnp.zeros((b, 1), jnp.float32),
+        season_phase=jnp.zeros((b,), jnp.int32),
+    )
+    pred = horizon(fc, batch.current.length)
+    return _judgment_tail(
+        batch,
+        pred,
+        fc.scale,
+        n.astype(jnp.int32),
+        pairwise_algorithm,
+        p_threshold,
+        min_mw,
+        min_wilcoxon,
+        min_kruskal,
+        min_friedman,
+    )
+
+
 def _is_multi_device(batch: ScoreBatch) -> bool:
     """True when the batch is placed across >1 device (GSPMD path)."""
     sharding = getattr(batch.current.values, "sharding", None)

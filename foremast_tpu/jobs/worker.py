@@ -188,6 +188,14 @@ class BrainWorker:
         # hist timestamp) for the history-free warm path
         self._meta_cache = ModelCache(max(4096, 2 * claim_limit))
         self._gap_meta = ModelCache(max(4096, 8 * claim_limit))
+        # slow-path doc-chunk size (progressive cold admission); an
+        # instance attribute so PodWorker can broadcast the leader's
+        # value — per-host env skew would desync SPMD judge boundaries
+        import os as _os
+
+        self.cold_chunk_docs = int(
+            _os.environ.get("FOREMAST_COLD_CHUNK_DOCS", "1024")
+        )
         self.metrics = metrics
 
     # -- preprocess: document -> MetricTasks ----------------------------
@@ -809,11 +817,7 @@ class BrainWorker:
         # _FIT_CHUNK bounds device memory). Warm steady state is
         # unaffected: the columnar fast path above already consumed the
         # all-warm subset, so `docs` here is usually tiny.
-        import os as _os
-
-        chunk_docs = int(
-            _os.environ.get("FOREMAST_COLD_CHUNK_DOCS", "1024")
-        )
+        chunk_docs = self.cold_chunk_docs
         use_pool = len(docs) > 1 and getattr(
             self.source, "concurrent_fetch", True
         )

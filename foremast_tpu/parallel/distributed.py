@@ -164,7 +164,29 @@ class PodWorker(BrainWorker):
     univariate engine is a ShardedJudge over `make_global_mesh()`. The
     claim set, series, and clock are leader-broadcast, the judgment runs
     SPMD over the global mesh, and only the leader persists results.
+
+    Control-flow-shaping knobs are ALSO leader-broadcast at
+    construction: a per-host env skew in the cold-chunk size or the
+    arena byte budgets would make processes issue differently-shaped
+    judge programs (or one take the stacked-score fallback while its
+    peers use the arena) and deadlock the collectives.
     """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        import os
+
+        from foremast_tpu.engine.arena import _arena_bytes, _arena_max_bytes
+
+        knobs = broadcast_obj(
+            (self.cold_chunk_docs, _arena_bytes(), _arena_max_bytes())
+            if is_leader()
+            else None
+        )
+        if knobs is not None and not is_leader():
+            self.cold_chunk_docs = knobs[0]
+            os.environ["FOREMAST_ARENA_BYTES"] = str(knobs[1])
+            os.environ["FOREMAST_ARENA_MAX_BYTES"] = str(knobs[2])
 
     def tick(self, now: float | None = None) -> int:
         if now is None:

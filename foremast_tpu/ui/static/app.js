@@ -64,6 +64,62 @@ function niceTicks(lo, hi, n) {
   return ticks;
 }
 
+/* Pure render-path geometry — every function below is PORTED to Python
+ * and pinned in tests/test_ui_js.py (the executed spec): domain
+ * computation, pixel scales, polyline/polygon point strings, anomaly-dot
+ * placement, tick layout, nearest-point lookup. Rendering proper is
+ * reduced to DOM calls over these outputs. */
+
+function makeDomain(base, upper, lower) {
+  // time domain from the measured curve; value domain over curve + band,
+  // +-8% headroom; degenerate (flat) spans widen by 1 so Y never /0
+  const tExt = extent([base], (x) => x.t);
+  const vExt = extent([base, upper, lower], (x) => x.v);
+  if (!tExt || !vExt) return null;
+  const t0 = tExt[0], t1 = tExt[1];
+  let v0 = vExt[0], v1 = vExt[1];
+  if (v0 === v1) { v0 -= 1; v1 += 1; }
+  const padV = (v1 - v0) * 0.08;
+  return { t0, t1, v0: v0 - padV, v1: v1 + padV };
+}
+
+function xPix(t, dom, W) {
+  return PAD.l + ((t - dom.t0) / (dom.t1 - dom.t0 || 1)) * (W - PAD.l - PAD.r);
+}
+
+function yPix(v, dom, H) {
+  return H - PAD.b - ((v - dom.v0) / (dom.v1 - dom.v0)) * (H - PAD.t - PAD.b);
+}
+
+function pathPoints(series, dom, W, H) {
+  return series.map((x) => `${xPix(x.t, dom, W)},${yPix(x.v, dom, H)}`).join(" ");
+}
+
+function bandPolygon(upper, lower, dom, W, H) {
+  // fill between the band edges over their COMMON timestamps: forward
+  // along upper, back along lower (reversed) closes the polygon
+  const loByT = new Map(lower.map((x) => [x.t, x.v]));
+  const pts = upper.filter((x) => loByT.has(x.t));
+  if (!pts.length) return null;
+  const fwd = pts.map((x) => `${xPix(x.t, dom, W)},${yPix(x.v, dom, H)}`);
+  const back = pts.slice().reverse()
+    .map((x) => `${xPix(x.t, dom, W)},${yPix(loByT.get(x.t), dom, H)}`);
+  return fwd.concat(back).join(" ");
+}
+
+function anomalyDots(anoms, dom, W, H) {
+  return anoms.map((a) => ({ cx: xPix(a.t, dom, W), cy: yPix(a.v, dom, H) }));
+}
+
+function tickLayout(dom, W, H) {
+  const yTicks = niceTicks(dom.v0, dom.v1, 4)
+    .map((v) => ({ v, y: yPix(v, dom, H) }));
+  const nT = Math.max(2, Math.floor(W / 140));
+  const xTicks = niceTicks(dom.t0, dom.t1, nT)
+    .map((t) => ({ t, x: xPix(t, dom, W) }));
+  return { yTicks, xTicks };
+}
+
 const fmtV = (v) =>
   Math.abs(v) >= 1e6 ? (v / 1e6).toFixed(1) + "M"
   : Math.abs(v) >= 1e3 ? (v / 1e3).toFixed(1) + "k"
@@ -92,57 +148,46 @@ function renderPanel(p) {
 
   const W = box.clientWidth || 440, H = 180;
   const svg = svgEl("svg", { viewBox: `0 0 ${W} ${H}` });
-  const all = [base, d.upper || [], d.lower || []];
-  const tExt = extent([base], (x) => x.t);
-  const vExt = extent(all, (x) => x.v);
-  if (!tExt || !vExt) {  // all-NaN series (e.g. PromQL 0/0) — treat as empty
+  const up = d.upper || [], lo = d.lower || [];
+  const dom = makeDomain(base, up, lo);
+  if (!dom) {  // all-NaN series (e.g. PromQL 0/0) — treat as empty
     const e = document.createElement("div");
     e.className = "empty";
     e.textContent = "no data";
     box.appendChild(e);
     return;
   }
-  const [t0, t1] = tExt;
-  let [v0, v1] = vExt;
-  if (v0 === v1) { v0 -= 1; v1 += 1; }
-  const padV = (v1 - v0) * 0.08;
-  v0 -= padV; v1 += padV;
-  const X = (t) => PAD.l + ((t - t0) / (t1 - t0 || 1)) * (W - PAD.l - PAD.r);
-  const Y = (v) => H - PAD.b - ((v - v0) / (v1 - v0)) * (H - PAD.t - PAD.b);
-  p.X = X; p.Y = Y; p.t0 = t0; p.t1 = t1; p.W = W; p.H = H;
+  const X = (t) => xPix(t, dom, W);
+  const Y = (v) => yPix(v, dom, H);
+  p.X = X; p.Y = Y; p.t0 = dom.t0; p.t1 = dom.t1; p.W = W; p.H = H;
 
-  for (const v of niceTicks(v0, v1, 4)) {
-    svg.appendChild(svgEl("line", { class: "gridline", x1: PAD.l, x2: W - PAD.r, y1: Y(v), y2: Y(v) }));
-    const txt = svgEl("text", { x: PAD.l - 6, y: Y(v) + 3, "text-anchor": "end" });
-    txt.textContent = fmtV(v);
+  const ticks = tickLayout(dom, W, H);
+  for (const g of ticks.yTicks) {
+    svg.appendChild(svgEl("line", { class: "gridline", x1: PAD.l, x2: W - PAD.r, y1: g.y, y2: g.y }));
+    const txt = svgEl("text", { x: PAD.l - 6, y: g.y + 3, "text-anchor": "end" });
+    txt.textContent = fmtV(g.v);
     svg.appendChild(txt);
   }
-  const nT = Math.max(2, Math.floor(W / 140));
-  for (const t of niceTicks(t0, t1, nT)) {
-    const txt = svgEl("text", { x: X(t), y: H - 4, "text-anchor": "middle" });
-    txt.textContent = fmtT(t);
+  for (const g of ticks.xTicks) {
+    const txt = svgEl("text", { x: g.x, y: H - 4, "text-anchor": "middle" });
+    txt.textContent = fmtT(g.t);
     svg.appendChild(txt);
   }
   svg.appendChild(svgEl("line", { class: "axisline", x1: PAD.l, x2: W - PAD.r, y1: H - PAD.b, y2: H - PAD.b }));
 
   // model band: fill between upper and lower where both exist
-  const up = d.upper || [], lo = d.lower || [];
   if (up.length && lo.length) {
-    const loByT = new Map(lo.map((x) => [x.t, x.v]));
-    const pts = up.filter((x) => loByT.has(x.t));
-    if (pts.length) {
-      const fwd = pts.map((x) => `${X(x.t)},${Y(x.v)}`);
-      const back = pts.slice().reverse().map((x) => `${X(x.t)},${Y(loByT.get(x.t))}`);
-      svg.appendChild(svgEl("polygon", { class: "band-area", points: fwd.concat(back).join(" ") }));
-    }
+    const poly = bandPolygon(up, lo, dom, W, H);
+    if (poly !== null)
+      svg.appendChild(svgEl("polygon", { class: "band-area", points: poly }));
     for (const edge of [up, lo])
-      svg.appendChild(svgEl("polyline", { class: "band-edge", points: edge.map((x) => `${X(x.t)},${Y(x.v)}`).join(" ") }));
+      svg.appendChild(svgEl("polyline", { class: "band-edge", points: pathPoints(edge, dom, W, H) }));
   }
 
-  svg.appendChild(svgEl("polyline", { class: "baseline-path", points: base.map((x) => `${X(x.t)},${Y(x.v)}`).join(" ") }));
+  svg.appendChild(svgEl("polyline", { class: "baseline-path", points: pathPoints(base, dom, W, H) }));
 
-  for (const a of d.anomalyJoined || [])
-    svg.appendChild(svgEl("circle", { class: "anom", cx: X(a.t), cy: Y(a.v), r: 4.5 }));
+  for (const a of anomalyDots(d.anomalyJoined || [], dom, W, H))
+    svg.appendChild(svgEl("circle", { class: "anom", cx: a.cx, cy: a.cy, r: 4.5 }));
 
   // crosshair layer (populated by the shared hover handler)
   p.xhair = svgEl("line", { class: "xhair", y1: PAD.t, y2: H - PAD.b, visibility: "hidden" });
@@ -153,7 +198,7 @@ function renderPanel(p) {
   svg.addEventListener("mousemove", (ev) => {
     const rect = svg.getBoundingClientRect();
     const frac = (ev.clientX - rect.left) / rect.width;
-    const t = t0 + Math.max(0, Math.min(1, (frac * W - PAD.l) / (W - PAD.l - PAD.r))) * (t1 - t0);
+    const t = dom.t0 + Math.max(0, Math.min(1, (frac * W - PAD.l) / (W - PAD.l - PAD.r))) * (dom.t1 - dom.t0);
     syncCrosshair(t, ev);
   });
   svg.addEventListener("mouseleave", () => syncCrosshair(null));

@@ -656,3 +656,78 @@ def test_device_state_counters_monotone_across_rebuilds():
     final = judge.device_state_counters()
     assert final["misses"] > after_clear["misses"]
     assert final["rows_live"] > 0
+
+
+def test_bf16_delta_scorer_matches_f32_and_keeps_low_cv_bands():
+    """FOREMAST_BF16_DELTA variant (BENCHMARKS.md roofline): the
+    anchor-shifted bf16-delta moving_average_all scorer must reproduce
+    f32 verdicts/flags on realistic data, and — the round-3 refusal
+    case — keep band geometry on LOW-CV series (value 100 +- 0.1, where
+    RAW bf16 storage had ulp 0.5 and destroyed the band)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from foremast_tpu.engine import scoring
+    from foremast_tpu.ops.windows import MetricWindows
+    from foremast_tpu.parallel.batch import throughput_batch
+
+    b, th = 64, 512
+    batch = throughput_batch(b, th, 30, seed=3)
+    ref = scoring.score(batch, algorithm="moving_average_all")
+    anchor, delta = scoring.pack_hist_bf16_delta(
+        batch.historical.values, batch.historical.mask
+    )
+    slim = dataclasses.replace(
+        batch,
+        historical=MetricWindows(
+            values=jnp.zeros((b, 0), jnp.float32),
+            mask=batch.historical.mask,
+            times=None,
+        ),
+    )
+    got = scoring.score_bf16_delta(slim, anchor, delta)
+    assert (np.asarray(got.verdict) == np.asarray(ref.verdict)).all()
+    assert (np.asarray(got.anomalies) == np.asarray(ref.anomalies)).all()
+
+    # low-CV: 100 +- 0.1 noise; the fitted scale must stay within 2% of
+    # the f32 scale (raw bf16 storage would quantize values to +-0.5 and
+    # inflate/deflate it wildly), and band edges within 0.5% of level
+    rng = np.random.default_rng(0)
+    hist = (100.0 + 0.1 * rng.standard_normal((b, th))).astype(np.float32)
+    low = dataclasses.replace(
+        batch,
+        historical=MetricWindows(
+            values=jnp.asarray(hist),
+            mask=jnp.ones((b, th), bool),
+            times=None,
+        ),
+        current=MetricWindows(
+            values=jnp.asarray(
+                (100.0 + 0.1 * rng.standard_normal((b, 30))).astype(
+                    np.float32
+                )
+            ),
+            mask=jnp.ones((b, 30), bool),
+            times=None,
+        ),
+    )
+    ref_low = scoring.score(low, algorithm="moving_average_all")
+    a2, d2 = scoring.pack_hist_bf16_delta(low.historical.values, low.historical.mask)
+    slim_low = dataclasses.replace(
+        low,
+        historical=MetricWindows(
+            values=jnp.zeros((b, 0), jnp.float32),
+            mask=low.historical.mask,
+            times=None,
+        ),
+    )
+    got_low = scoring.score_bf16_delta(slim_low, a2, d2)
+    ref_scale = np.asarray(ref_low.upper - ref_low.lower)
+    got_scale = np.asarray(got_low.upper - got_low.lower)
+    assert np.all(np.abs(got_scale - ref_scale) <= 0.02 * ref_scale + 1e-6)
+    assert np.allclose(
+        np.asarray(got_low.upper), np.asarray(ref_low.upper), rtol=5e-5,
+        atol=5e-3,
+    )
+    assert (np.asarray(got_low.verdict) == np.asarray(ref_low.verdict)).all()

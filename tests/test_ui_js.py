@@ -225,3 +225,239 @@ def test_python_ports_golden_behavior():
     )
     # degenerate span (lo == hi) must not divide by zero
     assert nice_ticks_py(3.0, 3.0, 5) != []
+
+
+# -- render-path ports (VERDICT r4 #9): geometry as executed Python ------
+
+PAD = {"l": 44, "r": 10, "t": 8, "b": 18}
+
+
+def js_num(x) -> str:
+    """JS Number->String for the values these ports emit: integral
+    doubles print without a decimal point, everything else as the
+    shortest round-trip (Python repr matches for non-exotic floats)."""
+    if isinstance(x, float) and math.isfinite(x) and x == int(x):
+        return str(int(x))
+    return repr(x)
+
+
+def make_domain_py(base, upper, lower):
+    t_ext = extent_py([base], lambda x: x["t"])
+    v_ext = extent_py([base, upper, lower], lambda x: x["v"])
+    if t_ext is None or v_ext is None:
+        return None
+    t0, t1 = t_ext
+    v0, v1 = v_ext
+    if v0 == v1:
+        v0, v1 = v0 - 1, v1 + 1
+    pad_v = (v1 - v0) * 0.08
+    return {"t0": t0, "t1": t1, "v0": v0 - pad_v, "v1": v1 + pad_v}
+
+
+def x_pix_py(t, dom, w):
+    return PAD["l"] + ((t - dom["t0"]) / ((dom["t1"] - dom["t0"]) or 1)) * (
+        w - PAD["l"] - PAD["r"]
+    )
+
+
+def y_pix_py(v, dom, h):
+    return h - PAD["b"] - ((v - dom["v0"]) / (dom["v1"] - dom["v0"])) * (
+        h - PAD["t"] - PAD["b"]
+    )
+
+
+def path_points_py(series, dom, w, h):
+    return " ".join(
+        f"{js_num(x_pix_py(x['t'], dom, w))},{js_num(y_pix_py(x['v'], dom, h))}"
+        for x in series
+    )
+
+
+def band_polygon_py(upper, lower, dom, w, h):
+    lo_by_t = {x["t"]: x["v"] for x in lower}
+    pts = [x for x in upper if x["t"] in lo_by_t]
+    if not pts:
+        return None
+    fwd = [
+        f"{js_num(x_pix_py(x['t'], dom, w))},{js_num(y_pix_py(x['v'], dom, h))}"
+        for x in pts
+    ]
+    back = [
+        f"{js_num(x_pix_py(x['t'], dom, w))},"
+        f"{js_num(y_pix_py(lo_by_t[x['t']], dom, h))}"
+        for x in reversed(pts)
+    ]
+    return " ".join(fwd + back)
+
+
+def anomaly_dots_py(anoms, dom, w, h):
+    return [
+        {"cx": x_pix_py(a["t"], dom, w), "cy": y_pix_py(a["v"], dom, h)}
+        for a in anoms
+    ]
+
+
+def tick_layout_py(dom, w, h):
+    y_ticks = [
+        {"v": v, "y": y_pix_py(v, dom, h)}
+        for v in nice_ticks_py(dom["v0"], dom["v1"], 4)
+    ]
+    n_t = max(2, math.floor(w / 140))
+    x_ticks = [
+        {"t": t, "x": x_pix_py(t, dom, w)}
+        for t in nice_ticks_py(dom["t0"], dom["t1"], n_t)
+    ]
+    return {"yTicks": y_ticks, "xTicks": x_ticks}
+
+
+def nearest_py(series, t):
+    best, bd = None, math.inf
+    for d in series:
+        dd = abs(d["t"] - t)
+        if dd < bd:
+            bd, best = dd, d
+    return best
+
+
+PINNED_MAKE_DOMAIN = """function makeDomain(base, upper, lower) {
+  // time domain from the measured curve; value domain over curve + band,
+  // +-8% headroom; degenerate (flat) spans widen by 1 so Y never /0
+  const tExt = extent([base], (x) => x.t);
+  const vExt = extent([base, upper, lower], (x) => x.v);
+  if (!tExt || !vExt) return null;
+  const t0 = tExt[0], t1 = tExt[1];
+  let v0 = vExt[0], v1 = vExt[1];
+  if (v0 === v1) { v0 -= 1; v1 += 1; }
+  const padV = (v1 - v0) * 0.08;
+  return { t0, t1, v0: v0 - padV, v1: v1 + padV };
+}"""
+
+PINNED_X_PIX = """function xPix(t, dom, W) {
+  return PAD.l + ((t - dom.t0) / (dom.t1 - dom.t0 || 1)) * (W - PAD.l - PAD.r);
+}"""
+
+PINNED_Y_PIX = """function yPix(v, dom, H) {
+  return H - PAD.b - ((v - dom.v0) / (dom.v1 - dom.v0)) * (H - PAD.t - PAD.b);
+}"""
+
+PINNED_PATH_POINTS = """function pathPoints(series, dom, W, H) {
+  return series.map((x) => `${xPix(x.t, dom, W)},${yPix(x.v, dom, H)}`).join(" ");
+}"""
+
+PINNED_BAND_POLYGON = """function bandPolygon(upper, lower, dom, W, H) {
+  // fill between the band edges over their COMMON timestamps: forward
+  // along upper, back along lower (reversed) closes the polygon
+  const loByT = new Map(lower.map((x) => [x.t, x.v]));
+  const pts = upper.filter((x) => loByT.has(x.t));
+  if (!pts.length) return null;
+  const fwd = pts.map((x) => `${xPix(x.t, dom, W)},${yPix(x.v, dom, H)}`);
+  const back = pts.slice().reverse()
+    .map((x) => `${xPix(x.t, dom, W)},${yPix(loByT.get(x.t), dom, H)}`);
+  return fwd.concat(back).join(" ");
+}"""
+
+PINNED_ANOMALY_DOTS = """function anomalyDots(anoms, dom, W, H) {
+  return anoms.map((a) => ({ cx: xPix(a.t, dom, W), cy: yPix(a.v, dom, H) }));
+}"""
+
+PINNED_TICK_LAYOUT = """function tickLayout(dom, W, H) {
+  const yTicks = niceTicks(dom.v0, dom.v1, 4)
+    .map((v) => ({ v, y: yPix(v, dom, H) }));
+  const nT = Math.max(2, Math.floor(W / 140));
+  const xTicks = niceTicks(dom.t0, dom.t1, nT)
+    .map((t) => ({ t, x: xPix(t, dom, W) }));
+  return { yTicks, xTicks };
+}"""
+
+PINNED_NEAREST = """function nearest(series, t) {
+  let best = null, bd = Infinity;
+  for (const d of series) {
+    const dd = Math.abs(d.t - t);
+    if (dd < bd) { bd = dd; best = d; }
+  }
+  return best;
+}"""
+
+
+def test_render_path_sources_match_pins():
+    src = open(APP_JS).read()
+    for name, pin in [
+        ("makeDomain", PINNED_MAKE_DOMAIN),
+        ("xPix", PINNED_X_PIX),
+        ("yPix", PINNED_Y_PIX),
+        ("pathPoints", PINNED_PATH_POINTS),
+        ("bandPolygon", PINNED_BAND_POLYGON),
+        ("anomalyDots", PINNED_ANOMALY_DOTS),
+        ("tickLayout", PINNED_TICK_LAYOUT),
+        ("nearest", PINNED_NEAREST),
+    ]:
+        assert extract_function(src, name) == pin, name
+
+
+def _demo_panel():
+    """A panel payload in the shape ui/join.py serves."""
+    base = [{"t": 1000 + 60 * i, "v": 1.0 + 0.1 * i} for i in range(10)]
+    upper = [{"t": 1000 + 60 * i, "v": 2.0 + 0.1 * i} for i in range(10)]
+    # lower misses two timestamps: the polygon must drop them
+    lower = [
+        {"t": 1000 + 60 * i, "v": 0.5 + 0.1 * i} for i in range(10)
+        if i not in (3, 7)
+    ]
+    anoms = [{"t": 1240, "v": 1.4}, {"t": 1480, "v": 1.8}]
+    return base, upper, lower, anoms
+
+
+def test_render_geometry_golden():
+    base, upper, lower, anoms = _demo_panel()
+    w, h = 440, 180
+    dom = make_domain_py(base, upper, lower)
+    # domain: time from base only, value across curve+band with 8% pad
+    assert dom["t0"] == 1000 and dom["t1"] == 1540
+    assert dom["v0"] < 0.5 and dom["v1"] > 2.9
+    span = (2.9 - 0.5) * 0.08
+    assert abs(dom["v0"] - (0.5 - span)) < 1e-12
+    assert abs(dom["v1"] - (2.9 + span)) < 1e-12
+
+    # pixel scales: corners map to the padded plot box exactly
+    assert x_pix_py(dom["t0"], dom, w) == PAD["l"]
+    assert x_pix_py(dom["t1"], dom, w) == w - PAD["r"]
+    assert y_pix_py(dom["v0"], dom, h) == h - PAD["b"]
+    assert abs(y_pix_py(dom["v1"], dom, h) - PAD["t"]) < 1e-12
+
+    # path string: one "x,y" pair per point, in order, JS formatting
+    pts = path_points_py(base, dom, w, h).split(" ")
+    assert len(pts) == len(base)
+    assert pts[0].split(",")[0] == "44"  # first point at the left pad
+
+    # band polygon: common timestamps only, forward + reversed back edge
+    poly = band_polygon_py(upper, lower, dom, w, h)
+    coords = poly.split(" ")
+    assert len(coords) == 2 * (len(upper) - 2)  # two missing lower pts
+    first_x = coords[0].split(",")[0]
+    last_x = coords[-1].split(",")[0]
+    assert first_x == last_x  # back edge returns to the start column
+
+    # anomaly dots ride the measured curve inside the plot box
+    for dot, a in zip(anomaly_dots_py(anoms, dom, w, h), anoms):
+        assert PAD["l"] <= dot["cx"] <= w - PAD["r"]
+        assert PAD["t"] <= dot["cy"] <= h - PAD["b"]
+        assert abs(dot["cx"] - x_pix_py(a["t"], dom, w)) < 1e-12
+
+    # tick layout: gridlines inside the box, x-tick count tracks width
+    ticks = tick_layout_py(dom, w, h)
+    assert all(PAD["t"] <= g["y"] <= h - PAD["b"] for g in ticks["yTicks"])
+    assert all(PAD["l"] <= g["x"] <= w - PAD["r"] for g in ticks["xTicks"])
+    assert len(tick_layout_py(dom, 880, h)["xTicks"]) >= len(ticks["xTicks"])
+
+    # degenerate and empty domains
+    flat = [{"t": 0, "v": 5.0}, {"t": 60, "v": 5.0}]
+    dflat = make_domain_py(flat, [], [])
+    assert dflat["v1"] - dflat["v0"] > 1  # widened, no /0
+    assert make_domain_py([], [], []) is None
+    nan = [{"t": 0, "v": float("nan")}]
+    assert make_domain_py(nan, [], []) is None  # all-NaN -> "no data"
+
+    # crosshair nearest-point lookup
+    assert nearest_py(base, 1239)["t"] == 1240
+    assert nearest_py(base, -1e9)["t"] == 1000
+    assert nearest_py([], 5) is None
