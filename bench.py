@@ -51,23 +51,7 @@ def main():
         # tests/test_engine.py::test_bf16_delta_scorer_matches_f32...
         # Default ON for the steady-state headline; FOREMAST_BF16_DELTA=0
         # opts back into f32 storage.
-        import dataclasses
-
-        import jax.numpy as jnp
-
-        from foremast_tpu.ops.windows import MetricWindows
-
-        anchor, delta = scoring.pack_hist_bf16_delta(
-            batch.historical.values, batch.historical.mask
-        )
-        slim = dataclasses.replace(
-            batch,
-            historical=MetricWindows(
-                values=jnp.zeros((B, 0), jnp.float32),
-                mask=batch.historical.mask,
-                times=None,
-            ),
-        )
+        slim, anchor, delta = scoring.make_bf16_delta_batch(batch)
         anchor, delta, slim = jax.device_put((anchor, delta, slim))
         jax.block_until_ready(delta)
 

@@ -256,7 +256,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
             if leader_pm and _os.path.exists(ckpt_path):
                 try:
                     judge.cache.load_local(ckpt_path)
-                    restored = dict(judge.cache._d)
+                    restored = judge.cache.snapshot()
                 except Exception as e:  # noqa: BLE001 - stale/corrupt
                     print(
                         f"model-cache restore failed ({e}); starting cold",

@@ -251,6 +251,40 @@ def _compact_result(verdict, anoms, upper, lower, p, differs, nidx):
     )
 
 
+def _pack_hist_bf16_host(series, length: int):
+    """Host-side anchor-shifted bf16-delta packing of ragged histories.
+
+    Returns (anchor f32 [B], delta bf16 [B, length], lens int32 [B]).
+    Rows are left-packed (valid prefix), so the device reconstructs the
+    mask from `lens` and the upload is 2 B/point — the cold-tick H2D is
+    the worker's dominant cost over the degraded tunnel (BENCHMARKS.md),
+    and this path ships ~2.5x fewer bytes than f32 values + bool mask.
+    Anchor = first valid value (the same shift masked_moments uses), so
+    deltas are bounded by the window range and bf16 keeps ~3 significant
+    digits of the deviations."""
+    import ml_dtypes
+
+    from foremast_tpu import native
+
+    b = len(series)
+    packed = native.pack_windows(list(series), length) if b else None
+    if packed is not None:
+        values, _, mask = packed
+        lens = mask.sum(axis=1).astype(np.int32)
+    else:
+        values = np.zeros((b, length), np.float32)
+        lens = np.zeros(b, np.int32)
+        for i, (_, v) in enumerate(series):
+            n = min(len(v), length)
+            values[i, :n] = np.asarray(v, np.float32)[:n]
+            lens[i] = n
+    anchor = values[:, 0].copy() if length else np.zeros(b, np.float32)
+    anchor[lens == 0] = 0.0
+    delta = values - anchor[:, None]
+    delta[np.arange(length)[None, :] >= lens[:, None]] = 0.0
+    return anchor, delta.astype(ml_dtypes.bfloat16), lens
+
+
 # Columnar-path padding: a zero terminal-state entry (n_hist=0 =>
 # UNKNOWN, dropped on decode) under one shared arena key.
 _PAD_ENTRY = (0.0, 0.0, np.zeros(1, np.float32), 0, 0.0, 0)
@@ -441,22 +475,71 @@ class HealthJudge:
         # rows at the 10,080-pt history, and one bucket-padded fit batch
         # would materialize gigabytes of host+device buffers; fixed-size
         # chunks reuse one compiled fit shape and bound peak memory.
+        # Cold fits ship anchor + bf16 deltas + lengths (2 B/point vs
+        # 5 B/point f32+mask): the cold tick is H2D-bound over the
+        # tunnel. The deployed default's fit needs only moments, which
+        # come from the deltas exactly; every other algorithm
+        # reconstructs f32 values in-program (fit_forecast_bf16_delta —
+        # the reconstruction is transient HBM, the saving is the wire).
+        # Quality pinned with the headline storage's tests;
+        # FOREMAST_BF16_DELTA=0 opts out.
+        bf16_fit = scoring.bf16_delta_enabled()
+        ma_fit = cfg.algorithm == "moving_average_all"
+        _zero_season = np.zeros(1, np.float32)
         for c0 in range(0, len(miss), _FIT_CHUNK):
             chunk = miss[c0 : c0 + _FIT_CHUNK]
             rows = bucket_length(len(chunk))
             pad = [chunk[0]] * (rows - len(chunk))  # repeat a real row:
-            hist = MetricWindows.from_ragged(  # bounded compile shapes
-                [(tasks[i].hist_times, tasks[i].hist_values) for i in chunk + pad],
-                th,
-                device_times=False,
-            )
-            fc = scoring.fit_forecast(
-                hist.values,
-                hist.mask,
-                algorithm=cfg.algorithm,
-                season_length=cfg.season_steps,
-            )
-            n_hist = hist.count().astype(jnp.int32)
+            ragged = [  # bounded compile shapes
+                (tasks[i].hist_times, tasks[i].hist_values)
+                for i in chunk + pad
+            ]
+            if bf16_fit and ma_fit:
+                anchor, delta, lens = _pack_hist_bf16_host(ragged, th)
+                level, scale, nh = self._fetch(
+                    scoring.fit_ma_from_bf16_delta(
+                        jnp.asarray(anchor),
+                        jnp.asarray(delta),
+                        jnp.asarray(lens),
+                    )
+                )
+                puts = []
+                for j, i in enumerate(chunk):
+                    entry = (
+                        float(level[j]),
+                        0.0,
+                        _zero_season,
+                        0,
+                        float(scale[j]),
+                        int(nh[j]),
+                    )
+                    entries[i] = entry
+                    if keys[i] is not None:
+                        puts.append((keys[i], entry))
+                if puts:
+                    self.fit_cache.put_many(puts)
+                continue
+            if bf16_fit:
+                anchor, delta, lens = _pack_hist_bf16_host(ragged, th)
+                fc = scoring.fit_forecast_bf16_delta(
+                    jnp.asarray(anchor),
+                    jnp.asarray(delta),
+                    jnp.asarray(lens),
+                    algorithm=cfg.algorithm,
+                    season_length=cfg.season_steps,
+                )
+                n_hist = jnp.asarray(lens)
+            else:
+                hist = MetricWindows.from_ragged(
+                    ragged, th, device_times=False
+                )
+                fc = scoring.fit_forecast(
+                    hist.values,
+                    hist.mask,
+                    algorithm=cfg.algorithm,
+                    season_length=cfg.season_steps,
+                )
+                n_hist = hist.count().astype(jnp.int32)
             # one overlapped D2H (same rationale as the result decode)
             level, trend, season, phase, scale, nh = self._fetch(
                 (fc.level, fc.trend, fc.season, fc.season_phase, fc.scale, n_hist)

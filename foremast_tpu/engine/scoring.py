@@ -475,6 +475,30 @@ def fit_forecast(
     return _fit_model(algorithm, values, mask, season_length)
 
 
+@partial(jax.jit, static_argnames=("algorithm", "season_length"))
+def fit_forecast_bf16_delta(
+    anchor: jax.Array,
+    delta: jax.Array,
+    lens: jax.Array,
+    algorithm: str = "moving_average_all",
+    season_length: int = 24,
+) -> Forecast:
+    """`fit_forecast` from a bf16-delta upload (any algorithm).
+
+    Values are reconstructed IN-PROGRAM — f32(anchor + delta) over the
+    valid prefix, mask from `lens` — and fed to the same fit. The
+    reconstruction is transient HBM; what it buys is the 2 B/point WIRE
+    upload (vs 5 B/point f32 values + bool mask), which is what bounds
+    cold fleet ticks over the tunnel (BENCHMARKS.md). Deviation
+    precision is bf16's ~3 significant digits relative to the window's
+    own range — pinned for the seasonal fits by the quality gates in
+    tests/test_engine.py."""
+    t = delta.shape[1]
+    mask = jnp.arange(t, dtype=jnp.int32)[None, :] < lens[:, None]
+    values = (anchor[:, None] + delta.astype(jnp.float32)) * mask
+    return _fit_model(algorithm, values, mask, season_length)
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -605,6 +629,38 @@ def score_from_arena(
 # path reads no history at all.
 
 
+def bf16_delta_enabled() -> bool:
+    """FOREMAST_BF16_DELTA gate (default ON): anchor-shifted bf16-delta
+    history handling for the moving-average family — the steady-state
+    headline storage AND the worker's cold-fit upload (judge.
+    _score_with_fit_cache), where history H2D is the cold-tick bound.
+    Set FOREMAST_BF16_DELTA=0 for full-f32 behavior."""
+    import os
+
+    return os.environ.get("FOREMAST_BF16_DELTA", "1") == "1"
+
+
+@jax.jit
+def fit_ma_from_bf16_delta(anchor: jax.Array, delta: jax.Array, lens: jax.Array):
+    """moving_average_all terminal state from bf16-delta history upload.
+
+    `delta` [B, T] bf16 (anchor-shifted, left-packed: padding slots are
+    exact zeros), `anchor` [B] f32, `lens` [B] int32 valid counts — the
+    mask is reconstructed on device from lengths, so the upload is
+    2 B/point instead of 5 B/point (f32 values + bool mask). Matches
+    ops.forecasters.moving_average_all's moments up to bf16 rounding of
+    the deviations (same pinned tolerance as score_bf16_delta)."""
+    n = lens.astype(jnp.float32)
+    s1 = jnp.sum(delta, axis=1, dtype=jnp.float32)
+    d32 = delta.astype(jnp.float32)
+    s2 = jnp.sum(d32 * d32, axis=1)
+    nn = jnp.maximum(n, 1.0)
+    mean_d = s1 / nn
+    mean = jnp.where(n > 0, anchor + mean_d, 0.0)
+    var = jnp.where(n > 0, jnp.maximum(s2 / nn - mean_d * mean_d, 0.0), 0.0)
+    return mean, jnp.sqrt(var), lens
+
+
 @jax.jit
 def pack_hist_bf16_delta(values: jax.Array, mask: jax.Array):
     """[B, T] f32 history -> (anchor [B] f32, delta [B, T] bf16).
@@ -617,6 +673,30 @@ def pack_hist_bf16_delta(values: jax.Array, mask: jax.Array):
     c = jnp.where(mask.any(axis=-1), c, 0.0)
     d = ((values - c[..., None]) * mask).astype(jnp.bfloat16)
     return c, d
+
+
+def make_bf16_delta_batch(batch: ScoreBatch):
+    """(slim_batch, anchor, delta) for `score_bf16_delta`.
+
+    Pins the structural contract in one place: the slim batch carries a
+    [B, 0] values buffer (no f32 history resides on device) but keeps
+    the FULL [B, T] mask, which score_bf16_delta reads for the valid
+    counts. Used by bench.py, the multichip dry run, and the tests."""
+    import dataclasses
+
+    anchor, delta = pack_hist_bf16_delta(
+        batch.historical.values, batch.historical.mask
+    )
+    b = batch.historical.values.shape[0]
+    slim = dataclasses.replace(
+        batch,
+        historical=MetricWindows(
+            values=jnp.zeros((b, 0), jnp.float32),
+            mask=batch.historical.mask,
+            times=None,
+        ),
+    )
+    return slim, anchor, delta
 
 
 @partial(

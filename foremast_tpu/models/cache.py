@@ -97,6 +97,12 @@ class ModelCache:
         with self._lock:
             return len(self._d)
 
+    def snapshot(self) -> dict:
+        """Point-in-time copy of the contents (lock-guarded) — e.g. the
+        pod-mode leader broadcasting its restored cache."""
+        with self._lock:
+            return dict(self._d)
+
     # -- optional durability (orbax) ------------------------------------
 
     def save(self, path: str) -> None:
@@ -128,10 +134,10 @@ class ModelCache:
         """Host-local checkpoint (pickle, atomic rename): unlike save(),
         performs NO cross-process coordination. Under jax.distributed,
         orbax's save is a collective (its sync barrier would deadlock
-        hosts that checkpoint at different tick cadences), while each
-        host's model cache is independent state (shared-nothing job
-        claims, design.md:35-43) — so multi-host workers each write
-        their own `model_cache.host{i}` file with this."""
+        processes that checkpoint at different tick cadences) — so the
+        pod-mode worker's LEADER writes the single `model_cache.pod`
+        file with this (restored entries are broadcast so every process
+        starts from the identical cache; cli.cmd_worker)."""
         import os
         import pickle
         import tempfile

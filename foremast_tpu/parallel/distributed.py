@@ -177,9 +177,15 @@ class PodWorker(BrainWorker):
         import os
 
         from foremast_tpu.engine.arena import _arena_bytes, _arena_max_bytes
+        from foremast_tpu.engine.scoring import bf16_delta_enabled
 
         knobs = broadcast_obj(
-            (self.cold_chunk_docs, _arena_bytes(), _arena_max_bytes())
+            (
+                self.cold_chunk_docs,
+                _arena_bytes(),
+                _arena_max_bytes(),
+                bf16_delta_enabled(),
+            )
             if is_leader()
             else None
         )
@@ -187,6 +193,10 @@ class PodWorker(BrainWorker):
             self.cold_chunk_docs = knobs[0]
             os.environ["FOREMAST_ARENA_BYTES"] = str(knobs[1])
             os.environ["FOREMAST_ARENA_MAX_BYTES"] = str(knobs[2])
+            # per-host skew here would dispatch f32 fits on one process
+            # and bf16-delta fits on its peers — differently-shaped SPMD
+            # programs over the shared mesh
+            os.environ["FOREMAST_BF16_DELTA"] = "1" if knobs[3] else "0"
 
     def tick(self, now: float | None = None) -> int:
         if now is None:

@@ -265,20 +265,27 @@ def test_fit_cache_reuses_fit_and_matches_fresh_results():
 
     tasks2 = [dataclasses.replace(t, job_id=t.job_id + "b") for t in tasks]
     orig = scoring.fit_forecast
+    orig16 = scoring.fit_forecast_bf16_delta
 
     def boom(*a, **k):  # pragma: no cover - failure path
         raise AssertionError("fit ran despite warm cache")
 
     scoring.fit_forecast = boom
+    scoring.fit_forecast_bf16_delta = boom  # bf16-delta fit path too
     try:
         got2 = cached.judge(tasks2)
     finally:
         scoring.fit_forecast = orig
+        scoring.fit_forecast_bf16_delta = orig16
 
     for a, b in zip(ref, got1):
         assert a.verdict == b.verdict
         assert a.anomaly_pairs == b.anomaly_pairs
-        np.testing.assert_allclose(a.upper, b.upper, rtol=1e-6)
+        # rtol covers the bf16-delta cold-fit upload (default on):
+        # deviations carry ~3 significant digits and HW's sequential
+        # scan compounds the rounding slightly (measured ~6e-4 rel);
+        # verdicts/pairs stay exact, band geometry is gated at 2%
+        np.testing.assert_allclose(a.upper, b.upper, rtol=5e-3)
         assert a.p_value == pytest.approx(b.p_value)
     for a, b in zip(got1, got2):
         assert a.verdict == b.verdict
@@ -327,7 +334,8 @@ def test_fit_cache_caches_cheap_fits_too():
     for a, b in zip(ref, got1):
         assert a.verdict == b.verdict
         assert a.anomaly_pairs == b.anomaly_pairs
-        np.testing.assert_allclose(a.upper, b.upper, rtol=1e-6)
+        # rtol covers the bf16-delta cold-fit upload (default on)
+        np.testing.assert_allclose(a.upper, b.upper, rtol=1e-4)
     for a, b in zip(got1, got2):
         assert a.verdict == b.verdict
         assert a.anomaly_pairs == b.anomaly_pairs
@@ -675,17 +683,7 @@ def test_bf16_delta_scorer_matches_f32_and_keeps_low_cv_bands():
     b, th = 64, 512
     batch = throughput_batch(b, th, 30, seed=3)
     ref = scoring.score(batch, algorithm="moving_average_all")
-    anchor, delta = scoring.pack_hist_bf16_delta(
-        batch.historical.values, batch.historical.mask
-    )
-    slim = dataclasses.replace(
-        batch,
-        historical=MetricWindows(
-            values=jnp.zeros((b, 0), jnp.float32),
-            mask=batch.historical.mask,
-            times=None,
-        ),
-    )
+    slim, anchor, delta = scoring.make_bf16_delta_batch(batch)
     got = scoring.score_bf16_delta(slim, anchor, delta)
     assert (np.asarray(got.verdict) == np.asarray(ref.verdict)).all()
     assert (np.asarray(got.anomalies) == np.asarray(ref.anomalies)).all()
@@ -713,15 +711,7 @@ def test_bf16_delta_scorer_matches_f32_and_keeps_low_cv_bands():
         ),
     )
     ref_low = scoring.score(low, algorithm="moving_average_all")
-    a2, d2 = scoring.pack_hist_bf16_delta(low.historical.values, low.historical.mask)
-    slim_low = dataclasses.replace(
-        low,
-        historical=MetricWindows(
-            values=jnp.zeros((b, 0), jnp.float32),
-            mask=low.historical.mask,
-            times=None,
-        ),
-    )
+    slim_low, a2, d2 = scoring.make_bf16_delta_batch(low)
     got_low = scoring.score_bf16_delta(slim_low, a2, d2)
     ref_scale = np.asarray(ref_low.upper - ref_low.lower)
     got_scale = np.asarray(got_low.upper - got_low.lower)
@@ -731,3 +721,63 @@ def test_bf16_delta_scorer_matches_f32_and_keeps_low_cv_bands():
         atol=5e-3,
     )
     assert (np.asarray(got_low.verdict) == np.asarray(ref_low.verdict)).all()
+
+
+def test_bf16_delta_fit_path_daily_seasonal_quality():
+    """Generalized bf16-delta cold-fit upload (any algorithm): the
+    auto_univariate daily fit from reconstructed bf16 deltas must land
+    the same terminal state (within bf16 deviation tolerance) and the
+    SAME anomaly flags as the f32 fit on the m=1440 workload shape."""
+    import jax.numpy as jnp
+
+    from benchmarks.quality import gen, make_batch
+    from foremast_tpu.engine import scoring
+    from foremast_tpu.engine.judge import _pack_hist_bf16_host
+
+    b, th, tc, m = 8, 10_080, 30, 1440
+    hist, cur, truth = gen("seasonal", b, th, tc, period=m)
+    t = np.arange(th, dtype=np.int64)
+    ragged = [(t, hist[i]) for i in range(b)]
+    anchor, delta, lens = _pack_hist_bf16_host(ragged, th)
+    fc16 = scoring.fit_forecast_bf16_delta(
+        jnp.asarray(anchor),
+        jnp.asarray(delta),
+        jnp.asarray(lens),
+        algorithm="auto_univariate",
+        season_length=m,
+    )
+    fc32 = scoring.fit_forecast(
+        jnp.asarray(hist),
+        jnp.ones((b, th), bool),
+        algorithm="auto_univariate",
+        season_length=m,
+    )
+    assert np.allclose(
+        np.asarray(fc16.level), np.asarray(fc32.level), atol=2e-3
+    )
+    s16, s32 = np.asarray(fc16.scale), np.asarray(fc32.scale)
+    assert np.all(np.abs(s16 - s32) <= 0.02 * s32 + 1e-6)
+    assert np.allclose(
+        np.asarray(fc16.season), np.asarray(fc32.season), atol=1e-2
+    )
+
+    batch = make_batch(hist, cur)
+    n_hist = jnp.asarray(lens)
+
+    def judge(fc):
+        return scoring.score_from_state(
+            batch,
+            fc.level,
+            fc.trend,
+            fc.season,
+            fc.season_phase,
+            fc.scale,
+            n_hist,
+        )
+
+    r16, r32 = judge(fc16), judge(fc32)
+    assert (np.asarray(r16.anomalies) == np.asarray(r32.anomalies)).all()
+    assert (np.asarray(r16.verdict) == np.asarray(r32.verdict)).all()
+    # and the flags actually catch the injected spikes (not vacuous)
+    flags = np.asarray(r16.anomalies)
+    assert (flags & truth).sum() >= 0.98 * truth.sum()

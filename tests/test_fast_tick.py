@@ -179,3 +179,38 @@ def test_admission_revalidates_per_key_not_wholesale():
     for k in admit:
         if k != "job-0":
             assert admit[k][1] is rows_before[k]  # untouched rowsinfo
+
+
+@pytest.mark.parametrize(
+    "algorithm", ["moving_average_all", "auto_univariate"],
+    ids=["ma-moments-shortcut", "seasonal-reconstruct"],
+)
+def test_cold_fit_bf16_upload_matches_f32(monkeypatch, algorithm):
+    """Cold fits upload anchor+bf16 deltas (FOREMAST_BF16_DELTA, default
+    on): the deployed default via the moments shortcut, every other
+    algorithm via in-program reconstruction. Verdicts, reasons, and
+    anomaly_info must match the f32 fit path on both the cold tick and
+    the warm re-check tick that scores from the cached state."""
+    services = 5
+    a_w, a_store, a_src = _mk_worker(services, algorithm, 24)
+    b_w, b_store, b_src = _mk_worker(services, algorithm, 24)
+
+    for src in (a_src, b_src):
+        url = next(u for u in src.data if "cur" in u and "latency:app2" in u)
+        ct, cv = src.data[url]
+        spiked = cv.copy()
+        spiked[-2:] = 40.0
+        src.data[url] = (ct, spiked)
+
+    assert a_w.tick(now=NOW + 150) == services  # bf16 fit upload (default)
+    monkeypatch.setenv("FOREMAST_BF16_DELTA", "0")
+    assert b_w.tick(now=NOW + 150) == services  # f32 fit upload
+    monkeypatch.delenv("FOREMAST_BF16_DELTA")
+    assert _statuses(a_store) == _statuses(b_store)
+    assert _statuses(a_store)["job-2"][0] == STATUS_COMPLETED_UNHEALTH
+
+    # the spiked doc is terminal; the warm tick re-checks the rest
+    assert a_w.tick(now=NOW + 200) == services - 1
+    monkeypatch.setenv("FOREMAST_BF16_DELTA", "0")
+    assert b_w.tick(now=NOW + 200) == services - 1
+    assert _statuses(a_store) == _statuses(b_store)
