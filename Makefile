@@ -18,6 +18,9 @@ native:
 deploy-render:
 	$(PY) -m foremast_tpu.deploy deploy
 
+metrics-lint:
+	$(PY) -m foremast_tpu.observe.metrics_lint
+
 docker-build:
 	docker build -t foremast/foremast-tpu:0.1.0 .
 
@@ -25,4 +28,4 @@ clean:
 	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 
-.PHONY: test bench bench-suite native deploy-render docker-build clean
+.PHONY: test bench bench-suite native deploy-render metrics-lint docker-build clean

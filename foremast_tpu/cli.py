@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import logging
 import os
 import sys
 import time
@@ -168,14 +169,20 @@ def _make_store(elastic_url: str | None):
 
 
 def cmd_serve(args: argparse.Namespace) -> int:
+    from foremast_tpu.observe import setup_logging
+    from foremast_tpu.observe.spans import Tracer
     from foremast_tpu.service.app import serve
 
+    setup_logging()
     store = _make_store(args.elastic_url)
     serve(
         host=args.host,
         port=args.port,
         store=store,
         query_endpoint=args.query_endpoint,
+        # per-request spans + the /debug/state trace section; the ring
+        # buffer dump is gated by FOREMAST_TRACE_DIR as everywhere
+        tracer=Tracer(service="service"),
     )
     return 0
 
@@ -185,11 +192,8 @@ def cmd_worker(args: argparse.Namespace) -> int:
     from foremast_tpu.config import BrainConfig
     from foremast_tpu.jobs.worker import BrainWorker
     from foremast_tpu.metrics.source import PrometheusSource
-    from foremast_tpu.observe.gauges import (
-        BrainGauges,
-        make_verdict_hook,
-        start_metrics_server,
-    )
+    from foremast_tpu.observe.gauges import BrainGauges, make_verdict_hook
+    from foremast_tpu.observe.spans import Tracer, start_observe_server
 
     from foremast_tpu.observe import setup_logging
 
@@ -296,16 +300,20 @@ def cmd_worker(args: argparse.Namespace) -> int:
 
     on_verdict = None
     worker_metrics = None
+    tracer = None
     # pod mode: telemetry is leader-only — every process executes the
     # full tick over the IDENTICAL broadcast fleet, so follower gauges
     # would multiply all job/verdict/arena counts by process_count
     leader = store is not None if pod_mode else True
+    if leader:
+        # span pipeline: stage histograms always; the Perfetto ring
+        # buffer only when FOREMAST_TRACE_DIR points somewhere
+        tracer = Tracer(service="worker", trace_dir=config.trace_dir)
     if args.gauge_port and leader:
         from foremast_tpu.observe.gauges import WorkerMetrics
 
         gauges = BrainGauges()
         worker_metrics = WorkerMetrics()
-        start_metrics_server(args.gauge_port)
         on_verdict = make_verdict_hook(gauges)
     if pod_mode:
         # One logical worker spanning the jax.distributed cluster: the
@@ -323,6 +331,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
             claim_limit=args.claim_limit,
             on_verdict=on_verdict,
             metrics=worker_metrics,
+            tracer=tracer,
         )
     else:
         worker = BrainWorker(
@@ -333,6 +342,13 @@ def cmd_worker(args: argparse.Namespace) -> int:
             claim_limit=args.claim_limit,
             on_verdict=on_verdict,
             metrics=worker_metrics,
+            tracer=tracer,
+        )
+    if args.gauge_port and leader:
+        # /metrics + /healthz + /debug/state on the scrape port (the
+        # reference exposed /metrics only)
+        start_observe_server(
+            args.gauge_port, state_fn=worker.debug_state
         )
 
     after_tick = None
@@ -371,6 +387,15 @@ def cmd_worker(args: argparse.Namespace) -> int:
     )
     if ckpt_path and len(judge.cache):
         ckpt_save(ckpt_path)  # final checkpoint on the way out
+    if tracer is not None:
+        try:
+            tracer.flush()  # final Perfetto dump (no-op without a trace dir)
+        except OSError as e:
+            # an unwritable trace dir must not turn a clean shutdown
+            # into a nonzero exit — the judgment work already succeeded
+            logging.getLogger("foremast_tpu.cli").warning(
+                "final trace flush failed: %s", e
+            )
     return 0
 
 
@@ -406,13 +431,25 @@ def cmd_watch_plane(args: argparse.Namespace) -> int:
     """Run the deployed watch-plane controller (barrelman equivalent)."""
     import os
 
+    from foremast_tpu.observe import setup_logging
+    from foremast_tpu.observe.spans import Tracer
     from foremast_tpu.watch.kubeapi import HttpKube
     from foremast_tpu.watch.plane import WatchPlane
 
+    setup_logging()
     kube = HttpKube(base_url=args.api_server)
     plane = WatchPlane(
-        kube, own_namespace=args.namespace or os.environ.get("NAMESPACE", "foremast")
+        kube,
+        own_namespace=args.namespace or os.environ.get("NAMESPACE", "foremast"),
+        tracer=Tracer(service="controller"),
     )
+    if args.gauge_port:
+        # the transition counter and poll-stage histogram register on
+        # the default registry — without this server they'd be
+        # unscrapeable in the only process that produces them
+        from foremast_tpu.observe.spans import start_observe_server
+
+        start_observe_server(args.gauge_port, state_fn=plane.debug_state)
     plane.run()
     return 0
 
@@ -542,6 +579,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--namespace",
         default=None,
         help="controller's own namespace (NAMESPACE downward-API parity)",
+    )
+    p.add_argument(
+        "--gauge-port",
+        type=int,
+        default=0,
+        help="controller metrics/varz exposition port (0 disables; pick a "
+        "port distinct from the worker's :8000 when co-hosted)",
     )
 
     p = sub.add_parser("ui", help="dashboard on :8080 (foremast-browser parity)")

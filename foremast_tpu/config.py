@@ -157,6 +157,26 @@ class BrainConfig:
     max_stuck_seconds: float = 90.0  # MAX_STUCK_IN_SECONDS, yaml:80-81
     max_cache_size: int = 1000  # MAX_CACHE_SIZE model cache, README:30
     es_endpoint: str = "http://localhost:9200"  # ES_ENDPOINT, yaml:22-23
+    # FOREMAST_TRACE_DIR: directory for Perfetto-loadable span dumps
+    # (observe/spans.py); None disables the trace ring buffer entirely —
+    # the deployed default pays only the stage histograms.
+    trace_dir: str | None = None
+
+    def fingerprint(self) -> str:
+        """Stable short hash of the effective JUDGMENT config — exported
+        on /debug/state so two workers' configs can be compared at a
+        glance (a fleet serving one store with divergent thresholds is a
+        misconfiguration the varz plane should make visible).
+        Plumbing fields are excluded: turning tracing on for one worker,
+        or reaching the SAME store via a sidecar address, must not make
+        it look misconfigured."""
+        import dataclasses
+        import hashlib
+
+        d = dataclasses.asdict(self)
+        for plumbing in ("trace_dir", "es_endpoint"):
+            d.pop(plumbing, None)
+        return hashlib.sha256(repr(d).encode()).hexdigest()[:12]
 
     @staticmethod
     def from_env(env: Mapping[str, str] | None = None) -> "BrainConfig":
@@ -224,4 +244,5 @@ class BrainConfig:
             max_stuck_seconds=get("MAX_STUCK_IN_SECONDS", 90.0),
             max_cache_size=get("MAX_CACHE_SIZE", 1000),
             es_endpoint=get("ES_ENDPOINT", "http://localhost:9200"),
+            trace_dir=e.get("FOREMAST_TRACE_DIR") or None,
         )

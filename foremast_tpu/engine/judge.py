@@ -22,6 +22,7 @@ import numpy as np
 
 from foremast_tpu.config import BrainConfig
 from foremast_tpu.engine import scoring
+from foremast_tpu.observe.spans import span
 from foremast_tpu.ops.windows import MetricWindows
 
 log = logging.getLogger("foremast_tpu.judge")
@@ -471,18 +472,47 @@ class HealthJudge:
             for i, e in zip(need, fetched):
                 entries[i] = e
         miss = [i for i, e in enumerate(entries) if e is None]
-        # Fit miss rows in bounded chunks: a fleet-cold tick can miss 40k+
-        # rows at the 10,080-pt history, and one bucket-padded fit batch
-        # would materialize gigabytes of host+device buffers; fixed-size
-        # chunks reuse one compiled fit shape and bound peak memory.
-        # Cold fits ship anchor + bf16 deltas + lengths (2 B/point vs
-        # 5 B/point f32+mask): the cold tick is H2D-bound over the
-        # tunnel. The deployed default's fit needs only moments, which
-        # come from the deltas exactly; every other algorithm
-        # reconstructs f32 values in-program (fit_forecast_bf16_delta —
-        # the reconstruction is transient HBM, the saving is the wire).
-        # Quality pinned with the headline storage's tests;
-        # FOREMAST_BF16_DELTA=0 opts out.
+        # fit stage spans the whole miss-refit loop; near-zero samples on
+        # warm ticks are the signal that the fit cache is doing its job
+        with span(
+            "judge.fit",
+            stage="fit",
+            rows=len(tasks),
+            misses=len(miss),
+            device=True,
+        ):
+            self._fit_miss_rows(miss, tasks, keys, entries, th)
+        gap = (
+            jnp.asarray(_gap_steps(tasks))
+            if cfg.algorithm in GAP_SENSITIVE_FITS
+            else None
+        )
+        pw = dict(
+            pairwise_algorithm=cfg.pairwise.algorithm,
+            p_threshold=cfg.pairwise.threshold,
+            min_mw=cfg.pairwise.min_mann_white_points,
+            min_wilcoxon=cfg.pairwise.min_wilcoxon_points,
+            min_kruskal=cfg.pairwise.min_kruskal_points,
+            min_friedman=cfg.pairwise.min_friedman_points,
+        )
+        return self._arena_score(batch, keys, entries, miss, gap, pw)
+
+    def _fit_miss_rows(self, miss, tasks, keys, entries, th) -> None:
+        """Fit the cache-miss rows in bounded chunks, filling `entries`
+        in place and populating the fit cache.
+
+        A fleet-cold tick can miss 40k+ rows at the 10,080-pt history,
+        and one bucket-padded fit batch would materialize gigabytes of
+        host+device buffers; fixed-size chunks reuse one compiled fit
+        shape and bound peak memory. Cold fits ship anchor + bf16
+        deltas + lengths (2 B/point vs 5 B/point f32+mask): the cold
+        tick is H2D-bound over the tunnel. The deployed default's fit
+        needs only moments, which come from the deltas exactly; every
+        other algorithm reconstructs f32 values in-program
+        (fit_forecast_bf16_delta — the reconstruction is transient HBM,
+        the saving is the wire). Quality pinned with the headline
+        storage's tests; FOREMAST_BF16_DELTA=0 opts out."""
+        cfg = self.config
         bf16_fit = scoring.bf16_delta_enabled()
         ma_fit = cfg.algorithm == "moving_average_all"
         _zero_season = np.zeros(1, np.float32)
@@ -559,20 +589,6 @@ class HealthJudge:
                     puts.append((keys[i], entry))
             if puts:
                 self.fit_cache.put_many(puts)
-        gap = (
-            jnp.asarray(_gap_steps(tasks))
-            if cfg.algorithm in GAP_SENSITIVE_FITS
-            else None
-        )
-        pw = dict(
-            pairwise_algorithm=cfg.pairwise.algorithm,
-            p_threshold=cfg.pairwise.threshold,
-            min_mw=cfg.pairwise.min_mann_white_points,
-            min_wilcoxon=cfg.pairwise.min_wilcoxon_points,
-            min_kruskal=cfg.pairwise.min_kruskal_points,
-            min_friedman=cfg.pairwise.min_friedman_points,
-        )
-        return self._arena_score(batch, keys, entries, miss, gap, pw)
 
     def _arena_score(self, batch, keys, entries, force, gap, pw):
         """Arena-gathered judgment shared by the object and columnar
@@ -593,24 +609,34 @@ class HealthJudge:
         if arena is None:
             arena = self._arena_for(max(len(e[2]) for e in entries))
         if arena is not None:
-            assigned = arena.assign(keys, force)
-            if assigned is not None and assigned[1]:
-                m_scat = max(len(entries[i][2]) for i in assigned[1])
-                if m_scat > arena.m:
-                    # wider season than the arena was built for: rebuild
-                    # (empty) at the new width and re-assign everything
-                    arena = self._arena_for(m_scat)
-                    assigned = arena.assign(keys, force)
+            with span(
+                "judge.arena_assemble",
+                stage="arena_assemble",
+                rows=len(keys),
+                device=True,
+            ):
+                assigned = arena.assign(keys, force)
                 if assigned is not None and assigned[1]:
-                    arena.scatter(assigned[0], assigned[1], entries)
+                    m_scat = max(len(entries[i][2]) for i in assigned[1])
+                    if m_scat > arena.m:
+                        # wider season than the arena was built for:
+                        # rebuild (empty) at the new width and re-assign
+                        # everything
+                        arena = self._arena_for(m_scat)
+                        assigned = arena.assign(keys, force)
+                    if assigned is not None and assigned[1]:
+                        arena.scatter(assigned[0], assigned[1], entries)
             if assigned is not None:
-                return scoring.score_from_arena(
-                    batch,
-                    *arena.state,
-                    jnp.asarray(assigned[0]),
-                    gap_steps=gap,
-                    **pw,
-                )
+                with span(
+                    "judge.score", stage="score", rows=len(keys), device=True
+                ):
+                    return scoring.score_from_arena(
+                        batch,
+                        *arena.state,
+                        jnp.asarray(assigned[0]),
+                        gap_steps=gap,
+                        **pw,
+                    )
         # fallback (arena disabled, or batch exceeds even the hard byte
         # cap): one-off host stack + upload, no cross-tick device reuse.
         # COUNTED and logged — a fleet living on this path re-pays its
@@ -626,7 +652,8 @@ class HealthJudge:
                 arena.hard_rows,
                 arena.m,
             )
-        return self._stacked_score(batch, entries, gap, pw)
+        with span("judge.score", stage="score", rows=len(keys), device=True):
+            return self._stacked_score(batch, entries, gap, pw)
 
     def _stacked_score(self, batch, entries, gap, pw):
         """One-off host stack + upload of terminal state (the no-arena
@@ -725,32 +752,36 @@ class HealthJudge:
         )
         gap = None if gap_steps is None else jnp.asarray(gap_steps)
         res = self._arena_score(batch, keys, entries, (), gap, pw)
-        if with_bands and self.band_mode == "full":
-            # full [B, tc] bands for custom hooks (parity with the object
-            # path's "full" mode — same band shape on warm and cold ticks)
-            v8, packed, ub, lb = self._fetch(
-                _compact_full_nopair(
-                    res.verdict, res.anomalies, res.upper, res.lower
+        with span(
+            "judge.decode", stage="decode", rows=rows_b, device=True
+        ):
+            if with_bands and self.band_mode == "full":
+                # full [B, tc] bands for custom hooks (parity with the
+                # object path's "full" mode — same band shape on warm
+                # and cold ticks)
+                v8, packed, ub, lb = self._fetch(
+                    _compact_full_nopair(
+                        res.verdict, res.anomalies, res.upper, res.lower
+                    )
                 )
-            )
-            ub, lb = ub[:b0], lb[:b0]
-        elif with_bands:
-            v8, packed, ub, lb = self._fetch(
-                _compact_result_nopair(
-                    res.verdict,
-                    res.anomalies,
-                    res.upper,
-                    res.lower,
-                    jnp.asarray(nidx),
+                ub, lb = ub[:b0], lb[:b0]
+            elif with_bands:
+                v8, packed, ub, lb = self._fetch(
+                    _compact_result_nopair(
+                        res.verdict,
+                        res.anomalies,
+                        res.upper,
+                        res.lower,
+                        jnp.asarray(nidx),
+                    )
                 )
-            )
-            ub, lb = ub[:b0], lb[:b0]
-        else:
-            v8, packed = self._fetch(
-                _compact_min(res.verdict, res.anomalies)
-            )
-            ub = lb = None
-        anoms = np.unpackbits(packed, axis=1, count=tc)
+                ub, lb = ub[:b0], lb[:b0]
+            else:
+                v8, packed = self._fetch(
+                    _compact_min(res.verdict, res.anomalies)
+                )
+                ub = lb = None
+            anoms = np.unpackbits(packed, axis=1, count=tc)
         return v8[:b0], anoms[:b0], ub, lb
 
     def _judge_bucket(
@@ -813,22 +844,35 @@ class HealthJudge:
         if use_cache:
             res = self._score_with_fit_cache(batch, tasks, th)
         else:
-            res = scoring.score(
-                batch,
-                gap_steps=(
-                    jnp.asarray(_gap_steps(tasks))
-                    if cfg.algorithm in GAP_SENSITIVE_FITS
-                    else None
-                ),
-                algorithm=cfg.algorithm,
-                season_length=cfg.season_steps,
-                pairwise_algorithm=cfg.pairwise.algorithm,
-                p_threshold=cfg.pairwise.threshold,
-                min_mw=cfg.pairwise.min_mann_white_points,
-                min_wilcoxon=cfg.pairwise.min_wilcoxon_points,
-                min_kruskal=cfg.pairwise.min_kruskal_points,
-                min_friedman=cfg.pairwise.min_friedman_points,
-            )
+            with span(
+                "judge.score", stage="score", rows=len(tasks), device=True
+            ):
+                res = scoring.score(
+                    batch,
+                    gap_steps=(
+                        jnp.asarray(_gap_steps(tasks))
+                        if cfg.algorithm in GAP_SENSITIVE_FITS
+                        else None
+                    ),
+                    algorithm=cfg.algorithm,
+                    season_length=cfg.season_steps,
+                    pairwise_algorithm=cfg.pairwise.algorithm,
+                    p_threshold=cfg.pairwise.threshold,
+                    min_mw=cfg.pairwise.min_mann_white_points,
+                    min_wilcoxon=cfg.pairwise.min_wilcoxon_points,
+                    min_kruskal=cfg.pairwise.min_kruskal_points,
+                    min_friedman=cfg.pairwise.min_friedman_points,
+                )
+        # decode waits on the device (score spans measure async dispatch
+        # only), so XLA execution time lands here on the stage histogram
+        with span(
+            "judge.decode", stage="decode", rows=len(tasks), device=True
+        ):
+            return self._decode_bucket(tasks, res, tc)
+
+    def _decode_bucket(
+        self, tasks: list[MetricTask], res, tc: int
+    ) -> list[MetricVerdict]:
         # ONE overlapped device->host fetch for all result arrays: a bare
         # np.asarray per jax.Array issues a synchronous round trip PER
         # ARRAY, and over the TPU tunnel each such round trip carries a

@@ -197,6 +197,11 @@ class Document:
     reason: str = ""
     processing_content: str = ""
     anomaly_info: dict[str, Any] | None = None
+    # correlation ID minted by the service at create time (observe/spans):
+    # carried through the store so worker/controller logs and traces can
+    # join back to the originating request. Additive — serialized only
+    # when set, so reference clients see an unchanged document shape.
+    trace_id: str = ""
 
     def to_json(self) -> dict[str, Any]:
         return {
@@ -217,6 +222,7 @@ class Document:
             "strategy": self.strategy,
             "reason": self.reason,
             "processingContent": self.processing_content,
+            **({"traceId": self.trace_id} if self.trace_id else {}),
             **({"anomalyInfo": self.anomaly_info} if self.anomaly_info else {}),
         }
 
@@ -241,6 +247,7 @@ class Document:
             reason=d.get("reason", ""),
             processing_content=d.get("processingContent", ""),
             anomaly_info=d.get("anomalyInfo"),
+            trace_id=d.get("traceId", ""),
         )
 
 

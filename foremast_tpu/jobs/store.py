@@ -36,6 +36,7 @@ from foremast_tpu.jobs.models import (
     TERMINAL_STATUSES,
     Document,
 )
+from foremast_tpu.observe.spans import span
 
 
 log = logging.getLogger("foremast_tpu.jobs.store")
@@ -96,6 +97,13 @@ class JobStore:
 
     def list_open(self) -> list[Document]:
         raise NotImplementedError
+
+    def count_open(self) -> int:
+        """Open (non-terminal) document count — the queue-depth varz.
+        Default materializes list_open(); stores with a server-side
+        count (ES `_count`) override so liveness probes don't page full
+        documents (and aren't capped by list_open's fetch size)."""
+        return len(self.list_open())
 
 
 def _is_claimable(doc: Document, now: float, max_stuck: float) -> bool:
@@ -191,6 +199,7 @@ INDEX_MAPPINGS = {
         "baselineMetricStore": {"type": "keyword", "index": False, "doc_values": False},
         "historicalMetricStore": {"type": "keyword", "index": False, "doc_values": False},
         "reason": {"type": "keyword", "index": False, "doc_values": False},
+        "traceId": {"type": "keyword", "index": False, "doc_values": False},
         "anomalyInfo": {"type": "object", "enabled": False},
     }
 }
@@ -217,6 +226,30 @@ class ElasticsearchStore(JobStore):
 
         self.endpoint = endpoint.rstrip("/")
         self._s = session or requests.Session()
+        # probe/varz handlers (count_open) run on their own threads and
+        # requests.Session is not thread-safe — give them a dedicated
+        # session mirroring the main one's auth/TLS config. Injected
+        # test doubles are reused directly.
+        if isinstance(self._s, requests.Session):
+            probe = requests.Session()
+            probe.headers.update(self._s.headers)
+            probe.auth = self._s.auth
+            probe.verify = self._s.verify
+            probe.cert = self._s.cert
+            probe.proxies.update(self._s.proxies)
+            # transport adapters carry pinned SSLContexts/retry/pool
+            # config; urllib3 pools are thread-safe, so sharing the
+            # instances is fine — losing them would make probes fail TLS
+            # against an ES the main session reaches
+            for prefix, adapter in self._s.adapters.items():
+                probe.mount(prefix, adapter)
+            self._probe_s = probe
+        else:
+            self._probe_s = self._s
+        # several probe threads can overlap (service /healthz +
+        # /debug/state, worker ThreadingHTTPServer scrapes) — serialize
+        # their use of the one probe session
+        self._probe_lock = threading.Lock()
         self.timeout = timeout
 
     # -- helpers --------------------------------------------------------
@@ -310,6 +343,31 @@ class ElasticsearchStore(JobStore):
                     f"{bad}; claim semantics require "
                     f"{self.CLAIM_CRITICAL_TYPES} — reindex required"
                 )
+            # additive upgrade: fields the template gained since the
+            # index was created (e.g. traceId) would otherwise fall to
+            # dynamic mapping on first write — analyzed text + doc_values
+            # for a field the template pins as unindexed keyword. ES
+            # allows ADDING fields in place, so pin them now; best-effort
+            # because dynamic mapping is merely today's pre-upgrade cost.
+            missing = {
+                f: spec
+                for f, spec in INDEX_MAPPINGS["properties"].items()
+                if f not in props
+            }
+            if missing:
+                pm = self._s.put(
+                    self._url("_mapping"),
+                    json={"properties": missing},
+                    timeout=self.timeout,
+                )
+                if pm.status_code >= 400:
+                    log.warning(
+                        "could not add %s to existing '%s' mapping "
+                        "(HTTP %d); new fields will be dynamically mapped",
+                        sorted(missing),
+                        self.INDEX,
+                        pm.status_code,
+                    )
             return True
         r.raise_for_status()
         return True
@@ -359,6 +417,9 @@ class ElasticsearchStore(JobStore):
         cutoff = datetime.fromtimestamp(
             now - max_stuck_seconds, timezone.utc
         ).strftime("%Y-%m-%dT%H:%M:%SZ")
+        # children of the worker's claim stage span: the two ES round
+        # trips (search, bulk CAS) separate on the trace timeline, so a
+        # slow claim attributes to the store, not to scoring
         query = {
             "size": limit,
             "seq_no_primary_term": True,  # required for the CAS below
@@ -391,10 +452,11 @@ class ElasticsearchStore(JobStore):
                 }
             },
         }
-        r = self._s.post(
-            self._url("_search"), json=query, timeout=self.timeout
-        )
-        r.raise_for_status()
+        with span("es.claim_search", limit=limit):
+            r = self._s.post(
+                self._url("_search"), json=query, timeout=self.timeout
+            )
+            r.raise_for_status()
         hits = r.json().get("hits", {}).get("hits", [])
 
         import json as _json
@@ -419,13 +481,14 @@ class ElasticsearchStore(JobStore):
             docs.append(doc)
         if not docs:
             return []
-        rr = self._s.post(
-            self._url("_bulk"),
-            data="\n".join(lines) + "\n",
-            headers={"Content-Type": "application/x-ndjson"},
-            timeout=self.timeout,
-        )
-        rr.raise_for_status()
+        with span("es.claim_bulk_cas", docs=len(docs)):
+            rr = self._s.post(
+                self._url("_bulk"),
+                data="\n".join(lines) + "\n",
+                headers={"Content-Type": "application/x-ndjson"},
+                timeout=self.timeout,
+            )
+            rr.raise_for_status()
         items = rr.json().get("items", [])
         out = []
         for doc, item in zip(docs, items):
@@ -467,13 +530,14 @@ class ElasticsearchStore(JobStore):
             doc.modified_at = stamp
             lines.append(_json.dumps({"index": {"_id": doc.id}}))
             lines.append(_json.dumps(doc.to_json()))
-        r = self._s.post(
-            self._url("_bulk"),
-            data="\n".join(lines) + "\n",
-            headers={"Content-Type": "application/x-ndjson"},
-            timeout=self.timeout,
-        )
-        r.raise_for_status()
+        with span("es.update_bulk", docs=len(docs)):
+            r = self._s.post(
+                self._url("_bulk"),
+                data="\n".join(lines) + "\n",
+                headers={"Content-Type": "application/x-ndjson"},
+                timeout=self.timeout,
+            )
+            r.raise_for_status()
         body = r.json()
         if body.get("errors"):
             for item in body.get("items", []):
@@ -483,14 +547,28 @@ class ElasticsearchStore(JobStore):
                         f"bulk update item failed for {info.get('_id')}: {item}"
                     )
 
+    _OPEN_QUERY = {
+        "bool": {"must_not": {"terms": {"status": list(TERMINAL_STATUSES)}}}
+    }
+
     def list_open(self):
-        query = {
-            "size": 1000,
-            "query": {"bool": {"must_not": {"terms": {"status": list(TERMINAL_STATUSES)}}}},
-        }
+        query = {"size": 1000, "query": self._OPEN_QUERY}
         r = self._s.post(self._url("_search"), json=query, timeout=self.timeout)
         r.raise_for_status()
         return [
             Document.from_json(h["_source"])
             for h in r.json().get("hits", {}).get("hits", [])
         ]
+
+    def count_open(self) -> int:
+        # runs on probe/varz handler threads: uses the dedicated probe
+        # session (never self._s, which the tick thread owns); the short
+        # timeout keeps liveness probes fast even when ES is wedged
+        with span("es.count_open"), self._probe_lock:
+            r = self._probe_s.post(
+                self._url("_count"),
+                json={"query": self._OPEN_QUERY},
+                timeout=min(self.timeout, 2.0),
+            )
+            r.raise_for_status()
+        return int(r.json().get("count", 0))

@@ -38,12 +38,15 @@ from foremast_tpu.jobs.models import (
     STATUS_COMPLETED_UNKNOWN,
     STATUS_PREPROCESS_COMPLETED,
     STATUS_PREPROCESS_FAILED,
+    TERMINAL_STATUSES,
     AnomalyInfo,
     Document,
 )
 from foremast_tpu.jobs.store import JobStore, parse_time
 from foremast_tpu.metrics.promql import decode_config
 from foremast_tpu.metrics.source import MetricSource
+from foremast_tpu.observe.logs import ctx_log
+from foremast_tpu.observe.spans import inherit_span, span
 
 log = logging.getLogger("foremast_tpu.worker")
 
@@ -113,6 +116,7 @@ class BrainWorker:
         on_verdict: Callable[[Document, list[MetricVerdict]], None] | None = None,
         metrics=None,  # observe.gauges.WorkerMetrics (optional)
         band_mode: str = "last",
+        tracer=None,  # observe.spans.Tracer (optional)
     ):
         """`band_mode` controls how much of the model band each verdict
         carries back from the device: "last" (default — only the final
@@ -197,6 +201,17 @@ class BrainWorker:
             _os.environ.get("FOREMAST_COLD_CHUNK_DOCS", "1024")
         )
         self.metrics = metrics
+        # Span tracer (observe/spans.py): tick() opens a root span and
+        # every stage — claim, fetch, fit, arena, score, decide, write —
+        # parents to it via the ambient-context helper, so the engine
+        # and store need no tracer plumbing. None = zero overhead.
+        self.tracer = tracer
+        self._last_tick = {"at": 0.0, "docs": 0, "fast": 0, "seconds": 0.0}
+        # last status logged per open job (pruned on terminal): open docs
+        # are re-judged every poll, and re-asserting an unchanged status
+        # at INFO would flood logs at fleet scale
+        self._judged_status: dict[str, str] = {}
+        self._JUDGED_STATUS_CAP = 16384
 
     # -- preprocess: document -> MetricTasks ----------------------------
 
@@ -606,13 +621,18 @@ class BrainWorker:
                 log.warning("preprocess failed for %s: %s", item[0].id, e)
                 return None
 
-        if len(fast) > 1 and getattr(self.source, "concurrent_fetch", True):
-            from concurrent.futures import ThreadPoolExecutor
+        with span("worker.fetch", stage="metric_fetch", docs=len(fast)):
+            if len(fast) > 1 and getattr(
+                self.source, "concurrent_fetch", True
+            ):
+                from concurrent.futures import ThreadPoolExecutor
 
-            with ThreadPoolExecutor(max_workers=min(16, len(fast))) as pool:
-                series = list(pool.map(fetch_doc, fast))
-        else:
-            series = [fetch_doc(item) for item in fast]
+                with ThreadPoolExecutor(
+                    max_workers=min(16, len(fast))
+                ) as pool:
+                    series = list(pool.map(inherit_span(fetch_doc), fast))
+            else:
+                series = [fetch_doc(item) for item in fast]
 
         failed = []
         ok_items = []
@@ -700,7 +720,6 @@ class BrainWorker:
         seg_unh = np.maximum.reduceat(is_unh, starts)
         seg_min = np.minimum.reduceat(v8, starts)
         nz_r, nz_c = np.nonzero(anoms)
-        hook = self.on_verdict
 
         def pairs_for(r, s_local, k2):
             lo_i = np.searchsorted(nz_r, r)
@@ -714,6 +733,22 @@ class BrainWorker:
             flat[1::2] = np.asarray(cv)[cols]
             return flat.tolist()
 
+        with span("worker.decide", stage="decide", docs=len(ok_items)):
+            updated = self._decide_fast(
+                ok_items, v8, seg_unh, seg_min, starts, pairs_for,
+                ub, lb, tc, now,
+            )
+        with span("worker.write_back", stage="write_back", docs=len(updated)):
+            self.store.update_many(updated)
+        return len(ok_items) + len(failed), slow
+
+    def _decide_fast(
+        self, ok_items, v8, seg_unh, seg_min, starts, pairs_for,
+        ub, lb, tc, now,
+    ):
+        """Fast-path status decisions + hook dispatch (split from
+        _fast_tick so the decide stage is one guarded span)."""
+        hook = self.on_verdict
         updated = []
         observe = self.metrics.observe_doc if self.metrics else None
         for j, ((doc, end_epoch, rowsinfo, _), s) in enumerate(ok_items):
@@ -731,6 +766,7 @@ class BrainWorker:
                     if p:
                         values_map[rowsinfo[k2][0]] = p
             self._decide_status(doc, jv, values_map, now, end_epoch)
+            self._log_judged(doc)
             updated.append(doc)
             if observe:
                 observe(doc.status, len(s))
@@ -769,19 +805,28 @@ class BrainWorker:
                     hook(doc, vs)
                 except Exception:
                     log.exception("on_verdict hook failed for %s", doc.id)
-        self.store.update_many(updated)
-        return len(ok_items) + len(failed), slow
+        return updated
 
 
     # -- main cycle ------------------------------------------------------
 
     def tick(self, now: float | None = None) -> int:
         """One claim-fetch-judge-write cycle. Returns #docs processed."""
+        if self.tracer is None:
+            return self._tick(now)
+        # the root span mints the tick's trace ID: every stage span
+        # below (and the engine/store spans nested inside them) shares
+        # it, as do JSON log records emitted while the tick is open
+        with self.tracer.span("worker.tick", worker=self.worker_id):
+            return self._tick(now)
+
+    def _tick(self, now: float | None = None) -> int:
         t0 = time.perf_counter()
         now = time.time() if now is None else now
-        docs = self.store.claim(
-            self.worker_id, self.config.max_stuck_seconds, self.claim_limit
-        )
+        with span("worker.claim", stage="claim", limit=self.claim_limit):
+            docs = self.store.claim(
+                self.worker_id, self.config.max_stuck_seconds, self.claim_limit
+            )
         if not docs:
             # idle cycles still did the claim round-trip (real store I/O)
             # and must be visible on the tick histogram
@@ -804,6 +849,7 @@ class BrainWorker:
                     self.metrics.tick_seconds.observe(
                         time.perf_counter() - t0
                     )
+                self._tick_done(n_fast, n_fast, t0)
                 return n_fast
 
         # Progressive admission (VERDICT r4 #7): the slow path — cold
@@ -830,18 +876,24 @@ class BrainWorker:
             # source actually blocks on I/O: in-memory sources declare
             # concurrent_fetch=False, and threading pure-Python dict
             # lookups is pure GIL overhead on the worker's host core.
-            if use_pool:
-                from concurrent.futures import ThreadPoolExecutor
-                from functools import partial as _partial
+            with span("worker.fetch", stage="metric_fetch", docs=len(chunk)):
+                if use_pool:
+                    from concurrent.futures import ThreadPoolExecutor
+                    from functools import partial as _partial
 
-                with ThreadPoolExecutor(
-                    max_workers=min(16, len(chunk))
-                ) as pool:
-                    fetched = list(
-                        pool.map(_partial(self._fetch_tasks, now=now), chunk)
-                    )
-            else:
-                fetched = [self._fetch_tasks(doc, now) for doc in chunk]
+                    with ThreadPoolExecutor(
+                        max_workers=min(16, len(chunk))
+                    ) as pool:
+                        fetched = list(
+                            pool.map(
+                                inherit_span(
+                                    _partial(self._fetch_tasks, now=now)
+                                ),
+                                chunk,
+                            )
+                        )
+                else:
+                    fetched = [self._fetch_tasks(doc, now) for doc in chunk]
             all_tasks: list[MetricTask] = []
             failed: list[Document] = []
             ok_docs: list[Document] = []
@@ -863,18 +915,22 @@ class BrainWorker:
             for v in verdicts:
                 by_job.setdefault(v.job_id, []).append(v)
 
-            for doc in ok_docs:
-                vs = by_job.get(doc.id, [])
-                self._write_back(doc, vs, now)
-                if self.metrics:
-                    self.metrics.observe_doc(doc.status, len(vs))
-                if self.on_verdict:
-                    try:
-                        self.on_verdict(doc, vs)
-                    except Exception:
-                        log.exception(
-                            "on_verdict hook failed for %s", doc.id
-                        )
+            # decide covers status transition + per-doc persistence
+            # (_write_back keeps both so subclass overrides stay valid)
+            with span("worker.decide", stage="decide", docs=len(ok_docs)):
+                for doc in ok_docs:
+                    vs = by_job.get(doc.id, [])
+                    self._write_back(doc, vs, now)
+                    self._log_judged(doc)
+                    if self.metrics:
+                        self.metrics.observe_doc(doc.status, len(vs))
+                    if self.on_verdict:
+                        try:
+                            self.on_verdict(doc, vs)
+                        except Exception:
+                            log.exception(
+                                "on_verdict hook failed for %s", doc.id
+                            )
             if self.metrics:
                 for doc in failed:
                     self.metrics.observe_doc(doc.status, 0)
@@ -884,7 +940,100 @@ class BrainWorker:
             ):
                 self.metrics.observe_arena(self._uni.device_state_counters())
             self.metrics.tick_seconds.observe(time.perf_counter() - t0)
+        self._tick_done(n_fast + len(docs), n_fast, t0)
         return n_fast + len(docs)
+
+    def _log_judged(self, doc) -> None:
+        """One correlatable line per service-created judgment: emitted
+        inside the tick span, so the record carries the tick's
+        trace/span IDs AND the request trace ID the service stamped on
+        the document (`job_trace_id`) — grep either ID to find the
+        other. Docs without a stamped ID (direct store writes) stay
+        silent. INFO only on the first judgment or a status CHANGE
+        (mirroring the controller's transitions counter); a re-judged
+        open doc whose status held re-asserts at DEBUG, else a fleet of
+        open jobs emits thousands of identical lines per poll."""
+        if doc.trace_id:
+            prev = self._judged_status.get(doc.id)
+            level = logging.INFO if doc.status != prev else logging.DEBUG
+            if doc.status in TERMINAL_STATUSES:
+                self._judged_status.pop(doc.id, None)
+            else:
+                self._judged_status[doc.id] = doc.status
+                # bound the map: a peer worker may land a job's terminal
+                # judgment, leaving our entry orphaned forever. Evict
+                # oldest-inserted past the cap — a still-open evictee
+                # merely re-logs one INFO line on its next judgment.
+                while len(self._judged_status) > self._JUDGED_STATUS_CAP:
+                    self._judged_status.pop(
+                        next(iter(self._judged_status))
+                    )
+            ctx_log(
+                log,
+                level,
+                "judgment",
+                job_id=doc.id,
+                status=doc.status,
+                job_trace_id=doc.trace_id,
+            )
+
+    def _tick_done(self, n_docs: int, n_fast: int, t0: float) -> None:
+        """Record the finished busy tick for /debug/state and emit one
+        correlatable completion log (the tick's trace ID rides on the
+        JSON record when a tracer is wired)."""
+        seconds = time.perf_counter() - t0
+        self._last_tick = {
+            "at": time.time(),
+            "docs": n_docs,
+            "fast": n_fast,
+            "seconds": seconds,
+        }
+        ctx_log(
+            log,
+            logging.INFO,
+            "tick complete",
+            docs=n_docs,
+            fast_path=n_fast,
+            seconds=round(seconds, 4),
+        )
+
+    def debug_state(self) -> dict:
+        """The /debug/state varz payload (observe.start_observe_server):
+        queue depth, cache occupancy, arena counters with hit rate, the
+        latest tick's stage breakdown, and config identity."""
+        from foremast_tpu import __version__
+
+        try:
+            queue_depth: int | None = self.store.count_open()
+            store_ok = True
+        except Exception:  # noqa: BLE001 - varz must not depend on ES health
+            queue_depth, store_ok = None, False
+        arena = None
+        if self._uni is not None:
+            arena = self._uni.device_state_counters()
+            looked = arena.get("hits", 0) + arena.get("misses", 0)
+            arena["hit_rate"] = (
+                round(arena.get("hits", 0) / looked, 4) if looked else None
+            )
+        state = {
+            "worker_id": self.worker_id,
+            "version": __version__,
+            "config_fingerprint": self.config.fingerprint(),
+            "claim_limit": self.claim_limit,
+            "queue_depth": queue_depth,
+            "store_ok": store_ok,
+            "model_cache": {
+                "fit_entries": len(self._fit_cache),
+                "fit_capacity": self.config.max_cache_size,
+                "hist_entries": len(self._hist_cache),
+                "admission_entries": len(self._admit),
+            },
+            "arena": arena,
+            "last_tick": dict(self._last_tick),
+        }
+        if self.tracer is not None:
+            state["trace"] = self.tracer.debug_state()
+        return state
 
     def run(
         self,

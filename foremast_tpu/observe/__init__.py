@@ -1,15 +1,26 @@
-"""Observability: gauge export, structured logs, profiler hooks."""
+"""Observability: spans, gauge export, structured logs, profiler hooks."""
 
 from foremast_tpu.observe.gauges import (
     BrainGauges,
+    WorkerMetrics,
     make_verdict_hook,
     start_metrics_server,
 )
 from foremast_tpu.observe.logs import JsonFormatter, ctx_log, setup_logging
 from foremast_tpu.observe.profile import annotate, trace_scoring
+from foremast_tpu.observe.spans import (
+    Span,
+    SpanRing,
+    Tracer,
+    counter,
+    current_span,
+    span,
+    start_observe_server,
+)
 
 __all__ = [
     "BrainGauges",
+    "WorkerMetrics",
     "make_verdict_hook",
     "start_metrics_server",
     "JsonFormatter",
@@ -17,4 +28,11 @@ __all__ = [
     "setup_logging",
     "annotate",
     "trace_scoring",
+    "Span",
+    "SpanRing",
+    "Tracer",
+    "counter",
+    "current_span",
+    "span",
+    "start_observe_server",
 ]

@@ -23,10 +23,20 @@ unchanged.
 
 from __future__ import annotations
 
+import logging
+import os
 import re
 import threading
 
+log = logging.getLogger("foremast_tpu.gauges")
+
 _SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
+
+# Gauge-family cap default: each family is 3 Gauge collectors held
+# forever in the registry, and metric names arrive from job configs
+# (REST-supplied), so an unbounded set is a memory + scrape-size leak
+# an adversarial or merely churning client can drive.
+DEFAULT_MAX_FAMILIES = 512
 
 
 def _san(name: str) -> str:
@@ -34,33 +44,96 @@ def _san(name: str) -> str:
 
 
 class BrainGauges:
-    """Lazily-created per-metric gauge triplets with a bounded family set."""
+    """Lazily-created per-metric gauge triplets with a bounded family set.
 
-    def __init__(self, registry=None, namespace: str = "foremastbrain"):
+    The bound is real (it was only a docstring promise before this):
+    at most `max_families` distinct metric families are ever created
+    (env `FOREMAST_MAX_GAUGE_FAMILIES`, default 512). Past the cap,
+    publishes for NEW metric names are dropped — counted on
+    `foremastbrain_gauge_families_dropped_total` and warned once —
+    while every already-created family keeps updating normally.
+    """
+
+    def __init__(
+        self,
+        registry=None,
+        namespace: str = "foremastbrain",
+        max_families: int | None = None,
+    ):
         from prometheus_client import REGISTRY, Gauge
+
+        from foremast_tpu.observe.spans import counter
 
         self._Gauge = Gauge
         self.registry = registry if registry is not None else REGISTRY
         self.ns = namespace
         self._fams: dict[str, tuple] = {}
         self._lock = threading.Lock()
+        self.max_families = (
+            max_families
+            if max_families is not None
+            else int(
+                os.environ.get("FOREMAST_MAX_GAUGE_FAMILIES", "")
+                or DEFAULT_MAX_FAMILIES
+            )
+        )
+        # shared-family helper, not a bare Counter: a second BrainGauges
+        # on the same registry must reuse the family, not explode on
+        # prometheus_client's duplicate-registration check
+        self.dropped = counter(
+            f"{self.ns}_gauge_families_dropped_total",
+            "distinct metric families dropped because the gauge-family "
+            "cap was hit",
+            registry=self.registry,
+        )
+        # counted once per distinct family, not per publish — the name
+        # says "families dropped" and a per-publish count would read as
+        # thousands shed when exactly one metric is over the cap. The
+        # dedup set is itself bounded: names arrive from REST-supplied
+        # job configs (the very leak the family cap defends against), so
+        # past the tracking bound the counter saturates instead of the
+        # set growing forever.
+        self._dropped_names: set[str] = set()
+        self._dropped_track_limit = max(4 * self.max_families, 1024)
+        self._cap_warned = False
 
     def _family(self, metric: str):
         key = _san(metric)
         with self._lock:
-            if key not in self._fams:
-                mk = lambda suffix, doc: self._Gauge(
-                    f"{self.ns}_{key}_{suffix}",
-                    doc,
-                    ["exported_namespace", "app"],
-                    registry=self.registry,
-                )
-                self._fams[key] = (
-                    mk("upper", f"model upper bound for {metric}"),
-                    mk("lower", f"model lower bound for {metric}"),
-                    mk("anomaly", f"last anomalous value for {metric}"),
-                )
-            return self._fams[key]
+            fam = self._fams.get(key)
+            if fam is not None:
+                return fam
+            if len(self._fams) >= self.max_families:
+                if (
+                    key not in self._dropped_names
+                    and len(self._dropped_names) < self._dropped_track_limit
+                ):
+                    self._dropped_names.add(key)
+                    self.dropped.inc()
+                if not self._cap_warned:
+                    self._cap_warned = True
+                    log.warning(
+                        "gauge-family cap (%d) hit; dropping new metric "
+                        "families from exposition (first dropped: %r) — "
+                        "raise FOREMAST_MAX_GAUGE_FAMILIES if the fleet "
+                        "legitimately carries more distinct series",
+                        self.max_families,
+                        metric,
+                    )
+                return None
+            mk = lambda suffix, doc: self._Gauge(
+                f"{self.ns}_{key}_{suffix}",
+                doc,
+                ["exported_namespace", "app"],
+                registry=self.registry,
+            )
+            fam = (
+                mk("upper", f"model upper bound for {metric}"),
+                mk("lower", f"model lower bound for {metric}"),
+                mk("anomaly", f"last anomalous value for {metric}"),
+            )
+            self._fams[key] = fam
+            return fam
 
     def publish(
         self,
@@ -71,7 +144,10 @@ class BrainGauges:
         lower: float,
         anomaly_value: float | None = None,
     ) -> None:
-        up, lo, an = self._family(metric)
+        fam = self._family(metric)
+        if fam is None:  # over the family cap; counted in self.dropped
+            return
+        up, lo, an = fam
         labels = dict(exported_namespace=namespace, app=app)
         up.labels(**labels).set(upper)
         lo.labels(**labels).set(lower)

@@ -12,6 +12,8 @@ import logging
 import sys
 import time
 
+from foremast_tpu.observe.spans import current_span
+
 
 class JsonFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
@@ -21,6 +23,13 @@ class JsonFormatter(logging.Formatter):
             "logger": record.name,
             "msg": record.getMessage(),
         }
+        # correlate with the span pipeline: any record emitted inside an
+        # open span carries its trace/span IDs, so logs, metrics and the
+        # Perfetto dump all join on one ID (observe/spans.py)
+        sp = current_span()
+        if sp is not None:
+            out["trace_id"] = sp.trace_id
+            out["span_id"] = sp.span_id
         if record.exc_info:
             out["exc"] = self.formatException(record.exc_info)
         extra = getattr(record, "ctx", None)

@@ -1,0 +1,120 @@
+"""Metric naming-convention lint — dashboard-contract enforcement.
+
+Every family this framework exports must match
+``foremast(brain)?_[a-z0-9_]+`` (the two prefixes the deployed
+dashboards, recording rules and alert rules key on), and the core
+families must carry exactly their documented label sets
+(docs/observability.md). A future PR renaming a family or adding a
+label silently breaks every dashboard built on it; ``make metrics-lint``
+and the tier-1 test in tests/test_observe.py make that a build failure
+instead.
+
+Usage:
+    lint_registry(registry) -> list of violation strings (empty = clean)
+    python -m foremast_tpu.observe.metrics_lint   # lints the default set
+"""
+
+from __future__ import annotations
+
+import re
+
+NAME_RE = re.compile(r"^foremast(brain)?_[a-z0-9_]+$")
+LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# prometheus_client-internal sample labels that are not family labels
+_SYNTHETIC_LABELS = frozenset({"le", "quantile"})
+
+# family name (as collected — counters are collected WITHOUT the _total
+# suffix) -> exact allowed label set. Families not listed here only need
+# the name/label regexes.
+ALLOWED_LABELS: dict[str, frozenset[str]] = {
+    "foremast_tick_stage_seconds": frozenset({"stage"}),
+    "foremast_worker_jobs": frozenset({"status"}),
+    "foremast_worker_windows": frozenset(),
+    "foremast_worker_tick_seconds": frozenset(),
+    "foremast_worker_arena_events": frozenset({"event"}),
+    "foremast_service_requests": frozenset({"route", "code"}),
+    "foremast_controller_transitions": frozenset({"phase"}),
+    "foremastbrain_gauge_families_dropped": frozenset(),
+}
+
+
+def lint_registry(registry) -> list[str]:
+    """Walk a CollectorRegistry and return naming/label violations."""
+    problems: list[str] = []
+    for family in registry.collect():
+        name = family.name
+        if not NAME_RE.match(name):
+            problems.append(
+                f"family {name!r} does not match foremast(brain)?_[a-z0-9_]+"
+            )
+        labels: set[str] = set()
+        for sample in family.samples:
+            labels.update(sample.labels)
+        labels -= _SYNTHETIC_LABELS
+        allowed = ALLOWED_LABELS.get(name)
+        if allowed is not None:
+            if labels - allowed:
+                problems.append(
+                    f"family {name!r} carries undocumented labels "
+                    f"{sorted(labels - allowed)} (allowed: {sorted(allowed)})"
+                )
+        else:
+            for lb in labels:
+                if not LABEL_RE.match(lb):
+                    problems.append(
+                        f"family {name!r} label {lb!r} does not match "
+                        "[a-z][a-z0-9_]*"
+                    )
+    return problems
+
+
+def default_registry_families():
+    """Instantiate every standard family on a fresh registry — the set a
+    deployed worker+service+controller exports — and exercise each once
+    so every label combination appears in the exposition."""
+    from prometheus_client import CollectorRegistry
+
+    from foremast_tpu.observe.gauges import BrainGauges, WorkerMetrics
+    from foremast_tpu.observe.spans import Tracer, counter
+
+    registry = CollectorRegistry()
+    gauges = BrainGauges(registry=registry)
+    gauges.publish("error5xx", "ns", "app", upper=1.0, lower=0.0, anomaly_value=2.0)
+    metrics = WorkerMetrics(registry=registry)
+    metrics.observe_doc("completed_health", 1)
+    metrics.observe_arena({"hits": 1, "misses": 1, "evictions": 0, "fallbacks": 0})
+    metrics.tick_seconds.observe(0.01)
+    tracer = Tracer(service="lint", registry=registry, trace_dir=None)
+    from foremast_tpu.observe.spans import TICK_STAGES
+
+    for stage in TICK_STAGES:
+        with tracer.span(f"lint.{stage}", stage=stage):
+            pass
+    counter(
+        "foremast_service_requests_total",
+        "service requests by route and status code",
+        ("route", "code"),
+        registry,
+    ).labels(route="/healthz", code="200").inc()
+    counter(
+        "foremast_controller_transitions_total",
+        "monitor phase transitions observed by the controller",
+        ("phase",),
+        registry,
+    ).labels(phase="Healthy").inc()
+    return registry
+
+
+def main() -> int:
+    problems = lint_registry(default_registry_families())
+    if problems:
+        for p in problems:
+            print(f"metrics-lint: {p}")
+        return 1
+    print("metrics-lint: all exported families conform")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -120,6 +120,14 @@ class LeaderStore(JobStore):
             self.inner.list_open() if is_leader() else None
         )
 
+    def count_open(self):
+        # varz-only (worker /debug/state), called from the observe-server
+        # probe thread — it must NOT enter a collective: followers never
+        # serve debug_state, so a broadcast here would have no matching
+        # participants and hang the pod on the first scrape. Leader
+        # answers locally; followers report 0 (they hold no queue).
+        return self.inner.count_open() if is_leader() else 0
+
 
 class LeaderSource(MetricSource):
     """Only process 0 performs metric fetches; series are broadcast.

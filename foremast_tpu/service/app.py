@@ -6,10 +6,22 @@ Route parity with `foremast-service/cmd/manager/main.go:262-276`:
     GET  /v1/healthcheck/id/{id}     -> SearchByID
     GET  /api/v1/{queryproxy}        -> CORS Prometheus proxy (UI)
 
-plus GET /healthz. The gateway validates + converts requests
-(`request_to_document`), creates jobs idempotently in the store, and
-serves external-status views; scoring happens in the BrainWorker against
-the same store.
+plus the observability surface this framework adds on top of the
+reference (which exposed nothing but gin's access log):
+
+    GET /healthz       liveness + store depth + version
+    GET /metrics       Prometheus exposition (request counters + any
+                       other family on the process registry)
+    GET /debug/state   JSON varz: queue depth, config identity, tracer
+                       state — the service side of the worker's
+                       /debug/state (observe.start_observe_server)
+
+The gateway validates + converts requests (`request_to_document`),
+creates jobs idempotently in the store, and serves external-status
+views; scoring happens in the BrainWorker against the same store. Every
+create mints a trace ID (observe/spans.py) stored on the document, so
+worker ticks and controller polls can be correlated back to the
+originating request.
 """
 
 from __future__ import annotations
@@ -17,13 +29,17 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import time
 
 import aiohttp
 from aiohttp import web
 
+from foremast_tpu import __version__
 from foremast_tpu.jobs.convert import InvalidRequest, request_to_document
 from foremast_tpu.jobs.models import AnalyzeRequest, document_response, status_to_external
 from foremast_tpu.jobs.store import InMemoryStore, JobStore
+from foremast_tpu.observe.logs import ctx_log
+from foremast_tpu.observe.spans import counter, current_span, new_trace_id
 
 log = logging.getLogger("foremast_tpu.service")
 
@@ -37,14 +53,66 @@ CORS_HEADERS = {
 }
 
 
+def _route_label(request: web.Request) -> str:
+    """Route PATTERN for the request counter ({id} stays a template —
+    raw paths would be an unbounded label cardinality leak)."""
+    try:
+        resource = request.match_info.route.resource
+        if resource is not None:
+            return resource.canonical
+    except Exception:  # noqa: BLE001 - labeling must never fail a request
+        pass
+    return "unmatched"
+
+
 def make_app(
     store: JobStore | None = None,
     query_endpoint: str | None = None,
+    tracer=None,
+    registry=None,
 ) -> web.Application:
     """query_endpoint: upstream Prometheus base (QUERY_SERVICE_ENDPOINT env
-    in the reference, `main.go:236-243`)."""
+    in the reference, `main.go:236-243`). `tracer` (observe.spans.Tracer)
+    opens one span per request; `registry` scopes the service's metric
+    families (default: the process registry)."""
     store = store if store is not None else InMemoryStore()
     query_endpoint = query_endpoint or os.environ.get("QUERY_SERVICE_ENDPOINT", "")
+    started = time.time()
+    requests_total = counter(
+        "foremast_service_requests_total",
+        "gateway requests by route pattern and status code",
+        ("route", "code"),
+        registry,
+    )
+
+    @web.middleware
+    async def observe_mw(request: web.Request, handler):
+        route = _route_label(request)
+        cm = None
+        if tracer is not None:
+            cm = tracer.span(
+                f"service.{request.method} {route}", method=request.method
+            )
+            cm.__enter__()
+        # code stays None for anything that is not an HTTP response the
+        # server produced — a CancelledError from a client disconnect
+        # must neither crash the finally nor count as a 500
+        code = None
+        try:
+            resp = await handler(request)
+            code = resp.status
+            return resp
+        except web.HTTPException as e:
+            code = e.status
+            raise
+        except Exception:
+            code = 500
+            raise
+        finally:
+            if code is not None:
+                requests_total.labels(route=route, code=str(code)).inc()
+            if cm is not None:
+                cm.__exit__(None, None, None)
 
     async def create(request: web.Request) -> web.Response:
         try:
@@ -62,9 +130,22 @@ def make_app(
             return web.json_response(
                 {"status": "error", "reason": str(e)}, status=400
             )
+        # correlation ID: the request span's trace ID (or a fresh one
+        # when tracing is off) rides on the document through the store,
+        # so every later tick/poll log can join back to this create
+        sp = current_span()
+        doc.trace_id = sp.trace_id if sp is not None else new_trace_id()
         # the store may be backed by blocking HTTP (Elasticsearch); keep
         # it off the event loop
         stored, created = await asyncio.to_thread(store.create, doc)
+        ctx_log(
+            log,
+            logging.INFO,
+            "job created" if created else "job exists",
+            job_id=stored.id,
+            app=stored.app_name,
+            job_trace_id=stored.trace_id,
+        )
         # ApplicationHealthAnalyzeResponse shape (models.go:63-80)
         return web.json_response(
             {
@@ -108,20 +189,66 @@ def make_app(
                 headers=CORS_HEADERS,
             )
 
+    async def _store_depth() -> int | None:
+        """Open (non-terminal) job count; None when the store is
+        unreachable or slow — health must report degradation in bounded
+        time, not raise or hang a liveness probe. The bound must stay
+        well under kubelet's default probe timeoutSeconds=1: a slow (not
+        down) store must degrade the body, never fail the probe."""
+        try:
+            return await asyncio.wait_for(
+                asyncio.to_thread(store.count_open), timeout=0.5
+            )
+        except Exception:  # noqa: BLE001
+            return None
+
     async def healthz(request: web.Request) -> web.Response:
-        return web.json_response({"ok": True})
+        depth = await _store_depth()
+        return web.json_response(
+            {
+                "ok": True,
+                "version": __version__,
+                "store_depth": depth,
+                "store_ok": depth is not None,
+            }
+        )
+
+    async def metrics(request: web.Request) -> web.Response:
+        from prometheus_client import (
+            CONTENT_TYPE_LATEST,
+            REGISTRY,
+            generate_latest,
+        )
+
+        payload = generate_latest(registry if registry is not None else REGISTRY)
+        return web.Response(body=payload, content_type=CONTENT_TYPE_LATEST.split(";")[0])
+
+    async def debug_state(request: web.Request) -> web.Response:
+        state = {
+            "component": "service",
+            "version": __version__,
+            "uptime_seconds": round(time.time() - started, 1),
+            "queue_depth": await _store_depth(),
+            "store": type(store).__name__,
+            "query_endpoint": bool(query_endpoint),
+        }
+        if tracer is not None:
+            state["trace"] = tracer.debug_state()
+        return web.json_response(state)
 
     async def _client_session(app: web.Application):
         app[SESSION_KEY] = aiohttp.ClientSession()
         yield
         await app[SESSION_KEY].close()
 
-    app = web.Application()
+    app = web.Application(middlewares=[observe_mw])
     app.cleanup_ctx.append(_client_session)
     app.router.add_post("/v1/healthcheck/create", create)
     app.router.add_get("/v1/healthcheck/id/{id}", by_id)
     app.router.add_get("/api/v1/{queryproxy}", query_proxy)
     app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/state", debug_state)
     app[STORE_KEY] = store
     return app
 

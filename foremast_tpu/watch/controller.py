@@ -27,6 +27,8 @@ import time as _time
 from typing import Callable
 
 from foremast_tpu.jobs.store import now_rfc3339, parse_time
+from foremast_tpu.observe.logs import ctx_log
+from foremast_tpu.observe.spans import counter, span
 from foremast_tpu.watch.analyst import AnalystClient, HttpAnalyst
 from foremast_tpu.watch.barrelman import Barrelman
 from foremast_tpu.watch.crds import (
@@ -77,6 +79,8 @@ class MonitorController:
         barrelman: Barrelman | None = None,
         analyst_factory: Callable[[str], AnalystClient] | None = None,
         clock: Callable[[], float] = _time.time,
+        tracer=None,
+        registry=None,
     ) -> None:
         self.kube = kube
         self.barrelman = barrelman
@@ -85,6 +89,17 @@ class MonitorController:
         )
         self.clock = clock
         self._unhealthy_since: dict[tuple[str, str], float] = {}
+        # span tracer (observe/spans.py): each monitor poll opens a root
+        # span; transition/rollback/pause spans nest under it so a
+        # remediation's latency is attributable on the same timeline as
+        # the worker's judgment stages
+        self.tracer = tracer
+        self.transitions = counter(
+            "foremast_controller_transitions_total",
+            "DeploymentMonitor phase transitions observed by the poller",
+            ("phase",),
+            registry,
+        )
 
     # ------------------------------------------------------------------
     # poll tick (checkRunningStatus)
@@ -107,12 +122,29 @@ class MonitorController:
             self._rearm_continuous(monitor)
 
     def _poll_running(self, monitor: DeploymentMonitor) -> None:
+        if self.tracer is None:
+            return self._poll_running_inner(monitor)
+        with self.tracer.span(
+            "controller.poll",
+            stage="poll",
+            namespace=monitor.namespace,
+            monitor=monitor.name,
+            job_id=monitor.status.job_id,
+        ):
+            return self._poll_running_inner(monitor)
+
+    def _poll_running_inner(self, monitor: DeploymentMonitor) -> None:
         now = self.clock()
         wait_until = parse_time(monitor.wait_until)
         try:
-            status = self.analyst_factory(monitor.analyst_endpoint).get_status(
-                monitor.status.job_id
-            )
+            with span(
+                "controller.get_status",
+                stage="get_status",
+                job_id=monitor.status.job_id,
+            ):
+                status = self.analyst_factory(
+                    monitor.analyst_endpoint
+                ).get_status(monitor.status.job_id)
             new_phase = status.phase
         except Exception as e:  # noqa: BLE001 - analyst down must not stall expiry
             log.warning(
@@ -125,14 +157,42 @@ class MonitorController:
                 monitor.status.phase = MonitorPhase.HEALTHY
                 monitor.status.expired = True
                 monitor.status.timestamp = now_rfc3339()
+                self.transitions.labels(phase=MonitorPhase.HEALTHY).inc()
                 self.kube.upsert_monitor(monitor)
             return
-        monitor.status.phase = new_phase
-        monitor.status.timestamp = now_rfc3339()
-        if status.anomaly:
-            monitor.status.anomaly = convert_to_anomaly(status.anomaly)
-        self.kube.upsert_monitor(monitor)
-        self.handle_transition(monitor)
+        old_phase = monitor.status.phase
+        # "update", not "transition": this span times every poll's
+        # status write-back + remediation dispatch, most of which merely
+        # re-assert the current phase
+        with span(
+            "controller.update",
+            stage="update",
+            phase=new_phase,
+            changed=new_phase != old_phase,
+            namespace=monitor.namespace,
+            monitor=monitor.name,
+        ):
+            monitor.status.phase = new_phase
+            monitor.status.timestamp = now_rfc3339()
+            # count/log PHASE CHANGES only — every poll re-asserts the
+            # current phase, and a rate() over re-assertions would just
+            # measure poll frequency
+            if new_phase != old_phase:
+                self.transitions.labels(phase=new_phase).inc()
+                ctx_log(
+                    log,
+                    logging.INFO,
+                    "monitor transition",
+                    namespace=monitor.namespace,
+                    monitor=monitor.name,
+                    phase=new_phase,
+                    from_phase=old_phase,
+                    job_id=monitor.status.job_id,
+                )
+            if status.anomaly:
+                monitor.status.anomaly = convert_to_anomaly(status.anomaly)
+            self.kube.upsert_monitor(monitor)
+            self.handle_transition(monitor)
 
     # ------------------------------------------------------------------
     # remediation (MonitorController informer UpdateFunc)
@@ -169,6 +229,15 @@ class MonitorController:
         """Roll the Deployment back to spec.rollbackRevision by patching
         its pod template from that revision's ReplicaSet
         (MonitorController.go:172-238, apps/v1 form)."""
+        with span(
+            "controller.rollback",
+            stage="rollback",
+            namespace=monitor.namespace,
+            monitor=monitor.name,
+        ):
+            self._rollback_inner(monitor)
+
+    def _rollback_inner(self, monitor: DeploymentMonitor) -> None:
         try:
             dep = self.kube.get_deployment(monitor.namespace, monitor.name)
         except NotFound:
@@ -220,19 +289,27 @@ class MonitorController:
 
     def pause(self, monitor: DeploymentMonitor) -> None:
         """Set spec.paused=true (MonitorController.go:254-281)."""
-        try:
-            self.kube.patch_deployment(
-                monitor.namespace, monitor.name, {"spec": {"paused": True}}
-            )
-            record_event(
-                self.kube,
-                monitor.namespace,
-                monitor.name,
-                reason="AutoPause",
-                message="paused rollout after unhealthy analysis",
-            )
-        except NotFound:
-            log.warning("pause target %s/%s gone", monitor.namespace, monitor.name)
+        with span(
+            "controller.pause",
+            stage="pause",
+            namespace=monitor.namespace,
+            monitor=monitor.name,
+        ):
+            try:
+                self.kube.patch_deployment(
+                    monitor.namespace, monitor.name, {"spec": {"paused": True}}
+                )
+                record_event(
+                    self.kube,
+                    monitor.namespace,
+                    monitor.name,
+                    reason="AutoPause",
+                    message="paused rollout after unhealthy analysis",
+                )
+            except NotFound:
+                log.warning(
+                    "pause target %s/%s gone", monitor.namespace, monitor.name
+                )
 
     # ------------------------------------------------------------------
     # continuous re-arm

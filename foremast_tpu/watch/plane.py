@@ -88,6 +88,8 @@ class WatchPlane:
         clock: Callable[[], float] = _time.time,
         sleep: Callable[[float], None] = _time.sleep,
         analyst_factory=None,
+        tracer=None,
+        registry=None,
     ) -> None:
         self.barrelman = Barrelman(
             kube,
@@ -95,10 +97,33 @@ class WatchPlane:
             clock=clock,
             analyst_factory=analyst_factory,
         )
-        self.controller = MonitorController(kube, barrelman=self.barrelman, clock=clock)
+        self.controller = MonitorController(
+            kube,
+            barrelman=self.barrelman,
+            clock=clock,
+            tracer=tracer,
+            registry=registry,
+        )
         self.informer = DeploymentInformer(kube, self.barrelman.handle_deployment)
         self.clock = clock
         self.sleep = sleep
+        self._started = clock()
+
+    def debug_state(self) -> dict:
+        """The /debug/state varz payload for the controller's scrape
+        port (observe.start_observe_server): identity, cached informer
+        size, and the tracer's poll-stage breakdown."""
+        from foremast_tpu import __version__
+
+        state = {
+            "component": "controller",
+            "version": __version__,
+            "uptime_seconds": round(self.clock() - self._started, 1),
+            "deployments_cached": len(self.informer._snapshot),
+        }
+        if self.controller.tracer is not None:
+            state["trace"] = self.controller.tracer.debug_state()
+        return state
 
     def step(self, now: float | None = None, last_resync: float = 0.0) -> float:
         """One scheduler step: monitor tick always; deployment resync when

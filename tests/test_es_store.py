@@ -89,6 +89,13 @@ class FakeES:
                 )
             self.mappings = (json or {}).get("mappings", {})
             return _Resp(200, {"acknowledged": True})
+        if u.path == "/documents/_mapping":  # additive field mapping
+            if self.mappings is None:
+                return _Resp(404, {"error": {"type": "index_not_found_exception"}})
+            self.mappings.setdefault("properties", {}).update(
+                (json or {}).get("properties", {})
+            )
+            return _Resp(200, {"acknowledged": True})
         m = re.fullmatch(r"/documents/_doc/([^/]+)", u.path)
         assert m, u.path
         doc_id = urllib.parse.unquote(m.group(1))
@@ -404,3 +411,26 @@ def test_ensure_index_rejects_divergent_preexisting_mapping():
     ok.mappings = INDEX_MAPPINGS  # pre-existing but compatible
     store2 = ElasticsearchStore("http://fake:9200", session=ok)
     assert store2.ensure_index()
+
+
+def test_ensure_index_pins_fields_added_since_index_creation():
+    """An index created by a previous version lacks template fields the
+    template has since gained (traceId); ensure_index must add them in
+    place so the first trace-stamped write doesn't fall to analyzed-text
+    dynamic mapping."""
+    from foremast_tpu.jobs.store import INDEX_MAPPINGS
+
+    fake = FakeES()
+    fake.mappings = {
+        "properties": {
+            k: v
+            for k, v in INDEX_MAPPINGS["properties"].items()
+            if k != "traceId"
+        }
+    }
+    store = ElasticsearchStore("http://fake:9200", session=fake)
+    assert store.ensure_index()
+    assert (
+        fake.mappings["properties"]["traceId"]
+        == INDEX_MAPPINGS["properties"]["traceId"]
+    )

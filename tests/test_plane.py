@@ -88,3 +88,30 @@ def test_watchplane_creates_monitor_for_existing_deployment():
     plane = WatchPlane(kube, clock=lambda: 0.0, sleep=lambda s: None)
     plane.step(last_resync=0.0)
     assert ("prod", "shop") in kube.monitors
+
+
+def test_watchplane_debug_state():
+    """The controller's /debug/state payload (served by watch-plane's
+    scrape port) carries identity, informer size, and tracer state."""
+    from prometheus_client import CollectorRegistry
+
+    from foremast_tpu.observe.spans import Tracer
+
+    kube = InMemoryKube()
+    kube.deployments[("prod", "shop")] = _dep("prod", "shop")
+    now = [100.0]
+    reg = CollectorRegistry()
+    plane = WatchPlane(
+        kube,
+        clock=lambda: now[0],
+        sleep=lambda s: None,
+        tracer=Tracer(service="controller", registry=reg),
+        registry=reg,
+    )
+    now[0] += 7
+    plane.step(last_resync=0.0)
+    state = plane.debug_state()
+    assert state["component"] == "controller" and state["version"]
+    assert state["uptime_seconds"] == 7.0
+    assert state["deployments_cached"] == 1
+    assert "trace" in state

@@ -129,3 +129,60 @@ def test_query_proxy_cors_and_gating():
             await client.close()
 
     _run(main())
+
+
+def test_observability_surface():
+    """ISSUE 1: the gateway exposes /metrics + enriched /healthz +
+    /debug/state, counts requests by route pattern, and mints a trace ID
+    on every created document so worker/controller telemetry can join
+    back to the originating request."""
+
+    async def main():
+        from prometheus_client import CollectorRegistry
+
+        from foremast_tpu.observe.spans import Tracer
+
+        store = InMemoryStore()
+        reg = CollectorRegistry()
+        app = make_app(
+            store=store,
+            tracer=Tracer(service="svc", registry=reg),
+            registry=reg,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/healthcheck/create", json=CREATE_BODY)
+            assert r.status == 200
+            jid = (await r.json())["jobId"]
+            # correlation ID minted at create rides on the stored doc
+            # (and round-trips the wire format as traceId)
+            doc = store.get(jid)
+            assert doc.trace_id
+            assert doc.to_json()["traceId"] == doc.trace_id
+
+            r = await client.get("/healthz")
+            health = await r.json()
+            assert health["ok"] is True and health["store_ok"] is True
+            assert health["version"] and health["store_depth"] == 1
+
+            r = await client.get("/debug/state")
+            state = await r.json()
+            assert state["component"] == "service"
+            assert state["queue_depth"] == 1
+            assert state["store"] == "InMemoryStore"
+            assert state["trace"]["service"] == "svc"
+
+            r = await client.get("/metrics")
+            assert r.status == 200
+            text = await r.text()
+            # route label is the PATTERN, not the raw path (cardinality)
+            assert (
+                'foremast_service_requests_total{code="200",'
+                'route="/v1/healthcheck/create"} 1.0' in text
+            )
+            assert 'route="/healthz"' in text
+        finally:
+            await client.close()
+
+    _run(main())
