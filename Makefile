@@ -18,8 +18,19 @@ native:
 deploy-render:
 	$(PY) -m foremast_tpu.deploy deploy
 
-metrics-lint:
-	$(PY) -m foremast_tpu.observe.metrics_lint
+# Unified static analysis (docs/static-analysis.md): jit-hygiene,
+# async-blocking, lock-discipline, env-contract + the metric naming
+# lint, gated against analysis_baseline.json.
+check:
+	$(PY) -m foremast_tpu.analysis
+
+# legacy alias — the metrics lint now runs inside `make check`
+metrics-lint: check
+
+# regenerate the env-knob table in docs/operations.md from
+# foremast_tpu/config.py's ENV_KNOBS registry
+env-docs:
+	$(PY) -m foremast_tpu.analysis --update-env-docs
 
 docker-build:
 	docker build -t foremast/foremast-tpu:0.1.0 .
@@ -28,4 +39,4 @@ clean:
 	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 
-.PHONY: test bench bench-suite native deploy-render metrics-lint docker-build clean
+.PHONY: test bench bench-suite native deploy-render check metrics-lint env-docs docker-build clean

@@ -1,0 +1,68 @@
+"""foremast-check: the repo's correctness contracts as machine-checked rules.
+
+The codebase encodes several invariants that survive only as docstrings
+and review lore: nothing host-syncing inside the jitted judgment
+(CONTRIBUTING.md "everything under jit stays fixed-shape"), nothing
+blocking on the aiohttp event loops, every lock-owning class touching its
+guarded state only under the lock, and every env knob declared in ONE
+registry so the config surface stays enumerable. ROADMAP.md explicitly
+invites aggressive refactoring, which is exactly how such invariants die
+silently — so this package turns them into AST-level checkers with a
+single gated runner:
+
+    python -m foremast_tpu.analysis        # or `make check`
+
+Architecture (core.py): each checker is a pure function of a parsed
+``Module`` (no imports of the checked code, no jax — the runner never
+dials an accelerator), emitting ``Finding``s with file:line, a stable
+rule ID, and a fix hint. Per-line ``# foremast: ignore[rule]``
+suppressions mark *deliberate* exceptions in place; the committed
+``analysis_baseline.json`` grandfathers pre-existing findings without
+letting new ones in. The runner folds in the metric naming lint
+(observe/metrics_lint.py — the bespoke precedent this generalizes) and
+exits non-zero on any new finding, which a tier-1 test enforces.
+
+Rules: jit-hygiene, async-blocking, lock-discipline, env-contract,
+metrics-lint. See docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+from foremast_tpu.analysis.core import (
+    Baseline,
+    Checker,
+    Finding,
+    Module,
+    analyze_modules,
+    analyze_source,
+    collect_modules,
+    repo_root,
+)
+
+
+def all_checkers() -> list[Checker]:
+    """One instance of every AST checker, in report order."""
+    from foremast_tpu.analysis.async_blocking import AsyncBlockingChecker
+    from foremast_tpu.analysis.env_contract import EnvContractChecker
+    from foremast_tpu.analysis.jit_hygiene import JitHygieneChecker
+    from foremast_tpu.analysis.lock_discipline import LockDisciplineChecker
+
+    return [
+        JitHygieneChecker(),
+        AsyncBlockingChecker(),
+        LockDisciplineChecker(),
+        EnvContractChecker(),
+    ]
+
+
+__all__ = [
+    "Baseline",
+    "Checker",
+    "Finding",
+    "Module",
+    "all_checkers",
+    "analyze_modules",
+    "analyze_source",
+    "collect_modules",
+    "repo_root",
+]

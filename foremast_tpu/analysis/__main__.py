@@ -1,0 +1,156 @@
+"""The gated runner: `python -m foremast_tpu.analysis` (== `make check`).
+
+Exit codes: 0 clean (modulo the committed baseline), 1 findings, 2 bad
+usage. Folds in the metric naming lint (observe/metrics_lint.py) so ONE
+command gates every machine-checked contract; `--write-baseline`
+snapshots today's findings as grandfathered debt (the committed
+`analysis_baseline.json` should only ever shrink).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from foremast_tpu.analysis import all_checkers
+from foremast_tpu.analysis.core import (
+    Baseline,
+    Finding,
+    analyze_modules,
+    collect_modules,
+    repo_root,
+)
+from foremast_tpu.analysis.env_contract import check_env_docs, update_env_docs
+
+BASELINE_NAME = "analysis_baseline.json"
+
+
+def metrics_lint_findings() -> list[Finding]:
+    """The pre-existing metric naming lint as findings under rule
+    `metrics-lint` — same gate, same reporting."""
+    from foremast_tpu.observe import metrics_lint
+
+    problems = metrics_lint.lint_registry(
+        metrics_lint.default_registry_families()
+    )
+    return [
+        Finding(
+            rule="metrics-lint",
+            path="foremast_tpu/observe/metrics_lint.py",
+            line=1,
+            message=p,
+            hint="metric families must match the dashboard contract "
+            "(docs/observability.md)",
+        )
+        for p in problems
+    ]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m foremast_tpu.analysis",
+        description="foremast-check: jit-hygiene, async-blocking, "
+        "lock-discipline, env-contract, metrics-lint",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to scan (default: the foremast_tpu package)",
+    )
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--baseline",
+        default=None,
+        help=f"baseline file (default: <repo>/{BASELINE_NAME})",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="snapshot current findings as the new baseline and exit 0",
+    )
+    p.add_argument(
+        "--no-metrics-lint",
+        action="store_true",
+        help="skip the metric naming lint fold-in",
+    )
+    p.add_argument(
+        "--update-env-docs",
+        action="store_true",
+        help="regenerate the env-knob table in docs/operations.md and exit",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    root = repo_root()
+    if args.update_env_docs:
+        changed = update_env_docs(root)
+        print(
+            "env docs regenerated"
+            if changed
+            else "env docs already in sync"
+        )
+        return 0
+
+    modules = collect_modules(root, args.paths or None)
+    findings = analyze_modules(modules, all_checkers())
+    if not args.paths:
+        # repo-level contracts only make sense on the default full scan
+        findings.extend(check_env_docs(root))
+        if not args.no_metrics_lint:
+            findings.extend(metrics_lint_findings())
+    findings.sort(key=Finding.sort_key)
+
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    if args.write_baseline:
+        Baseline.from_findings(findings).save(baseline_path)
+        print(
+            f"wrote {len(findings)} grandfathered finding(s) to "
+            f"{os.path.relpath(baseline_path, root)}"
+        )
+        return 0
+
+    baseline = Baseline.load(baseline_path)
+    new, grandfathered = baseline.split(findings)
+    stale = baseline.stale(findings)
+
+    if args.json:
+        json.dump(
+            {
+                "findings": [f.to_json() for f in new],
+                "grandfathered": [f.to_json() for f in grandfathered],
+                "stale_baseline": stale,
+            },
+            sys.stdout,
+            indent=2,
+        )
+        print()
+    else:
+        for f in new:
+            print(f.render())
+        if grandfathered:
+            print(
+                f"foremast-check: {len(grandfathered)} grandfathered "
+                f"finding(s) suppressed by {BASELINE_NAME}"
+            )
+        for e in stale:
+            print(
+                "foremast-check: stale baseline entry (debt paid — remove "
+                f"it): [{e['rule']}] {e['path']}: {e['message']}"
+            )
+        if new:
+            print(
+                f"foremast-check: {len(new)} new finding(s); fix, suppress "
+                "with `# foremast: ignore[rule]` + justification, or (last "
+                "resort) re-baseline — docs/static-analysis.md"
+            )
+        else:
+            print("foremast-check: clean")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

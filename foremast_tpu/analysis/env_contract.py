@@ -1,0 +1,233 @@
+"""env-contract: every env read outside config.py maps to a declared knob.
+
+The reference brain is configured entirely through environment
+variables, and this framework inherited the habit: knobs accreted in
+arena sizing, bf16 storage, gauge caps, UI endpoints, native-loader
+gates... Each stray ``os.environ.get`` is configuration surface that
+docs, `/debug/state` fingerprinting, and operators cannot enumerate.
+
+The contract: ``foremast_tpu/config.py`` declares the registry
+(``ENV_KNOBS`` — name, default, type, description); every literal env
+read anywhere else in the package must name a registered knob. Reads of
+*computed* names are flagged too (they defeat enumeration) — a thin
+wrapper whose call sites pass literals documents itself with a
+``# foremast: ignore[env-contract]`` at the single dynamic read.
+
+The registry is also the single source for the operator docs: the env
+table in ``docs/operations.md`` between the ``ENV REGISTRY`` markers is
+GENERATED (``python -m foremast_tpu.analysis --update-env-docs`` or
+``make env-docs``), and the default run reports a finding when the
+committed table has drifted from the registry.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from foremast_tpu.analysis.core import Checker, Finding, Module, os_import_aliases
+
+DOCS_RELPATH = "docs/operations.md"
+DOCS_BEGIN = "<!-- BEGIN ENV REGISTRY (generated: make env-docs) -->"
+DOCS_END = "<!-- END ENV REGISTRY -->"
+
+
+def _dotted(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def registered_names() -> frozenset[str]:
+    """Knob names from the config registry (imported lazily — config
+    pulls numpy/jax, which the AST passes never need)."""
+    from foremast_tpu.config import ENV_KNOBS
+
+    return frozenset(k.name for k in ENV_KNOBS)
+
+
+class EnvContractChecker(Checker):
+    rule = "env-contract"
+    description = (
+        "os.environ reads outside config.py must name a registered knob"
+    )
+
+    # the registry module itself, and the analysis package (which would
+    # otherwise flag its own documentation strings' AST fixtures)
+    EXEMPT = ("foremast_tpu/config.py",)
+
+    def __init__(self, names: frozenset[str] | None = None):
+        self._names = names
+
+    @property
+    def names(self) -> frozenset[str]:
+        if self._names is None:
+            self._names = registered_names()
+        return self._names
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath not in self.EXEMPT
+
+    def check(self, module: Module) -> list[Finding]:
+        # bare `environ`/`getenv` only count when actually imported from
+        # os — a WSGI handler's `environ` dict is not the process env
+        environ_names = {"os.environ"} | {
+            a for a in os_import_aliases(module.tree, "environ")
+        }
+        read_calls = {"os.getenv", "os.environ.get"} | {
+            f"{a}.get" for a in environ_names if a != "os.environ"
+        } | set(os_import_aliases(module.tree, "getenv"))
+        findings: list[Finding] = []
+        for node in ast.walk(module.tree):
+            name_node = None
+            if isinstance(node, ast.Call) and _dotted(node.func) in read_calls:
+                if node.args:
+                    name_node = node.args[0]
+            elif (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, ast.Load)
+                and _dotted(node.value) in environ_names
+            ):
+                name_node = node.slice
+            if name_node is None:
+                continue
+            if isinstance(name_node, ast.Constant) and isinstance(
+                name_node.value, str
+            ):
+                if name_node.value not in self.names:
+                    findings.append(
+                        module.finding(
+                            self.rule,
+                            node,
+                            f"env var {name_node.value!r} read here is not "
+                            "declared in config.ENV_KNOBS",
+                            hint="add an EnvKnob entry (name, default, "
+                            "kind, description) in foremast_tpu/config.py, "
+                            "then `make env-docs`",
+                        )
+                    )
+            else:
+                findings.append(
+                    module.finding(
+                        self.rule,
+                        node,
+                        "env read with a computed name defeats knob "
+                        "enumeration",
+                        hint="read literals (register each), or suppress a "
+                        "thin wrapper whose call sites pass literals with "
+                        "`# foremast: ignore[env-contract]`",
+                    )
+                )
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# generated operator docs
+# ---------------------------------------------------------------------------
+
+
+def render_env_table() -> str:
+    """The docs/operations.md configuration-reference block, rendered
+    from config.ENV_KNOBS. Deterministic: registry order within each
+    group, groups in fixed order."""
+    from foremast_tpu.config import ENV_KNOBS
+
+    groups = [
+        (
+            "engine",
+            "Engine (reference parity, `foremast-brain.yaml:21-81` + "
+            "`foremast-brain/README.md:20-38`):",
+        ),
+        ("framework", "Framework-specific:"),
+        ("deploy", "Deployment / platform integration:"),
+    ]
+    lines = [DOCS_BEGIN, ""]
+    for group, heading in groups:
+        knobs = [k for k in ENV_KNOBS if k.group == group]
+        if not knobs:
+            continue
+        lines.append(heading)
+        lines.append("")
+        lines.append("| Var | Default | Meaning |")
+        lines.append("|---|---|---|")
+        for k in knobs:
+            default = k.default if k.default not in (None, "") else "—"
+            desc = k.description.replace("|", "\\|")
+            lines.append(
+                f"| `{k.name}` | {default.replace('|', chr(92) + '|')} "
+                f"| {desc} |"
+            )
+        lines.append("")
+    lines.append(
+        "This table is generated from `foremast_tpu/config.py`'s "
+        "`ENV_KNOBS` registry — edit the registry, then run `make "
+        "env-docs`. `make check` fails when the two drift."
+    )
+    lines.append(DOCS_END)
+    return "\n".join(lines)
+
+
+def _split_docs(text: str) -> tuple[str, str, str] | None:
+    try:
+        head, rest = text.split(DOCS_BEGIN, 1)
+        _, tail = rest.split(DOCS_END, 1)
+    except ValueError:
+        return None
+    return head, text[len(head): len(text) - len(tail)], tail
+
+
+def check_env_docs(root: str) -> list[Finding]:
+    """Findings when the committed docs block is missing or stale."""
+    path = os.path.join(root, DOCS_RELPATH)
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    parts = _split_docs(text)
+    hint = "run `make env-docs` (or python -m foremast_tpu.analysis --update-env-docs)"
+    if parts is None:
+        return [
+            Finding(
+                rule="env-contract",
+                path=DOCS_RELPATH,
+                line=1,
+                message="ENV REGISTRY markers missing from operator docs",
+                hint=hint,
+            )
+        ]
+    if parts[1] != render_env_table():
+        return [
+            Finding(
+                rule="env-contract",
+                path=DOCS_RELPATH,
+                line=text[: text.index(DOCS_BEGIN)].count("\n") + 1,
+                message="generated env table is stale vs config.ENV_KNOBS",
+                hint=hint,
+            )
+        ]
+    return []
+
+
+def update_env_docs(root: str) -> bool:
+    """Rewrite the generated block in place; returns True if changed."""
+    path = os.path.join(root, DOCS_RELPATH)
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    parts = _split_docs(text)
+    if parts is None:
+        raise SystemExit(
+            f"{DOCS_RELPATH}: ENV REGISTRY markers not found; add\n"
+            f"{DOCS_BEGIN}\n{DOCS_END}\nwhere the table belongs"
+        )
+    head, old, tail = parts
+    new = render_env_table()
+    if old == new:
+        return False
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(head + new + tail)
+    return True

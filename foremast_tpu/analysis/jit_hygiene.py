@@ -1,0 +1,502 @@
+"""jit-hygiene: host syncs and retrace hazards inside jitted scoring code.
+
+The engine's throughput contract (CONTRIBUTING.md) is that everything
+under `jit` stays fixed-shape and device-resident: ragged data becomes
+masks, config becomes gathered operand vectors, branches become selects.
+The failure modes this checker encodes are the ones that silently tax
+the hot path instead of crashing:
+
+  * ``.item()`` / ``float()`` / ``bool()`` on a traced value — a
+    blocking device->host sync per call (or a ConcretizationTypeError at
+    trace time for the builtins);
+  * ``np.asarray``/``np.array`` of a traced value — host materialization
+    inside the program, which breaks tracing or forces a transfer;
+  * Python ``if``/``while`` on a traced value — either a trace-time
+    error or, where it happens to concretize, a retrace per distinct
+    value;
+  * unhashable defaults (list/dict/set) on ``static_argnames`` params —
+    every call site raises or, worse, retraces.
+
+Scope and precision: jit ROOTS are functions carrying a ``jax.jit``
+decorator (including ``partial(jax.jit, ...)``) or bound by a
+``name = jax.jit(fn)`` assignment; their traced set is parameters minus
+``static_argnames``/``static_argnums``. Tracedness then propagates
+INTERPROCEDURALLY through intra-module call sites (a helper's parameter
+is traced iff some jit-reachable caller passes it a traced expression)
+and INTRAPROCEDURALLY through local assignments (``t = x.astype(...)``
+taints ``t``), to a fixpoint. This is what lets the checker flag
+``float(level)`` in a shared helper while staying silent on
+``float(t_scale)`` where every caller passes a Python scalar.
+
+Idioms never flagged (static under tracing): ``x is None`` branching,
+``len(x)``, ``isinstance``, and ``.shape``/``.ndim``/``.dtype``/
+``.size`` access.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from foremast_tpu.analysis.core import Checker, Finding, Module
+
+_NP_NAMES = frozenset({"np", "numpy"})
+_NP_MATERIALIZERS = frozenset(
+    {"asarray", "array", "asanyarray", "ascontiguousarray"}
+)
+_SYNC_BUILTINS = frozenset({"float", "bool"})
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+_UNHASHABLE_DEFAULTS = (
+    ast.List,
+    ast.Dict,
+    ast.Set,
+    ast.ListComp,
+    ast.DictComp,
+    ast.SetComp,
+)
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """`a.b.c` -> "a.b.c" for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return _dotted(node) in ("jax.jit", "jit")
+
+
+def _const_str_seq(node: ast.AST, consts: dict[str, ast.AST]) -> list[str] | None:
+    """Resolve a tuple/list of string constants, following one level of
+    module-constant indirection (the `_STATIC = (...)` pattern)."""
+    if isinstance(node, ast.Name) and node.id in consts:
+        node = consts[node.id]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    return None
+
+
+def _const_int_seq(node: ast.AST, consts: dict[str, ast.AST]) -> list[int] | None:
+    if isinstance(node, ast.Name) and node.id in consts:
+        node = consts[node.id]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    return None
+
+
+def _jit_call_statics(
+    call: ast.Call, consts: dict[str, ast.AST]
+) -> tuple[list[str], list[int]] | None:
+    """(static_argnames, static_argnums) if `call` wraps jax.jit:
+    `jax.jit(...)` or `[functools.]partial(jax.jit, ...)`; else None."""
+    is_partial = _dotted(call.func) in ("partial", "functools.partial")
+    if is_partial:
+        if not (call.args and _is_jax_jit(call.args[0])):
+            return None
+    elif not _is_jax_jit(call.func):
+        return None
+    names: list[str] = []
+    nums: list[int] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names = _const_str_seq(kw.value, consts) or []
+        elif kw.arg == "static_argnums":
+            nums = _const_int_seq(kw.value, consts) or []
+    return names, nums
+
+
+def _param_names(fn: ast.FunctionDef) -> list[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+def _defaults_by_param(fn: ast.FunctionDef) -> dict[str, ast.AST]:
+    a = fn.args
+    positional = [*a.posonlyargs, *a.args]
+    out: dict[str, ast.AST] = {}
+    for param, default in zip(
+        positional[len(positional) - len(a.defaults):], a.defaults
+    ):
+        out[param.arg] = default
+    for param, default in zip(a.kwonlyargs, a.kw_defaults):
+        if default is not None:
+            out[param.arg] = default
+    return out
+
+
+class _FnInfo:
+    def __init__(self, node: ast.FunctionDef):
+        self.node = node
+        self.name = node.name
+        self.is_root = False
+        self.static: set[str] = set()
+        self.traced_params: set[str] = set()
+        self.call_sites: list[tuple[str, ast.Call]] = []  # (callee, node)
+
+
+class JitHygieneChecker(Checker):
+    rule = "jit-hygiene"
+    description = (
+        "host syncs, traced-value branching, and unhashable static args "
+        "inside jax.jit-reachable functions"
+    )
+
+    PATH_PREFIXES = (
+        "foremast_tpu/engine/",
+        "foremast_tpu/models/",
+        "foremast_tpu/ops/",
+    )
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(self.PATH_PREFIXES)
+
+    # -- module scan -----------------------------------------------------
+
+    def check(self, module: Module) -> list[Finding]:
+        consts = self._module_consts(module.tree)
+        fns = self._collect_functions(module.tree)
+        self._mark_roots(module.tree, fns, consts)
+        reachable = self._propagate_tracedness(fns)
+        findings: list[Finding] = []
+        for info in reachable:
+            findings.extend(self._check_function(module, info))
+        return findings
+
+    @staticmethod
+    def _module_consts(tree: ast.Module) -> dict[str, ast.AST]:
+        consts: dict[str, ast.AST] = {}
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                t = stmt.targets[0]
+                if isinstance(t, ast.Name):
+                    consts[t.id] = stmt.value
+        return consts
+
+    @staticmethod
+    def _collect_functions(tree: ast.Module) -> dict[str, _FnInfo]:
+        fns: dict[str, _FnInfo] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # first definition wins on name collisions (same-named
+                # methods across classes share an entry; over-connecting
+                # the call graph only widens coverage)
+                fns.setdefault(node.name, _FnInfo(node))
+        for info in fns.values():
+            for sub in ast.walk(info.node):
+                if isinstance(sub, ast.Call):
+                    callee = None
+                    if isinstance(sub.func, ast.Name):
+                        callee = sub.func.id
+                    elif isinstance(sub.func, ast.Attribute):
+                        callee = sub.func.attr
+                    if callee and callee in fns and callee != info.name:
+                        info.call_sites.append((callee, sub))
+        return fns
+
+    def _mark_roots(
+        self,
+        tree: ast.Module,
+        fns: dict[str, _FnInfo],
+        consts: dict[str, ast.AST],
+    ) -> None:
+        def apply_statics(info: _FnInfo, names: list[str], nums: list[int]):
+            params = _param_names(info.node)
+            info.is_root = True
+            info.static.update(names)
+            info.static.update(params[i] for i in nums if i < len(params))
+
+        for info in fns.values():
+            for deco in info.node.decorator_list:
+                if _is_jax_jit(deco):
+                    info.is_root = True
+                elif isinstance(deco, ast.Call):
+                    statics = _jit_call_statics(deco, consts)
+                    if statics is not None:
+                        apply_statics(info, *statics)
+        # assignment form: `scored = jax.jit(fn)` / `partial(jax.jit, ..)(fn)`
+        for stmt in ast.walk(tree):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            call = stmt.value
+            if not (isinstance(call, ast.Call) and call.args):
+                continue
+            target_fn = call.args[0]
+            if not (isinstance(target_fn, ast.Name) and target_fn.id in fns):
+                continue
+            statics = None
+            if _is_jax_jit(call.func):
+                names = []
+                nums = []
+                for kw in call.keywords:
+                    if kw.arg == "static_argnames":
+                        names = _const_str_seq(kw.value, consts) or []
+                    elif kw.arg == "static_argnums":
+                        nums = _const_int_seq(kw.value, consts) or []
+                statics = (names, nums)
+            elif isinstance(call.func, ast.Call):
+                statics = _jit_call_statics(call.func, consts)
+            if statics is not None:
+                apply_statics(fns[target_fn.id], *statics)
+
+    # -- tracedness ------------------------------------------------------
+
+    def _propagate_tracedness(self, fns: dict[str, _FnInfo]) -> list[_FnInfo]:
+        """Fixpoint: roots' traced params flow through call-site argument
+        positions into callee params. Returns the jit-reachable set."""
+        for info in fns.values():
+            if info.is_root:
+                info.traced_params = (
+                    set(_param_names(info.node)) - info.static - {"self", "cls"}
+                )
+        # reachability first (call graph is static)
+        frontier = [i for i in fns.values() if i.is_root]
+        reach = {i.name for i in frontier}
+        order = list(frontier)
+        while frontier:
+            info = frontier.pop()
+            for callee, _ in info.call_sites:
+                if callee not in reach:
+                    reach.add(callee)
+                    frontier.append(fns[callee])
+                    order.append(fns[callee])
+        changed = True
+        while changed:
+            changed = False
+            for info in order:
+                tainted = self._tainted_names(info)
+                for callee, call in info.call_sites:
+                    target = fns[callee]
+                    params = [
+                        p
+                        for p in _param_names(target.node)
+                        if p not in ("self", "cls")
+                    ]
+                    mapped: list[tuple[str, ast.AST]] = []
+                    for i, arg in enumerate(call.args):
+                        if i < len(params):
+                            mapped.append((params[i], arg))
+                    for kw in call.keywords:
+                        if kw.arg in params:
+                            mapped.append((kw.arg, kw.value))
+                    for pname, arg in mapped:
+                        if pname not in target.traced_params and self._references(
+                            arg, tainted
+                        ):
+                            target.traced_params.add(pname)
+                            changed = True
+        return order
+
+    def _tainted_names(self, info: _FnInfo) -> set[str]:
+        """Traced params plus locals assigned from traced expressions
+        (its own fixpoint — assignment order in source need not match
+        dataflow order)."""
+        tainted = set(info.traced_params)
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(info.node):
+                value = None
+                targets: list[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    value, targets = node.value, node.targets
+                elif isinstance(node, ast.AugAssign):
+                    value, targets = node.value, [node.target]
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    value, targets = node.value, [node.target]
+                elif isinstance(node, ast.For):
+                    value, targets = node.iter, [node.target]
+                if value is None or not self._references(value, tainted):
+                    continue
+                for target in targets:
+                    for sub in ast.walk(target):
+                        if (
+                            isinstance(sub, ast.Name)
+                            and sub.id not in tainted
+                        ):
+                            tainted.add(sub.id)
+                            changed = True
+        return tainted
+
+    @staticmethod
+    def _static_exempt_ids(node: ast.AST) -> set[int]:
+        """AST node ids inside `node` that only touch STATIC facts about
+        traced values — `x.shape/ndim/dtype/size`, `len(x)`,
+        `isinstance(...)`, `x is (not) None` — and therefore must not
+        propagate or trigger taint (`b, t = values.shape` is a Python
+        int under tracing, not a traced scalar)."""
+        exempt: set[int] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops
+            ):
+                for sub in ast.walk(n):
+                    exempt.add(id(sub))
+            elif (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Name)
+                and n.func.id in ("len", "isinstance")
+            ):
+                for sub in ast.walk(n):
+                    exempt.add(id(sub))
+            elif isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+                for sub in ast.walk(n):
+                    exempt.add(id(sub))
+        return exempt
+
+    @classmethod
+    def _references(cls, node: ast.AST, names: set[str]) -> bool:
+        """True when `node` references a tainted name OUTSIDE the static
+        idioms (shape/len/is-None/...)."""
+        exempt = cls._static_exempt_ids(node)
+        return any(
+            isinstance(n, ast.Name) and n.id in names and id(n) not in exempt
+            for n in ast.walk(node)
+        )
+
+    # -- per-function checks ---------------------------------------------
+
+    def _check_function(self, module: Module, info: _FnInfo) -> Iterable[Finding]:
+        fn = info.node
+        tainted = self._tainted_names(info)
+        findings: list[Finding] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                findings.extend(self._check_call(module, fn, node, tainted))
+            elif isinstance(node, (ast.If, ast.While)):
+                name = self._traced_branch_name(node.test, tainted)
+                if name is not None:
+                    findings.append(
+                        module.finding(
+                            self.rule,
+                            node,
+                            f"jit-reachable `{fn.name}` branches in Python "
+                            f"on traced value `{name}`",
+                            hint=(
+                                "use jnp.where/lax.cond, or declare the "
+                                "argument in static_argnames if it is "
+                                "genuinely compile-time"
+                            ),
+                        )
+                    )
+        if info.is_root:
+            findings.extend(self._check_static_defaults(module, fn, info))
+        return findings
+
+    def _check_call(
+        self,
+        module: Module,
+        fn: ast.FunctionDef,
+        node: ast.Call,
+        tainted: set[str],
+    ) -> Iterable[Finding]:
+        out: list[Finding] = []
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "item"
+            and not node.args
+            and self._references(func.value, tainted)
+        ):
+            out.append(
+                module.finding(
+                    self.rule,
+                    node,
+                    f"`.item()` on traced value inside jit-reachable "
+                    f"`{fn.name}` forces a blocking device->host sync",
+                    hint="keep the value on device; fetch once per batch "
+                    "with jax.device_get after the program returns",
+                )
+            )
+            return out
+        dotted = _dotted(func)
+        if (
+            dotted
+            and "." in dotted
+            and dotted.split(".", 1)[0] in _NP_NAMES
+            and dotted.rsplit(".", 1)[1] in _NP_MATERIALIZERS
+            and node.args
+            and self._references(node.args[0], tainted)
+        ):
+            out.append(
+                module.finding(
+                    self.rule,
+                    node,
+                    f"`{dotted}` materializes traced value on host inside "
+                    f"jit-reachable `{fn.name}`",
+                    hint="use jnp.* inside jitted code; np.* belongs on the "
+                    "host side of the batch boundary",
+                )
+            )
+        elif (
+            isinstance(func, ast.Name)
+            and func.id in _SYNC_BUILTINS
+            and node.args
+            and self._references(node.args[0], tainted)
+        ):
+            out.append(
+                module.finding(
+                    self.rule,
+                    node,
+                    f"`{func.id}()` on traced value inside jit-reachable "
+                    f"`{fn.name}` concretizes (sync or trace error)",
+                    hint="keep it a jax scalar, or hoist the conversion to "
+                    "the caller outside jit",
+                )
+            )
+        return out
+
+    def _traced_branch_name(
+        self, test: ast.AST, tainted: set[str]
+    ) -> str | None:
+        """First traced name the branch condition concretizes, or None.
+
+        Exempt idioms (static under tracing): `x is (not) None`,
+        `len(x)`, `isinstance(...)`, and `x.shape/ndim/dtype/size` —
+        these shape program structure, not runtime values."""
+        exempt = self._static_exempt_ids(test)
+        for n in ast.walk(test):
+            if (
+                isinstance(n, ast.Name)
+                and n.id in tainted
+                and id(n) not in exempt
+            ):
+                return n.id
+        return None
+
+    def _check_static_defaults(
+        self, module: Module, fn: ast.FunctionDef, info: _FnInfo
+    ) -> Iterable[Finding]:
+        defaults = _defaults_by_param(fn)
+        for name in sorted(info.static):
+            default = defaults.get(name)
+            if default is not None and isinstance(default, _UNHASHABLE_DEFAULTS):
+                yield module.finding(
+                    self.rule,
+                    default,
+                    f"static arg `{name}` of jitted `{fn.name}` defaults to "
+                    "an unhashable value",
+                    hint="static args key the compile cache; use a tuple / "
+                    "frozenset / hashable sentinel",
+                )

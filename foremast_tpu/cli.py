@@ -481,7 +481,9 @@ def _env_int(name: str, default: int) -> int:
     """Env-var int with a warning (not a crash) on malformed values —
     build_parser runs for EVERY subcommand, so a bad env var must not
     break unrelated commands with a raw traceback."""
-    raw = os.environ.get(name)
+    # thin wrapper: every call site passes a literal, registered knob
+    # (FOREMAST_CLAIM_LIMIT), so the dynamic read here stays enumerable
+    raw = os.environ.get(name)  # foremast: ignore[env-contract]
     if not raw:
         return default
     try:

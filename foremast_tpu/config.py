@@ -246,3 +246,338 @@ class BrainConfig:
             es_endpoint=get("ES_ENDPOINT", "http://localhost:9200"),
             trace_dir=e.get("FOREMAST_TRACE_DIR") or None,
         )
+
+
+# ---------------------------------------------------------------------------
+# The env-var registry: the ENTIRE configuration surface, enumerable.
+# ---------------------------------------------------------------------------
+#
+# Every environment variable any foremast_tpu module reads must be
+# declared here — the env-contract checker (foremast_tpu/analysis/)
+# fails the build on undeclared reads, and the operator docs table in
+# docs/operations.md is GENERATED from this registry (`make env-docs`).
+# That keeps three things from drifting: the code's actual env surface,
+# the docs, and what /debug/state can enumerate (`env_overrides()`).
+#
+# `name` may be an indexed pattern (`metric_type{i}`) for the
+# reference's per-metric-type override family — those are only ever
+# read through config.from_env, so the checker never needs to match
+# them literally.
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvKnob:
+    """One declared environment variable: the unit of config surface."""
+
+    name: str
+    default: str | None
+    kind: str  # "str" | "int" | "float" | "bool" | "path" | "indexed"
+    description: str
+    group: str = "framework"  # "engine" | "framework" | "deploy"
+
+
+ENV_KNOBS: tuple[EnvKnob, ...] = (
+    # -- engine (reference parity: foremast-brain.yaml:21-81 + README:20-38)
+    EnvKnob(
+        "ES_ENDPOINT",
+        "http://localhost:9200",
+        "str",
+        "Elasticsearch job store, engine spelling (in-memory if unset "
+        "and no `ELASTIC_URL`)",
+        "engine",
+    ),
+    EnvKnob(
+        "ML_ALGORITHM",
+        "moving_average_all",
+        "str",
+        "also: moving_average, ewma, double_exponential_smoothing, "
+        "holt_winters, phase_means (pooled per-phase means — the "
+        "daily-season workhorse), seasonal, prophet, `auto_univariate` "
+        "(per-series structure screen over {mean, HW or phase_means by "
+        "season length, Fourier seasonal} — recommended for unknown "
+        "metric mixes), `auto`, `bivariate_normal`, `lstm_autoencoder` "
+        "(hybrid: AE + seasonal-residual Gaussian)",
+        "engine",
+    ),
+    EnvKnob(
+        "ML_THRESHOLD",
+        "2.0",
+        "float",
+        "global sigma multiplier (reference alias: `threshold`)",
+        "engine",
+    ),
+    EnvKnob(
+        "threshold",
+        "2.0",
+        "float",
+        "reference spelling of `ML_THRESHOLD`",
+        "engine",
+    ),
+    EnvKnob(
+        "ML_BOUND",
+        "1",
+        "int",
+        "1=upper, 2=lower, 3=both (reference alias: `bound`)",
+        "engine",
+    ),
+    EnvKnob("bound", "1", "int", "reference spelling of `ML_BOUND`", "engine"),
+    EnvKnob("min_lower_bound", "0", "float", "lower-bound floor", "engine"),
+    EnvKnob(
+        "metric_type_threshold_count",
+        "0",
+        "int",
+        "row count of the per-metric-type override table",
+        "engine",
+    ),
+    EnvKnob(
+        "metric_type{i}",
+        None,
+        "indexed",
+        "with `threshold{i}`/`bound{i}`/`min_lower_bound{i}`: "
+        "per-metric-type override rows (deployed defaults: error5xx 2/1, "
+        "error4xx 3/1, latency 10/3, cpu 5/1, memory 5/1)",
+        "engine",
+    ),
+    EnvKnob(
+        "ML_PAIRWISE_ALGORITHM",
+        "ALL",
+        "str",
+        "ALL | ANY | MANN_WHITE | WILCOXON | KRUSKAL | FRIEDMAN (the "
+        "reference design doc's fourth algorithm, two-group special case)",
+        "engine",
+    ),
+    EnvKnob(
+        "ML_PAIRWISE_THRESHOLD", "0.05", "float", "pairwise p threshold", "engine"
+    ),
+    EnvKnob(
+        "MIN_MANN_WHITE_DATA_POINTS",
+        "20",
+        "int",
+        "Mann-Whitney min-points gate",
+        "engine",
+    ),
+    EnvKnob(
+        "MIN_WILCOXON_DATA_POINTS",
+        "20",
+        "int",
+        "Wilcoxon min-points gate",
+        "engine",
+    ),
+    EnvKnob(
+        "MIN_KRUSKAL_DATA_POINTS",
+        "5",
+        "int",
+        "Kruskal-Wallis min-points gate",
+        "engine",
+    ),
+    EnvKnob(
+        "MIN_FRIEDMAN_DATA_POINTS",
+        "20",
+        "int",
+        "Friedman min-points gate",
+        "engine",
+    ),
+    EnvKnob(
+        "ML_SEASON_STEPS",
+        "1440",
+        "int",
+        "season length in steps for every seasonal model (HW, Fourier "
+        "seasonal, residual-MVN, the auto screen); 1440 = daily at the "
+        "60 s PromQL step. Histories under 2 cycles keep the mean model "
+        "(identifiability guard). Routing note (deliberate): an EXPLICIT "
+        "`ML_ALGORITHM=holt_winters` is honored as configured even at "
+        "m=1440, where its rolled scan makes cold fits several times "
+        "slower than `phase_means` — silently rewriting an operator's "
+        "explicit algorithm choice would make config behavior "
+        "unpredictable. For daily seasons prefer `auto_univariate` "
+        "(which routes long seasons to the pooled phase-means fit "
+        "itself) or set `phase_means` directly",
+        "engine",
+    ),
+    EnvKnob(
+        "MIN_HISTORICAL_DATA_POINT_TO_MEASURE",
+        "10",
+        "int",
+        "measurability gate",
+        "engine",
+    ),
+    EnvKnob(
+        "MAX_STUCK_IN_SECONDS", "90", "float", "work-stealing takeover", "engine"
+    ),
+    EnvKnob("MAX_CACHE_SIZE", "1000", "int", "fitted-model LRU size", "engine"),
+    # -- framework-specific
+    EnvKnob(
+        "ELASTIC_URL",
+        None,
+        "str",
+        "Elasticsearch job store, service spelling (falls back to "
+        "`ES_ENDPOINT`; in-memory when both unset)",
+    ),
+    EnvKnob(
+        "FOREMAST_PALLAS",
+        "0",
+        "bool",
+        "`1` opts into the fused Pallas judgment kernel",
+    ),
+    EnvKnob(
+        "FOREMAST_NATIVE",
+        "1",
+        "bool",
+        "`0` disables the C++ data loader (pure Python)",
+    ),
+    EnvKnob(
+        "FOREMAST_LSTM_STEPS",
+        "60",
+        "int",
+        "LSTM-AE train steps per new model",
+    ),
+    EnvKnob(
+        "FOREMAST_CLAIM_LIMIT",
+        "256",
+        "int",
+        "jobs claimed per tick (`worker --claim-limit`); the whole claim "
+        "scores as one batched program",
+    ),
+    EnvKnob(
+        "FOREMAST_COLD_CHUNK_DOCS",
+        "1024",
+        "int",
+        "slow-path doc-chunk size: cold claim sets run "
+        "fetch→fit→judge→write per chunk, bounding time-to-first-verdict "
+        "by one chunk's work (~20 s at fleet scale)",
+    ),
+    EnvKnob(
+        "FOREMAST_ARENA_BYTES",
+        "268435456",
+        "int",
+        "soft HBM budget for the device state arena (default 256 MB; `0` "
+        "disables the arena). The arena AUTO-GROWS past this when the "
+        "fleet's working set needs more rows — one warning log per "
+        "growth — because an LRU arena smaller than the working set "
+        "would re-upload the whole fleet's state every tick. Sizing "
+        "rule: rows = services × metrics-per-job; bytes/row = 20 + 4 × "
+        "`ML_SEASON_STEPS` (daily m=1440 ⇒ ~5.8 KB/row, so a "
+        "16k-service × 4-metric daily fleet needs ~378 MB). Pod mode "
+        "broadcasts the leader's value (engine.arena.set_arena_budget)",
+    ),
+    EnvKnob(
+        "FOREMAST_ARENA_MAX_BYTES",
+        "2147483648",
+        "int",
+        "hard arena ceiling (default 2 GB ≈ 12% of a v5e chip's HBM). "
+        "Batches that cannot fit even here fall back to a per-tick full "
+        "state restack — counted in "
+        "`foremast_worker_arena_events_total{event=\"fallbacks\"}` and "
+        "logged, never silent",
+    ),
+    EnvKnob(
+        "FOREMAST_BF16_DELTA",
+        "1",
+        "bool",
+        "default `1`: histories travel/reside as f32 anchor + bf16 "
+        "deltas (2 B/point) — 1.95x on the steady-state headline, 2-4x "
+        "on cold-tick/churn H2D (moments shortcut for the deployed "
+        "default, in-program reconstruction for seasonal fits); verdict "
+        "parity, low-CV band geometry, and m=1440 seasonal fidelity are "
+        "test-pinned. `0` restores full-f32 handling. Pod mode "
+        "broadcasts the leader's value (engine.scoring.set_bf16_delta)",
+    ),
+    EnvKnob(
+        "FOREMAST_MAX_GAUGE_FAMILIES",
+        "512",
+        "int",
+        "gauge-family cap: past it, publishes for NEW metric names are "
+        "dropped (counted on "
+        "`foremastbrain_gauge_families_dropped_total`, warned once)",
+    ),
+    EnvKnob(
+        "FOREMAST_TRACE_DIR",
+        None,
+        "path",
+        "directory for Perfetto-loadable span ring-buffer dumps; unset "
+        "disables the buffer (stage histograms stay on)",
+    ),
+    EnvKnob(
+        "FOREMAST_PROFILE",
+        None,
+        "path",
+        "dump jax.profiler traces around scoring",
+    ),
+    EnvKnob(
+        "FOREMAST_SERVICE_ENDPOINT",
+        "http://localhost:8099",
+        "str",
+        "browser-reachable job-gateway URL for the UI",
+    ),
+    EnvKnob(
+        "QUERY_SERVICE_ENDPOINT",
+        None,
+        "str",
+        "Prometheus base for the service's query proxy",
+    ),
+    EnvKnob(
+        "FOREMAST_UI_NAMESPACE",
+        "foremast-examples",
+        "str",
+        "dashboard's charted namespace label",
+    ),
+    EnvKnob("FOREMAST_UI_APP", "demo", "str", "dashboard's charted app label"),
+    # -- deployment / platform integration
+    EnvKnob(
+        "NAMESPACE",
+        "default",
+        "str",
+        "fallback gauge namespace label; the watch plane's own namespace "
+        "(downward-API parity)",
+        "deploy",
+    ),
+    EnvKnob(
+        "JAX_COORDINATOR_ADDRESS",
+        None,
+        "str",
+        "multi-host init (pod mode), with `JAX_NUM_PROCESSES` / "
+        "`JAX_PROCESS_ID`",
+        "deploy",
+    ),
+    EnvKnob("JAX_NUM_PROCESSES", None, "int", "multi-host init", "deploy"),
+    EnvKnob("JAX_PROCESS_ID", None, "int", "multi-host init", "deploy"),
+    EnvKnob(
+        "KUBERNETES_SERVICE_HOST",
+        "kubernetes.default.svc",
+        "str",
+        "in-cluster API server (injected by the kubelet)",
+        "deploy",
+    ),
+    EnvKnob(
+        "KUBERNETES_SERVICE_PORT",
+        "443",
+        "str",
+        "in-cluster API server port",
+        "deploy",
+    ),
+    EnvKnob(
+        "K8S_METRICS_COMMON_TAGS",
+        None,
+        "str",
+        "instrument starter: comma-separated `key:value` tags stamped on "
+        "every emitted metric",
+        "deploy",
+    ),
+    EnvKnob(
+        "APP_NAME",
+        None,
+        "str",
+        "instrument starter: fallback `app` tag",
+        "deploy",
+    ),
+)
+
+ENV_KNOB_NAMES = frozenset(k.name for k in ENV_KNOBS)
+
+
+def env_overrides(env: Mapping[str, str] | None = None) -> dict[str, str]:
+    """Registered knobs explicitly set in the process env — the varz
+    plane's enumerable answer to "how is this worker configured beyond
+    defaults" (non-indexed knobs only; values are raw strings)."""
+    e = os.environ if env is None else env
+    return {k.name: e[k.name] for k in ENV_KNOBS if k.name in e}

@@ -56,11 +56,34 @@ _MAX_ROWS = 262_144
 _MIN_ROWS = 8_192
 
 
+# Explicit overrides beat the env: pod-mode followers adopt the
+# leader's broadcast budgets via set_arena_budget() — mutating
+# os.environ after worker threads exist is a cross-thread race, and the
+# write would only reach code that happens to re-read the env.
+_BYTES_OVERRIDE: int | None = None
+_MAX_BYTES_OVERRIDE: int | None = None
+
+
+def set_arena_budget(
+    soft_bytes: int | None, max_bytes: int | None
+) -> None:
+    """Pin the arena byte budgets for this process (None clears an
+    override back to env/default). Call BEFORE the first tick: existing
+    arenas keep the capacity they were built with."""
+    global _BYTES_OVERRIDE, _MAX_BYTES_OVERRIDE
+    _BYTES_OVERRIDE = None if soft_bytes is None else int(soft_bytes)
+    _MAX_BYTES_OVERRIDE = None if max_bytes is None else int(max_bytes)
+
+
 def _arena_bytes() -> int:
+    if _BYTES_OVERRIDE is not None:
+        return _BYTES_OVERRIDE
     return int(os.environ.get("FOREMAST_ARENA_BYTES", _DEFAULT_BYTES))
 
 
 def _arena_max_bytes() -> int:
+    if _MAX_BYTES_OVERRIDE is not None:
+        return _MAX_BYTES_OVERRIDE
     return int(
         os.environ.get("FOREMAST_ARENA_MAX_BYTES", _DEFAULT_MAX_BYTES)
     )

@@ -629,6 +629,20 @@ def score_from_arena(
 # path reads no history at all.
 
 
+# Explicit override beats the env: pod-mode followers adopt the
+# leader's broadcast value via set_bf16_delta() — a per-host skew here
+# dispatches differently-shaped SPMD programs, and mutating os.environ
+# after threads start is a cross-thread race.
+_BF16_DELTA_OVERRIDE: bool | None = None
+
+
+def set_bf16_delta(enabled: bool | None) -> None:
+    """Pin the bf16-delta gate for this process (None clears the
+    override back to the env default)."""
+    global _BF16_DELTA_OVERRIDE
+    _BF16_DELTA_OVERRIDE = enabled if enabled is None else bool(enabled)
+
+
 def bf16_delta_enabled() -> bool:
     """FOREMAST_BF16_DELTA gate (default ON): anchor-shifted bf16-delta
     history handling for the moving-average family — the steady-state
@@ -637,6 +651,8 @@ def bf16_delta_enabled() -> bool:
     Set FOREMAST_BF16_DELTA=0 for full-f32 behavior."""
     import os
 
+    if _BF16_DELTA_OVERRIDE is not None:
+        return _BF16_DELTA_OVERRIDE
     return os.environ.get("FOREMAST_BF16_DELTA", "1") == "1"
 
 

@@ -1031,6 +1031,13 @@ class BrainWorker:
             "arena": arena,
             "last_tick": dict(self._last_tick),
         }
+        # registered knobs explicitly set in this process's env — with
+        # the config fingerprint, the enumerable answer to "why do two
+        # workers behave differently" (config.ENV_KNOBS is the registry
+        # the env-contract checker enforces)
+        from foremast_tpu.config import env_overrides
+
+        state["env_overrides"] = env_overrides()
         if self.tracer is not None:
             state["trace"] = self.tracer.debug_state()
         return state

@@ -44,7 +44,9 @@ class ModelCache:
         staying resident must pair peeks with a periodic batched
         get_many to keep the LRU honest, or size the cache for the
         working set."""
-        return self._d.get(key)
+        # deliberate lock-free fast path (per-tick hot lookup); the GIL
+        # makes the single dict read atomic
+        return self._d.get(key)  # foremast: ignore[lock-discipline]
 
     def put(self, key: Hashable, value) -> None:
         with self._lock:

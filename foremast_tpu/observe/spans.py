@@ -308,17 +308,23 @@ class Tracer:
         if self.ring is not None:
             self.ring.add(s.to_event())
             if root:
-                now = time.monotonic()
-                if now - self._last_flush >= self.AUTOFLUSH_SECONDS:
-                    self._autoflush()
+                self._autoflush()
 
     def _autoflush(self) -> None:
         """Flush on a daemon thread: root-span exit runs on whatever
         thread (or event loop) closed the span, and serializing the
         whole ring there would stall it. At most one background flush
-        at a time; a flush in flight just defers to the next root."""
+        at a time; a flush in flight just defers to the next root.
+        The elapsed-time check sits under the lock too — an unlocked
+        read of `_last_flush` raced concurrent root exits into
+        duplicate flush threads (lock-discipline finding)."""
         with self._flush_lock:
             if self._flush_active:
+                return
+            if (
+                time.monotonic() - self._last_flush
+                < self.AUTOFLUSH_SECONDS
+            ):
                 return
             self._flush_active = True
             # stamp inside the lock so concurrent root exits don't pile
@@ -340,7 +346,8 @@ class Tracer:
                         e,
                     )
             finally:
-                self._flush_active = False
+                with self._flush_lock:
+                    self._flush_active = False
 
         threading.Thread(
             target=_run, name="foremast-trace-flush", daemon=True

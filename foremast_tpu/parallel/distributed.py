@@ -182,10 +182,15 @@ class PodWorker(BrainWorker):
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        import os
-
-        from foremast_tpu.engine.arena import _arena_bytes, _arena_max_bytes
-        from foremast_tpu.engine.scoring import bf16_delta_enabled
+        from foremast_tpu.engine.arena import (
+            _arena_bytes,
+            _arena_max_bytes,
+            set_arena_budget,
+        )
+        from foremast_tpu.engine.scoring import (
+            bf16_delta_enabled,
+            set_bf16_delta,
+        )
 
         knobs = broadcast_obj(
             (
@@ -199,12 +204,13 @@ class PodWorker(BrainWorker):
         )
         if knobs is not None and not is_leader():
             self.cold_chunk_docs = knobs[0]
-            os.environ["FOREMAST_ARENA_BYTES"] = str(knobs[1])
-            os.environ["FOREMAST_ARENA_MAX_BYTES"] = str(knobs[2])
-            # per-host skew here would dispatch f32 fits on one process
-            # and bf16-delta fits on its peers — differently-shaped SPMD
-            # programs over the shared mesh
-            os.environ["FOREMAST_BF16_DELTA"] = "1" if knobs[3] else "0"
+            # explicit process-local overrides, NOT os.environ writes:
+            # mutating the env after threads exist is a cross-thread
+            # race, and a per-host skew in either knob would dispatch
+            # f32 fits on one process and bf16-delta fits on its peers —
+            # differently-shaped SPMD programs over the shared mesh
+            set_arena_budget(knobs[1], knobs[2])
+            set_bf16_delta(knobs[3])
 
     def tick(self, now: float | None = None) -> int:
         if now is None:

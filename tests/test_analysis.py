@@ -1,0 +1,588 @@
+"""foremast-check (foremast_tpu/analysis): fixtures per checker, the
+suppression and baseline machinery, the env registry/docs contract, and
+the tier-1 gate asserting the tree itself is clean.
+
+Fixture snippets are analyzed as source strings through the same
+`analyze_source` path the runner uses, so a checker regression that
+stops catching its violation class fails here before it silently
+green-lights the tree.
+"""
+
+from __future__ import annotations
+
+import os
+import textwrap
+
+import pytest
+
+from foremast_tpu.analysis import all_checkers, analyze_source, repo_root
+from foremast_tpu.analysis.async_blocking import AsyncBlockingChecker
+from foremast_tpu.analysis.core import (
+    Baseline,
+    Finding,
+    analyze_modules,
+    collect_modules,
+)
+from foremast_tpu.analysis.env_contract import (
+    EnvContractChecker,
+    check_env_docs,
+    render_env_table,
+)
+from foremast_tpu.analysis.jit_hygiene import JitHygieneChecker
+from foremast_tpu.analysis.lock_discipline import LockDisciplineChecker
+
+
+def src(text: str) -> str:
+    return textwrap.dedent(text)
+
+
+# ---------------------------------------------------------------------------
+# jit-hygiene
+# ---------------------------------------------------------------------------
+
+JIT_PATH = "foremast_tpu/engine/fixture.py"
+
+JIT_BAD = src(
+    '''
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("mode",))
+    def score(values, threshold, mode=[]):
+        if threshold > 1.0:
+            values = values * 2
+        return _peak(values)
+
+    def _peak(values):
+        top = values.max()
+        return float(top) + np.asarray(values).sum() + top.item()
+    '''
+)
+
+JIT_CLEAN = src(
+    '''
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("algorithm",))
+    def score(values, mask, algorithm="ma"):
+        b, t_len = values.shape
+        if algorithm == "ma" or t_len < 2:
+            return jnp.mean(values)
+        if mask is None:
+            return jnp.mean(values)
+        return _helper(values, float(t_len))
+
+    def _helper(values, scale):
+        return values * scale + float(scale)
+    '''
+)
+
+
+def test_jit_hygiene_catches_each_violation_class():
+    findings = analyze_source(JIT_BAD, JIT_PATH, [JitHygieneChecker()])
+    messages = "\n".join(f.message for f in findings)
+    assert "branches in Python on traced value `threshold`" in messages
+    assert "`float()` on traced value" in messages
+    assert "`np.asarray` materializes traced value" in messages
+    assert "`.item()` on traced value" in messages
+    assert "static arg `mode`" in messages and "unhashable" in messages
+    assert all(f.rule == "jit-hygiene" for f in findings)
+    assert len(findings) == 5
+
+
+def test_jit_hygiene_taint_is_interprocedural_not_blanket():
+    """`_helper` is only flagged because its caller passes traced data;
+    the same helper fed static scalars stays clean (the `_design`
+    false-positive class)."""
+    findings = analyze_source(JIT_CLEAN, JIT_PATH, [JitHygieneChecker()])
+    assert findings == []
+
+
+def test_jit_hygiene_scope_is_engine_models_ops():
+    checker = JitHygieneChecker()
+    assert checker.applies_to("foremast_tpu/engine/scoring.py")
+    assert checker.applies_to("foremast_tpu/models/seasonal.py")
+    assert checker.applies_to("foremast_tpu/ops/forecasters.py")
+    assert not checker.applies_to("foremast_tpu/service/app.py")
+    # host-side code may branch on numpy values freely
+    assert analyze_source(JIT_BAD, "foremast_tpu/jobs/fixture.py", [JitHygieneChecker()]) == []
+
+
+def test_jit_hygiene_shape_branching_is_static():
+    source = src(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fit(values, mask):
+            b, t_len = values.shape
+            if t_len == 0:
+                return jnp.zeros((b,))
+            if len(values) > 4 and values.ndim == 2:
+                return jnp.mean(values)
+            return jnp.sum(values)
+        """
+    )
+    assert analyze_source(source, JIT_PATH, [JitHygieneChecker()]) == []
+
+
+def test_jit_hygiene_assignment_form_roots():
+    source = src(
+        """
+        import jax
+        from functools import partial
+
+        def _decide(x, algorithm):
+            if algorithm == "any":
+                return x.sum()
+            return x.item()
+
+        decide = partial(jax.jit, static_argnames=("algorithm",))(_decide)
+        """
+    )
+    findings = analyze_source(source, JIT_PATH, [JitHygieneChecker()])
+    assert len(findings) == 1
+    assert "`.item()`" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# async-blocking
+# ---------------------------------------------------------------------------
+
+ASYNC_PATH = "foremast_tpu/service/fixture.py"
+
+ASYNC_BAD = src(
+    """
+    import time
+    import requests
+
+    async def handler(request, store):
+        time.sleep(1)
+        requests.get("http://upstream")
+        store.update(request)
+        return open("/etc/hostname").read()
+    """
+)
+
+ASYNC_CLEAN = src(
+    """
+    import asyncio
+    import time
+
+    async def handler(request, store):
+        await asyncio.sleep(1)
+        doc = await asyncio.to_thread(store.get, "id")
+
+        def executor_target():
+            time.sleep(1)
+
+        return doc
+    """
+)
+
+
+def test_async_blocking_catches_each_violation_class():
+    findings = analyze_source(ASYNC_BAD, ASYNC_PATH, [AsyncBlockingChecker()])
+    messages = "\n".join(f.message for f in findings)
+    assert "`time.sleep(...)`" in messages
+    assert "`requests.get(...)`" in messages
+    assert "`store.update(...)`" in messages
+    assert "`open()`" in messages
+    assert len(findings) == 4
+
+
+def test_async_blocking_permits_to_thread_and_nested_sync_defs():
+    assert analyze_source(ASYNC_CLEAN, ASYNC_PATH, [AsyncBlockingChecker()]) == []
+
+
+def test_async_blocking_ignores_sync_functions():
+    source = src(
+        """
+        import time
+
+        def poll_loop():
+            time.sleep(5)
+        """
+    )
+    assert analyze_source(source, ASYNC_PATH, [AsyncBlockingChecker()]) == []
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+LOCK_PATH = "foremast_tpu/jobs/fixture.py"
+
+LOCK_BAD = src(
+    """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+            self.count = 0
+
+        def put(self, key, value):
+            with self._lock:
+                self._items[key] = value
+                self.count += 1
+
+        def racy_get(self, key):
+            return self._items.get(key)
+
+        def racy_reset(self):
+            self.count = 0
+    """
+)
+
+LOCK_CLEAN = src(
+    """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+            self.limit = 8  # read-only config: never guarded
+
+        def put(self, key, value):
+            with self._lock:
+                if len(self._items) < self.limit:
+                    self._items[key] = value
+
+        def get(self, key):
+            with self._lock:
+                return self._items.get(key)
+
+        def describe(self):
+            return f"box(limit={self.limit})"
+    """
+)
+
+
+def test_lock_discipline_flags_unlocked_access():
+    findings = analyze_source(LOCK_BAD, LOCK_PATH, [LockDisciplineChecker()])
+    messages = "\n".join(f.message for f in findings)
+    assert "unlocked read of `self._items` in `Box.racy_get`" in messages
+    assert "unlocked write to `self.count` in `Box.racy_reset`" in messages
+    assert len(findings) == 2
+
+
+def test_lock_discipline_clean_class_and_readonly_config():
+    assert analyze_source(LOCK_CLEAN, LOCK_PATH, [LockDisciplineChecker()]) == []
+
+
+def test_lock_discipline_module_level_globals():
+    source = src(
+        """
+        import threading
+
+        _lock = threading.Lock()
+        _cache = None
+
+        def load():
+            global _cache
+            with _lock:
+                if _cache is None:
+                    _cache = object()
+                return _cache
+
+        def racy_invalidate():
+            global _cache
+            _cache = None
+        """
+    )
+    findings = analyze_source(source, LOCK_PATH, [LockDisciplineChecker()])
+    assert len(findings) == 1
+    assert "module global `_cache` in `racy_invalidate`" in findings[0].message
+
+
+def test_lock_discipline_nested_def_does_not_inherit_lock():
+    source = src(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._flag = False
+
+            def arm(self):
+                with self._lock:
+                    self._flag = True
+
+                    def later():
+                        self._flag = False
+
+                    return later
+        """
+    )
+    findings = analyze_source(source, LOCK_PATH, [LockDisciplineChecker()])
+    assert len(findings) == 1
+    assert "unlocked write to `self._flag`" in findings[0].message
+
+
+def test_lock_discipline_flags_runtime_env_writes():
+    source = src(
+        """
+        import os
+
+        def adopt(knobs):
+            os.environ["FOREMAST_ARENA_BYTES"] = str(knobs[0])
+        """
+    )
+    findings = analyze_source(source, LOCK_PATH, [LockDisciplineChecker()])
+    assert len(findings) == 1
+    assert "mutates process env at runtime" in findings[0].message
+
+
+def test_lock_discipline_wsgi_environ_dict_is_not_process_env():
+    source = src(
+        """
+        def app(environ, start_response):
+            environ["HTTP_X"] = "1"
+            return environ.get("PATH_INFO", "/")
+        """
+    )
+    assert analyze_source(source, LOCK_PATH, [LockDisciplineChecker()]) == []
+
+
+# ---------------------------------------------------------------------------
+# env-contract
+# ---------------------------------------------------------------------------
+
+ENV_PATH = "foremast_tpu/engine/fixture_env.py"
+
+
+def env_checker() -> EnvContractChecker:
+    return EnvContractChecker(names=frozenset({"GOOD_KNOB"}))
+
+
+def test_env_contract_flags_unregistered_and_dynamic_reads():
+    source = src(
+        """
+        import os
+
+        def configure(name):
+            a = os.environ.get("GOOD_KNOB")
+            b = os.environ.get("BAD_KNOB", "1")
+            c = os.environ["ALSO_BAD"]
+            d = os.environ.get(name)
+            return a, b, c, d
+        """
+    )
+    findings = analyze_source(source, ENV_PATH, [env_checker()])
+    messages = "\n".join(f.message for f in findings)
+    assert "'BAD_KNOB'" in messages
+    assert "'ALSO_BAD'" in messages
+    assert "computed name" in messages
+    assert "GOOD_KNOB" not in messages
+    assert len(findings) == 3
+
+
+def test_env_contract_exempts_config_and_wsgi_dicts():
+    source = 'import os\nx = os.environ.get("ANYTHING")\n'
+    assert analyze_source(source, "foremast_tpu/config.py", [env_checker()]) == []
+    wsgi = src(
+        """
+        def app(environ, start_response):
+            return environ.get("PATH_INFO")
+        """
+    )
+    assert analyze_source(wsgi, ENV_PATH, [env_checker()]) == []
+
+
+def test_env_contract_from_import_alias_counts():
+    source = src(
+        """
+        from os import environ
+
+        def f():
+            return environ.get("BAD_KNOB"), environ["WORSE"]
+        """
+    )
+    findings = analyze_source(source, ENV_PATH, [env_checker()])
+    assert len(findings) == 2
+
+
+def test_registry_names_unique_and_real():
+    from foremast_tpu.config import ENV_KNOBS
+
+    names = [k.name for k in ENV_KNOBS]
+    assert len(names) == len(set(names))
+    for knob in ENV_KNOBS:
+        assert knob.description
+        assert knob.group in ("engine", "framework", "deploy")
+
+
+def test_env_overrides_enumerates_set_knobs(monkeypatch):
+    from foremast_tpu.config import env_overrides
+
+    monkeypatch.setenv("FOREMAST_ARENA_BYTES", "4096")
+    monkeypatch.delenv("FOREMAST_BF16_DELTA", raising=False)
+    over = env_overrides()
+    assert over["FOREMAST_ARENA_BYTES"] == "4096"
+    assert "FOREMAST_BF16_DELTA" not in over
+
+
+def test_env_docs_block_in_sync_with_registry():
+    assert check_env_docs(repo_root()) == []
+    # and the renderer output actually lives in the committed file
+    with open(os.path.join(repo_root(), "docs", "operations.md")) as f:
+        assert render_env_table() in f.read()
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_same_line_by_rule():
+    source = src(
+        """
+        import time
+
+        async def handler(request):
+            time.sleep(1)  # foremast: ignore[async-blocking]
+        """
+    )
+    assert analyze_source(source, ASYNC_PATH, [AsyncBlockingChecker()]) == []
+
+
+def test_suppression_bare_and_comment_line_above():
+    source = src(
+        """
+        import time
+
+        async def handler(request):
+            # foremast: ignore
+            time.sleep(1)
+        """
+    )
+    assert analyze_source(source, ASYNC_PATH, [AsyncBlockingChecker()]) == []
+
+
+def test_suppression_wrong_rule_does_not_apply():
+    source = src(
+        """
+        import time
+
+        async def handler(request):
+            time.sleep(1)  # foremast: ignore[jit-hygiene]
+        """
+    )
+    findings = analyze_source(source, ASYNC_PATH, [AsyncBlockingChecker()])
+    assert len(findings) == 1
+
+
+def test_suppression_on_other_statement_does_not_leak_down():
+    source = src(
+        """
+        import time
+
+        async def handler(request):
+            x = 1  # foremast: ignore[async-blocking]
+            time.sleep(1)
+        """
+    )
+    findings = analyze_source(source, ASYNC_PATH, [AsyncBlockingChecker()])
+    assert len(findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_round_trip_and_staleness(tmp_path):
+    findings = analyze_source(ASYNC_BAD, ASYNC_PATH, [AsyncBlockingChecker()])
+    assert findings
+    path = str(tmp_path / "baseline.json")
+    Baseline.from_findings(findings).save(path)
+    loaded = Baseline.load(path)
+    new, grandfathered = loaded.split(findings)
+    assert new == [] and len(grandfathered) == len(findings)
+    assert loaded.stale(findings) == []
+    # a paid-off finding shows as stale; a brand-new one is not masked
+    subset = findings[1:]
+    assert len(loaded.stale(subset)) == 1
+    extra = Finding(
+        rule="async-blocking", path=ASYNC_PATH, line=99, message="novel"
+    )
+    new, _ = loaded.split([*findings, extra])
+    assert new == [extra]
+
+
+def test_baseline_fingerprint_is_line_independent():
+    a = Finding(rule="r", path="p.py", line=10, message="m")
+    b = Finding(rule="r", path="p.py", line=99, message="m")
+    assert a.fingerprint() == b.fingerprint()
+    assert a.fingerprint() != Finding(
+        rule="r", path="p.py", line=10, message="other"
+    ).fingerprint()
+
+
+def test_missing_baseline_means_empty():
+    assert Baseline.load("/nonexistent/baseline.json").entries == []
+
+
+# ---------------------------------------------------------------------------
+# the gate: the tree itself is clean (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_tree_clean_against_committed_baseline():
+    """`python -m foremast_tpu.analysis` exits 0 on this tree: every
+    AST checker over the whole package, the env-docs sync contract, and
+    the committed (empty-or-shrinking) baseline."""
+    root = repo_root()
+    modules = collect_modules(root)
+    findings = analyze_modules(modules, all_checkers())
+    findings.extend(check_env_docs(root))
+    baseline = Baseline.load(os.path.join(root, "analysis_baseline.json"))
+    new, _ = baseline.split(findings)
+    assert new == [], "\n" + "\n".join(f.render() for f in new)
+
+
+def test_runner_exit_codes(tmp_path, capsys):
+    from foremast_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "fixture_bad.py"
+    bad.write_text(ASYNC_BAD)
+    assert main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "async-blocking" in out and "new finding" in out
+
+    clean = tmp_path / "fixture_clean.py"
+    clean.write_text(ASYNC_CLEAN)
+    assert main([str(clean)]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_runner_folds_in_metrics_lint():
+    from foremast_tpu.analysis.__main__ import metrics_lint_findings
+
+    assert metrics_lint_findings() == []
+
+
+@pytest.mark.slow
+def test_runner_cli_subprocess_gate():
+    """The exact command `make check` runs, end to end."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "foremast_tpu.analysis"],
+        capture_output=True,
+        text=True,
+        cwd=repo_root(),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -781,3 +781,39 @@ def test_bf16_delta_fit_path_daily_seasonal_quality():
     # and the flags actually catch the injected spikes (not vacuous)
     flags = np.asarray(r16.anomalies)
     assert (flags & truth).sum() >= 0.98 * truth.sum()
+
+
+def test_arena_budget_setter_overrides_env(monkeypatch):
+    """Pod-mode knob adoption (parallel/distributed.PodWorker) goes
+    through explicit setters, not post-startup os.environ writes (the
+    lock-discipline rule those writes violated): the override wins over
+    the env, and clearing it restores env/default behavior."""
+    from foremast_tpu.engine.arena import (
+        _arena_bytes,
+        _arena_max_bytes,
+        set_arena_budget,
+    )
+
+    monkeypatch.setenv("FOREMAST_ARENA_BYTES", "123")
+    monkeypatch.setenv("FOREMAST_ARENA_MAX_BYTES", "456")
+    set_arena_budget(1024, 2048)
+    try:
+        assert _arena_bytes() == 1024
+        assert _arena_max_bytes() == 2048
+    finally:
+        set_arena_budget(None, None)
+    assert _arena_bytes() == 123
+    assert _arena_max_bytes() == 456
+
+
+def test_bf16_delta_setter_overrides_env(monkeypatch):
+    from foremast_tpu.engine import scoring
+
+    monkeypatch.setenv("FOREMAST_BF16_DELTA", "0")
+    assert not scoring.bf16_delta_enabled()
+    scoring.set_bf16_delta(True)
+    try:
+        assert scoring.bf16_delta_enabled()
+    finally:
+        scoring.set_bf16_delta(None)
+    assert not scoring.bf16_delta_enabled()
