@@ -12,6 +12,9 @@ bench:
 bench-suite:
 	$(PY) -m benchmarks.suite
 
+bench-pipeline:
+	$(PY) -m benchmarks.pipeline_bench
+
 native:
 	$(MAKE) -C native
 
@@ -39,4 +42,4 @@ clean:
 	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 
-.PHONY: test bench bench-suite native deploy-render check metrics-lint env-docs docker-build clean
+.PHONY: test bench bench-suite bench-pipeline native deploy-render check metrics-lint env-docs docker-build clean

@@ -447,6 +447,38 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "by one chunk's work (~20 s at fleet scale)",
     ),
     EnvKnob(
+        "FOREMAST_PIPELINE_DEPTH",
+        "2",
+        "int",
+        "slow-path tick-pipeline depth: prefetch runs depth-1 chunks "
+        "ahead of the device and the write queue holds at most depth "
+        "judged chunks (peak residency ~2×depth chunks across the "
+        "fetch / judge / write-back stages). `1` = fully serial. "
+        "Sources declaring "
+        "`concurrent_fetch = False` (pod-mode LeaderSource — its "
+        "fetches are ordered collectives — and in-memory sources) "
+        "always degrade to serial. Pod mode broadcasts the leader's "
+        "value",
+    ),
+    EnvKnob(
+        "FOREMAST_FETCH_WORKERS",
+        "16",
+        "int",
+        "persistent per-worker metric-fetch thread pool size (per-doc "
+        "query_range fan-out within a chunk; one pool per worker "
+        "process, reused across ticks). Pod mode broadcasts the "
+        "leader's value",
+    ),
+    EnvKnob(
+        "FOREMAST_COMPILE_CACHE_DIR",
+        None,
+        "path",
+        "JAX persistent compilation cache directory: the 20-40 s "
+        "per-bucket warmup compiles are paid once per binary and "
+        "reloaded across process restarts (hit/miss logged at "
+        "`worker --warmup`). Unset = in-memory compile cache only",
+    ),
+    EnvKnob(
         "FOREMAST_ARENA_BYTES",
         "268435456",
         "int",

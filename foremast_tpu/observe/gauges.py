@@ -255,7 +255,7 @@ class WorkerMetrics:
     """
 
     def __init__(self, registry=None):
-        from prometheus_client import REGISTRY, Counter, Histogram
+        from prometheus_client import REGISTRY, Counter, Gauge, Histogram
 
         reg = registry if registry is not None else REGISTRY
         self.jobs = Counter(
@@ -287,6 +287,35 @@ class WorkerMetrics:
             "evictions": 0,
             "fallbacks": 0,
         }
+        # slow-path chunk-pipeline occupancy (jobs/pipeline.py): the
+        # idle counter answers "how long did the device sit waiting on
+        # Prometheus", the two gauges snapshot the latest slow-path tick
+        self.pipeline_idle = Counter(
+            "foremast_worker_pipeline_idle_seconds_total",
+            "seconds the judge stage (the device) sat stalled waiting "
+            "for a chunk's metric windows",
+            registry=reg,
+        )
+        self.pipeline_overlap = Gauge(
+            "foremast_worker_pipeline_overlap_ratio",
+            "latest slow-path tick: fraction of stage-busy seconds "
+            "hidden by fetch/judge/write overlap (0 = serial, ~0.67 = "
+            "perfect three-stage overlap)",
+            registry=reg,
+        )
+        self.pipeline_queue = Gauge(
+            "foremast_worker_pipeline_write_queue_peak",
+            "latest slow-path tick: peak depth of the verdict "
+            "write-back queue",
+            registry=reg,
+        )
+
+    def observe_pipeline(self, stats) -> None:
+        """Feed one slow-path tick's ChunkPipeline stats
+        (jobs/pipeline.py PipelineStats)."""
+        self.pipeline_idle.inc(max(0.0, stats.judge_stall_seconds))
+        self.pipeline_overlap.set(stats.overlap_ratio())
+        self.pipeline_queue.set(stats.write_queue_peak)
 
     def observe_doc(self, status: str, n_windows: int) -> None:
         self.jobs.labels(status=status).inc()

@@ -195,6 +195,8 @@ class PodWorker(BrainWorker):
         knobs = broadcast_obj(
             (
                 self.cold_chunk_docs,
+                self.pipeline_depth,
+                self.fetch_workers,
                 _arena_bytes(),
                 _arena_max_bytes(),
                 bf16_delta_enabled(),
@@ -204,13 +206,18 @@ class PodWorker(BrainWorker):
         )
         if knobs is not None and not is_leader():
             self.cold_chunk_docs = knobs[0]
+            # pipeline depth/pool size are broadcast for completeness:
+            # LeaderSource forces the serial (depth-1) path regardless,
+            # but no control-flow-shaping knob may ever skew per host
+            self.pipeline_depth = knobs[1]
+            self.fetch_workers = knobs[2]
             # explicit process-local overrides, NOT os.environ writes:
             # mutating the env after threads exist is a cross-thread
             # race, and a per-host skew in either knob would dispatch
             # f32 fits on one process and bf16-delta fits on its peers —
             # differently-shaped SPMD programs over the shared mesh
-            set_arena_budget(knobs[1], knobs[2])
-            set_bf16_delta(knobs[3])
+            set_arena_budget(knobs[3], knobs[4])
+            set_bf16_delta(knobs[5])
 
     def tick(self, now: float | None = None) -> int:
         if now is None:

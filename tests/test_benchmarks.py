@@ -107,6 +107,24 @@ def test_worker_bench_churn_mode_small():
     assert out["cold_first_verdict_seconds"] <= out["cold_tick_seconds"]
 
 
+def test_pipeline_bench_small_smoke(capsys):
+    """Shipped-tick pipeline benchmark, one iteration at CI shapes: the
+    serial and pipelined cold ticks must both run, produce identical
+    store writes (asserted inside run()), and report occupancy stats."""
+    import benchmarks.pipeline_bench as pipeline_bench
+
+    pipeline_bench.main(["--small"])
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["config"] == "p-pipelined-cold-tick"
+    assert line["metric"] == "cold_tick_speedup"
+    assert line["equivalent"] is True
+    assert line["value"] and line["value"] > 0
+    assert line["chunks"] == 3
+    assert line["serial_cold_tick_seconds"] > 0
+    assert line["pipelined_cold_tick_seconds"] > 0
+    assert 0.0 <= line["overlap_ratio"] < 1.0
+
+
 def test_mixed_univariate_joint_worker_tick():
     """VERDICT r4 #5: ONE worker claim set mixing all five univariate
     shapes with bivariate + LSTM-hybrid joint jobs under the `auto`

@@ -179,3 +179,35 @@ def test_score_honors_env_config(tmp_path, capsys, monkeypatch):
         historical={"error4xx": NORMAL},
     )
     assert resp["status"] == "anomaly"
+
+
+def test_enable_compile_cache_sets_jax_config(tmp_path, monkeypatch):
+    """FOREMAST_COMPILE_CACHE_DIR points JAX's persistent compilation
+    cache at a durable dir (and creates it) so warmup compiles survive
+    process restarts; unset, the knob must be a no-op."""
+    import jax
+
+    from foremast_tpu.cli import _enable_compile_cache
+
+    flags = (
+        "jax_compilation_cache_dir",
+        "jax_persistent_cache_min_compile_time_secs",
+        "jax_persistent_cache_min_entry_size_bytes",
+    )
+    prev = {f: getattr(jax.config, f) for f in flags if hasattr(jax.config, f)}
+    target = tmp_path / "xla-cache"
+    monkeypatch.setenv("FOREMAST_COMPILE_CACHE_DIR", str(target))
+    try:
+        _enable_compile_cache()
+        assert jax.config.jax_compilation_cache_dir == str(target)
+        assert target.is_dir()
+    finally:
+        # restore: a tmp_path-bound cache dir must not outlive the test
+        for f, v in prev.items():
+            jax.config.update(f, v)
+
+    monkeypatch.delenv("FOREMAST_COMPILE_CACHE_DIR")
+    _enable_compile_cache()  # unset: no-op, config untouched
+    assert jax.config.jax_compilation_cache_dir == prev.get(
+        "jax_compilation_cache_dir"
+    )
