@@ -15,6 +15,15 @@ bench-suite:
 bench-pipeline:
 	$(PY) -m benchmarks.pipeline_bench
 
+# mixed univariate + joint fleet, end-to-end worker ticks (ISSUE 4):
+# 16,384 services with 15% joint (bivariate/LSTM-hybrid) docs
+bench-mixed:
+	$(PY) -m benchmarks.worker_bench --services 16384 --joint-frac 0.15 --algorithm auto --ticks 5
+
+# watch-plane scale: 10k DeploymentMonitors on InMemoryKube
+bench-plane:
+	$(PY) -m benchmarks.plane_bench
+
 native:
 	$(MAKE) -C native
 
@@ -42,4 +51,4 @@ clean:
 	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 
-.PHONY: test bench bench-suite bench-pipeline native deploy-render check metrics-lint env-docs docker-build clean
+.PHONY: test bench bench-suite bench-pipeline bench-mixed bench-plane native deploy-render check metrics-lint env-docs docker-build clean

@@ -1,0 +1,136 @@
+"""Watch-plane scale micro-benchmark (VERDICT r5 #7).
+
+The reference runs workqueue-ratelimited informer handlers with 2
+workers (`Barrelman.go:112-119,940-993`); this framework's plane is a
+single-threaded loop (`watch/plane.py`): Deployments list+diff resync
+every 30 s, DeploymentMonitor poll every 10 s. That is fine until
+N monitors x 10 s poll says otherwise — this benchmark says.
+
+Drives `DeploymentInformer.resync` and `MonitorController.tick` against
+an `InMemoryKube` seeded with N deployments + N RUNNING monitors and a
+zero-latency analyst stub, so the measured time is the PLANE's own host
+work (list, diff, dispatch, poll bookkeeping) with every external round
+trip at its floor. Budget: one controller poll tick and one steady
+resync must each stay well under the 10 s poll period at 10k monitors —
+the done-bar is ~1 s per tick.
+
+Usage: python -m benchmarks.plane_bench [--monitors N] [--small]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+from foremast_tpu.watch.controller import MonitorController
+from foremast_tpu.watch.crds import DeploymentMonitor, MonitorPhase, MonitorStatus
+from foremast_tpu.watch.kubeapi import InMemoryKube
+from foremast_tpu.watch.plane import DeploymentInformer
+
+
+class _StubAnalyst:
+    """Zero-latency analyst: every job stays Running (the steady state
+    of a fleet mid-window — no status write-back, the poll's floor)."""
+
+    class _Status:
+        phase = MonitorPhase.RUNNING
+        reason = ""
+        anomaly: dict = {}
+
+    def __init__(self, endpoint: str = ""):
+        pass
+
+    def get_status(self, job_id: str):
+        return self._Status()
+
+
+def build(n: int) -> InMemoryKube:
+    kube = InMemoryKube()
+    kube.add_namespace("bench", annotations={"foremast.ai/monitoring": "enabled"})
+    for i in range(n):
+        name = f"svc-{i}"
+        kube.deployments[("bench", name)] = {
+            "metadata": {
+                "namespace": "bench",
+                "name": name,
+                "uid": f"uid-{i}",
+                "resourceVersion": "1",
+                "labels": {"app": name},
+            },
+            "spec": {
+                "selector": {"matchLabels": {"app": name}},
+                "template": {"metadata": {"labels": {"app": name}}},
+            },
+        }
+        kube.monitors[("bench", f"{name}-monitor")] = DeploymentMonitor(
+            name=f"{name}-monitor",
+            namespace="bench",
+            selector={"app": name},
+            analyst_endpoint="http://analyst.invalid/v1/healthcheck/",
+            wait_until="2100-01-01T00:00:00Z",  # far future: no expiry
+            status=MonitorStatus(job_id=f"job-{i}", phase=MonitorPhase.RUNNING),
+        )
+    return kube
+
+
+def run(monitors: int, ticks: int = 3) -> dict:
+    kube = build(monitors)
+    handled = [0]
+
+    def handler(event, dep, old):  # count-only: isolates informer cost
+        handled[0] += 1
+
+    informer = DeploymentInformer(kube, handler)
+    controller = MonitorController(kube, analyst_factory=_StubAnalyst)
+
+    t0 = time.perf_counter()
+    informer.resync()  # prime: emits one add per deployment
+    prime_s = time.perf_counter() - t0
+
+    steady = []
+    for _ in range(ticks):
+        t0 = time.perf_counter()
+        informer.resync()  # no changes: pure list + diff
+        steady.append(time.perf_counter() - t0)
+
+    polls = []
+    for _ in range(ticks):
+        t0 = time.perf_counter()
+        controller.tick()
+        polls.append(time.perf_counter() - t0)
+
+    steady_s = sorted(steady)[len(steady) // 2]
+    poll_s = sorted(polls)[len(polls) // 2]
+    return {
+        "monitors": monitors,
+        "deployments": monitors,
+        "informer_prime_seconds": round(prime_s, 4),
+        "informer_resync_seconds": round(steady_s, 4),
+        "poll_tick_seconds": round(poll_s, 4),
+        "poll_us_per_monitor": round(poll_s / monitors * 1e6, 2),
+        "events_handled": handled[0],
+        "within_budget": bool(steady_s < 1.0 and poll_s < 1.0),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--monitors", type=int, default=10_000)
+    ap.add_argument("--ticks", type=int, default=3)
+    ap.add_argument("--small", action="store_true", help="CI smoke shapes")
+    args = ap.parse_args(argv)
+    if args.small:
+        args.monitors = min(args.monitors, 256)
+    result = run(args.monitors, args.ticks)
+    result["config"] = "wp-watch-plane-scale"
+    result["metric"] = "poll_tick_seconds"
+    result["value"] = result["poll_tick_seconds"]
+    result["unit"] = "seconds"
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

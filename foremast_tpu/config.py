@@ -439,6 +439,16 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "scores as one batched program",
     ),
     EnvKnob(
+        "FOREMAST_JOINT_COLUMNAR",
+        "1",
+        "bool",
+        "default `1`: warm joint (multi-alias bivariate / LSTM-hybrid) "
+        "docs ride the columnar fast tick from arena-resident model "
+        "state, the same path univariate re-checks use. `0` routes every "
+        "joint doc through the per-task object path (the pre-round-7 "
+        "behavior — ~10x slower per joint doc at fleet scale)",
+    ),
+    EnvKnob(
         "FOREMAST_COLD_CHUNK_DOCS",
         "1024",
         "int",
@@ -572,6 +582,17 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "deploy",
     ),
     EnvKnob("JAX_NUM_PROCESSES", None, "int", "multi-host init", "deploy"),
+    EnvKnob(
+        "FOREMAST_POD_TIMEOUT_SECONDS",
+        "300",
+        "float",
+        "pod-mode collective watchdog: a broadcast that does not "
+        "complete within this budget aborts the tick "
+        "(PodCollectiveTimeout) so a follower never hangs on a dead "
+        "leader — the in-flight claims age out via "
+        "MAX_STUCK_IN_SECONDS takeover. `0` disables the watchdog",
+        "deploy",
+    ),
     EnvKnob("JAX_PROCESS_ID", None, "int", "multi-host init", "deploy"),
     EnvKnob(
         "KUBERNETES_SERVICE_HOST",

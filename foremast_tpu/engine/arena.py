@@ -115,17 +115,29 @@ def _scatter(level, trend, season, phase, scale, nh, idx, l_n, t_n, s_n, p_n, sc
     )
 
 
-class StateArena:
-    """Fitted-forecast rows in HBM with approximate-LRU row recycling.
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_tree(state, idx, updates):
+    """Row scatter for an arbitrary state pytree (TreeArena): every leaf
+    is [capacity, ...] and receives its [width, ...] update slab at the
+    same row indices. Donated like `_scatter` — the arena owns the sole
+    reference, so XLA updates in place."""
+    return jax.tree.map(lambda s, u: s.at[idx].set(u), state, updates)
 
-    Not thread-safe by design: it belongs to a single judge's scoring
-    thread (the worker is the only writer, and ModelCache remains the
-    concurrent-visible layer).
-    """
+
+class RowArena:
+    """Row-assignment machinery shared by every device state arena:
+    byte-budgeted capacity with pow2 auto-grow toward the hard cap,
+    approximate-LRU recycling, hit/miss/eviction counters, and the
+    per-call transient-row aging. Subclasses own the actual device
+    buffer layout via `_alloc` / `_grow` (and their own `scatter`).
+
+    Not thread-safe by design: an arena belongs to a single judge's
+    scoring thread (the worker is the only writer, and ModelCache
+    remains the concurrent-visible layer)."""
 
     def __init__(
         self,
-        season_len: int,
+        row_bytes: int,
         max_bytes: int | None = None,
         sharding=None,
     ):
@@ -138,21 +150,28 @@ class StateArena:
         Replication is correct because row assignment is deterministic:
         every process derives identical (key -> row) maps from identical
         broadcast inputs (parallel/distributed.py)."""
-        self.m = max(int(season_len), 1)
+        self.row_bytes = max(int(row_bytes), 1)
         self.sharding = sharding
         budget = _arena_bytes() if max_bytes is None else max_bytes
-        self.max_rows = min(_MAX_ROWS, max(budget // _row_bytes(self.m), 8))
+        self.max_rows = min(_MAX_ROWS, max(budget // self.row_bytes, 8))
         # soft budget: a batch larger than max_rows auto-grows toward the
         # hard cap (one log per growth) instead of silently thrashing or
         # falling back; only past hard_rows does assign() refuse
         self.hard_rows = min(
             _MAX_ROWS,
-            max(_arena_max_bytes() // _row_bytes(self.m), 8),
+            max(_arena_max_bytes() // self.row_bytes, 8),
         )
         self.cap = 0
-        self.state = None  # (level, trend, season, phase, scale, n_hist)
+        self.state = None  # layout owned by the subclass
         self.rows: dict = {}  # fit key -> row index
         self.row_key: list = []  # row index -> fit key | None
+        # fit key -> the host entry OBJECT its row was scattered from:
+        # joint-path refresh detection (an entry replaced under the same
+        # key means the device row is stale) compares by identity, the
+        # same contract as the worker's admission revalidation. Kept by
+        # callers that scatter whole-entry rows (TreeArena users);
+        # evictions prune it so it never outgrows the row count.
+        self.row_entry: dict = {}
         self.free: list[int] = []  # unassigned row indices
         self._transients: list[int] = []  # last call's unkeyed rows
         self.stamp = np.zeros(0, np.int64)  # per-row last-use tick
@@ -160,6 +179,16 @@ class StateArena:
         self.hits = 0
         self.misses = 0  # rows scattered (new or refreshed)
         self.evictions = 0
+
+    # -- layout hooks (subclass-owned) ------------------------------------
+
+    def _alloc(self, cap: int):
+        """Fresh all-zero state for `cap` rows."""
+        raise NotImplementedError
+
+    def _grow(self, pad: int):
+        """`self.state` extended by `pad` zero rows."""
+        raise NotImplementedError
 
     # -- memory ----------------------------------------------------------
 
@@ -180,39 +209,21 @@ class StateArena:
             self.max_rows = min(self.hard_rows, _pow2(need))
             log.warning(
                 "arena grown past FOREMAST_ARENA_BYTES soft budget: "
-                "%d rows x %d B (season_len=%d) = %.0f MB; set "
-                "FOREMAST_ARENA_BYTES>=%d to silence",
+                "%d rows x %d B = %.0f MB; set FOREMAST_ARENA_BYTES>=%d "
+                "to silence",
                 need,
-                _row_bytes(self.m),
-                self.m,
-                need * _row_bytes(self.m) / 1e6,
-                need * _row_bytes(self.m),
+                self.row_bytes,
+                need * self.row_bytes / 1e6,
+                need * self.row_bytes,
             )
         if need <= self.cap:
             return True
-        new_cap = min(self.max_rows, max(_pow2(need), _MIN_ROWS))
+        new_cap = min(self.max_rows, max(_pow2(need), self._min_rows()))
         pad = new_cap - self.cap
         if self.state is None:
-            self.state = (
-                jnp.zeros(new_cap, jnp.float32),
-                jnp.zeros(new_cap, jnp.float32),
-                jnp.zeros((new_cap, self.m), jnp.float32),
-                jnp.zeros(new_cap, jnp.int32),
-                jnp.zeros(new_cap, jnp.float32),
-                jnp.zeros(new_cap, jnp.int32),
-            )
+            self.state = self._alloc(new_cap)
         else:
-            lvl, tr, se, ph, sc, nh = self.state
-            zf = jnp.zeros(pad, jnp.float32)
-            zi = jnp.zeros(pad, jnp.int32)
-            self.state = (
-                jnp.concatenate([lvl, zf]),
-                jnp.concatenate([tr, zf]),
-                jnp.concatenate([se, jnp.zeros((pad, self.m), jnp.float32)]),
-                jnp.concatenate([ph, zi]),
-                jnp.concatenate([sc, zf]),
-                jnp.concatenate([nh, zi]),
-            )
+            self.state = self._grow(pad)
         if self.sharding is not None:
             # explicit placement (replicated over the judge's mesh); a
             # handful of device_puts per growth, never per tick
@@ -225,11 +236,18 @@ class StateArena:
         self.cap = new_cap
         return True
 
+    def _min_rows(self) -> int:
+        """Initial-allocation floor (subclasses with fat rows lower it:
+        pre-allocating 8,192 LSTM rows would burn ~0.5 GB on a 10-job
+        fleet)."""
+        return _MIN_ROWS
+
     def clear(self) -> None:
         """Release device buffers and all row assignments."""
         self.cap = 0
         self.state = None
         self.rows.clear()
+        self.row_entry.clear()
         self.row_key = []
         self.stamp = np.zeros(0, np.int64)
         self.free = []
@@ -328,6 +346,7 @@ class StateArena:
                     old = self.row_key[r]
                     if old is not None:
                         del self.rows[old]
+                        self.row_entry.pop(old, None)
                         self.evictions += 1
                 if k is not None:
                     self.rows[k] = r
@@ -341,6 +360,55 @@ class StateArena:
                 scatter.append(i)
                 self.misses += 1
         return rows, scatter
+
+    def counters(self) -> dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "rows_live": len(self.rows),
+            "capacity_rows": self.cap,
+        }
+
+
+class StateArena(RowArena):
+    """Univariate fitted-forecast rows: [capacity] state vectors plus a
+    [capacity, m] season buffer (the layout `scoring.score_from_arena`
+    gathers)."""
+
+    def __init__(
+        self,
+        season_len: int,
+        max_bytes: int | None = None,
+        sharding=None,
+    ):
+        self.m = max(int(season_len), 1)
+        super().__init__(
+            _row_bytes(self.m), max_bytes=max_bytes, sharding=sharding
+        )
+
+    def _alloc(self, cap: int):
+        return (
+            jnp.zeros(cap, jnp.float32),
+            jnp.zeros(cap, jnp.float32),
+            jnp.zeros((cap, self.m), jnp.float32),
+            jnp.zeros(cap, jnp.int32),
+            jnp.zeros(cap, jnp.float32),
+            jnp.zeros(cap, jnp.int32),
+        )
+
+    def _grow(self, pad: int):
+        lvl, tr, se, ph, sc, nh = self.state
+        zf = jnp.zeros(pad, jnp.float32)
+        zi = jnp.zeros(pad, jnp.int32)
+        return (
+            jnp.concatenate([lvl, zf]),
+            jnp.concatenate([tr, zf]),
+            jnp.concatenate([se, jnp.zeros((pad, self.m), jnp.float32)]),
+            jnp.concatenate([ph, zi]),
+            jnp.concatenate([sc, zf]),
+            jnp.concatenate([nh, zi]),
+        )
 
     # -- data movement ---------------------------------------------------
 
@@ -390,11 +458,87 @@ class StateArena:
             self.state = _scatter(*self.state, idx, lvl, tr, se, ph, sc, nh)
 
     def counters(self) -> dict:
-        return {
-            "hits": self.hits,
-            "misses": self.misses,
-            "evictions": self.evictions,
-            "rows_live": len(self.rows),
-            "capacity_rows": self.cap,
-            "season_len": self.m,
-        }
+        out = super().counters()
+        out["season_len"] = self.m
+        return out
+
+
+class TreeArena(RowArena):
+    """Device-resident rows of an arbitrary fixed-shape state PYTREE —
+    the joint-detector counterpart of `StateArena` (ISSUE 4 tentpole).
+
+    One row holds everything a joint model needs to score warm: for the
+    bivariate detector the fitted Gaussian (mean [2], cov [2, 2], valid);
+    for the LSTM-AE hybrid the stacked `AEParams` leaves, the training
+    error moments, and the residual-MVN state (per-metric HW terminal
+    state, residual mean, covariance). The template fixes every leaf's
+    per-row shape/dtype; capacity is the leading axis of every leaf, and
+    warm batches are assembled ON DEVICE by `jnp.take` over a [B] row
+    index inside the joint scoring programs
+    (`multivariate.lstm_joint_score_from_rows`,
+    `models.bivariate.detect_bivariate_from_rows`). Byte budgeting,
+    pow2 auto-grow, LRU recycling and counters are inherited unchanged
+    from `RowArena`."""
+
+    def __init__(
+        self,
+        template,
+        max_bytes: int | None = None,
+        sharding=None,
+    ):
+        """`template`: pytree of `jax.ShapeDtypeStruct` (or anything with
+        .shape/.dtype) describing ONE row, without the capacity axis."""
+        self.template = template
+        leaves = jax.tree.leaves(template)
+        row_bytes = sum(
+            int(np.prod(leaf.shape, dtype=np.int64))
+            * np.dtype(leaf.dtype).itemsize
+            for leaf in leaves
+        ) or 1
+        super().__init__(row_bytes, max_bytes=max_bytes, sharding=sharding)
+
+    def _min_rows(self) -> int:
+        # joint rows are fat (an f=4 LSTM-AE row is ~60 KB vs the
+        # univariate daily row's ~5.8 KB); pre-allocating StateArena's
+        # 8,192-row floor would burn ~0.5 GB of HBM on a 10-job fleet
+        return 64
+
+    def _alloc(self, cap: int):
+        return jax.tree.map(
+            lambda leaf: jnp.zeros((cap, *leaf.shape), leaf.dtype),
+            self.template,
+        )
+
+    def _grow(self, pad: int):
+        return jax.tree.map(
+            lambda s, leaf: jnp.concatenate(
+                [s, jnp.zeros((pad, *leaf.shape), leaf.dtype)]
+            ),
+            self.state,
+            self.template,
+        )
+
+    # -- data movement ---------------------------------------------------
+
+    def scatter(self, rows: np.ndarray, positions: list[int], entries) -> None:
+        """Upload (re)fitted row pytrees into their rows.
+
+        entries[i]: a pytree of HOST numpy leaves structurally matching
+        the template (each leaf exactly the template's per-row shape —
+        callers tile/pad season buffers beforehand). Same pow2
+        width-padding discipline as `StateArena.scatter`."""
+        k = len(positions)
+        if k == 0:
+            return
+        with span("arena.scatter", rows=k, device=True):
+            width = _pow2(k)
+            idx = np.empty(width, np.int32)
+            idx[:k] = [rows[i] for i in positions]
+            idx[k:] = idx[0]
+            picked = [entries[i] for i in positions]
+            if k < width:
+                picked.extend([picked[0]] * (width - k))
+            updates = jax.tree.map(
+                lambda *leaves: np.stack(leaves), *picked
+            )
+            self.state = _scatter_tree(self.state, idx, updates)

@@ -50,7 +50,12 @@ from foremast_tpu.engine.judge import (
     bucket_length,
     infer_step,
 )
-from foremast_tpu.models.bivariate import detect_bivariate, fit_bivariate
+from foremast_tpu.models.bivariate import (
+    detect_bivariate,
+    detect_bivariate_from_rows,
+    fit_bivariate,
+    fit_bivariate_bf16_delta,
+)
 from foremast_tpu.models.cache import ModelCache
 from foremast_tpu.models.lstm_ae import (
     AEParams,
@@ -59,13 +64,16 @@ from foremast_tpu.models.lstm_ae import (
     ae_cutoff,
     fit_many,
     score_many_cutoff,
+    score_rows_cutoff,
 )
 from foremast_tpu.models.residual_mvn import (
     MVNState,
     chi2_quantile,
     fit_residual_mvn,
+    fit_residual_mvn_bf16_delta,
     residual_mvn_d2_robust,
 )
+from foremast_tpu.observe.spans import span
 from foremast_tpu.ops.forecasters import Forecast
 from foremast_tpu.ops.windows import MetricWindows
 
@@ -111,19 +119,22 @@ def select_mode(algorithm: str, n_metrics: int) -> str:
     return "univariate"
 
 
-def _align(tasks: list[MetricTask], which: str) -> tuple[np.ndarray, np.ndarray]:
+def align_series(
+    times: list[np.ndarray], vals: list[np.ndarray]
+) -> tuple[np.ndarray, np.ndarray]:
     """Common timestamps + stacked values [F, n] for one job's window set.
 
-    which: 'hist' or 'cur'. Joint observations exist only where every
-    metric has a sample.
-    """
-    times = [np.asarray(getattr(t, f"{which}_times"), np.int64) for t in tasks]
-    vals = [np.asarray(getattr(t, f"{which}_values"), np.float32) for t in tasks]
+    Joint observations exist only where every metric has a sample. The
+    one alignment routine for BOTH the object path (`_align`) and the
+    worker's joint columnar path — the two must never diverge on how a
+    ragged alias set intersects."""
+    times = [np.asarray(t, np.int64) for t in times]
+    vals = [np.asarray(v, np.float32) for v in vals]
     common = times[0]
     for t in times[1:]:
         common = np.intersect1d(common, t, assume_unique=False)
     if len(common) == 0:
-        return common, np.zeros((len(tasks), 0), np.float32)
+        return common, np.zeros((len(times), 0), np.float32)
     cols = []
     for t, v in zip(times, vals):
         # first occurrence per timestamp (times may repeat in raw traces)
@@ -132,6 +143,14 @@ def _align(tasks: list[MetricTask], which: str) -> tuple[np.ndarray, np.ndarray]
         idx = np.searchsorted(ts, common)
         cols.append(v[order][idx])
     return common, np.stack(cols, axis=0)
+
+
+def _align(tasks: list[MetricTask], which: str) -> tuple[np.ndarray, np.ndarray]:
+    """`align_series` over one job's task windows (which: 'hist'/'cur')."""
+    return align_series(
+        [getattr(t, f"{which}_times") for t in tasks],
+        [getattr(t, f"{which}_values") for t in tasks],
+    )
 
 
 def _marginal_bounds(hist: np.ndarray, threshold: float, tc: int):
@@ -151,8 +170,8 @@ def _marginal_bounds(hist: np.ndarray, threshold: float, tc: int):
     return up, lo
 
 
-def _pack(rows: list[np.ndarray], length: int) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Ragged rows -> ([B, length] values, [B, length] mask)."""
+def _pack_np(rows: list[np.ndarray], length: int) -> tuple[np.ndarray, np.ndarray]:
+    """Ragged rows -> host ([B, length] values, [B, length] mask)."""
     b = len(rows)
     out = np.zeros((b, length), np.float32)
     mask = np.zeros((b, length), bool)
@@ -160,6 +179,12 @@ def _pack(rows: list[np.ndarray], length: int) -> tuple[jnp.ndarray, jnp.ndarray
         n = min(len(r), length)
         out[i, :n] = r[:n]
         mask[i, :n] = True
+    return out, mask
+
+
+def _pack(rows: list[np.ndarray], length: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Ragged rows -> ([B, length] values, [B, length] mask) on device."""
+    out, mask = _pack_np(rows, length)
     return jnp.asarray(out), jnp.asarray(mask)
 
 
@@ -228,6 +253,81 @@ class _JointJob:
     cur_v: np.ndarray  # [F, nc]
 
 
+def _pack_bf16_delta_rows(values: np.ndarray, mask: np.ndarray):
+    """Anchor-shifted bf16-delta pack of left-packed joint histories.
+
+    values [..., T] f32 with a valid-prefix mask [..., T] (broadcastable)
+    -> (anchor [...] f32, delta [..., T] bf16). Anchor is the first slot
+    (left-packed rows put the first valid value there; all-masked rows
+    anchor 0), the same shift `judge._pack_hist_bf16_host` uses, so cold
+    joint fits ship 2 B/point instead of 5."""
+    import ml_dtypes
+
+    anchor = (values[..., 0] * mask[..., 0]).astype(np.float32)
+    delta = (values - anchor[..., None]) * mask
+    return anchor, delta.astype(ml_dtypes.bfloat16)
+
+
+@jax.jit
+def lstm_joint_score_from_rows(state, rows, x, mask, cut, cutoff, hi_cutoff, gaps):
+    """The LSTM-AE hybrid judgment from ARENA-resident joint state —
+    the joint counterpart of `scoring.score_from_arena` (ISSUE 4
+    tentpole): one compiled program gathers each doc's state row on
+    device (`rows` [S] into the TreeArena leaves), runs the AE
+    reconstruction check and the echo-robust residual-MVN check, and
+    applies the confirmation-band corroboration rule — exactly the
+    `_judge_lstm_group` scoring tail, with zero per-tick state upload.
+
+    state: TreeArena pytree — `ae` (stacked AEParams), `level`/`trend`/
+    `season`/`phase` (per-metric HW terminal state, season tiled to the
+    arena width), `rmu`/`cov` (residual Gaussian), `valid`.
+    x [S, 1, tc, F] padded aligned current windows; mask [S, tc] real
+    points; cut [S] gamma-calibrated AE error cutoffs; cutoff/hi_cutoff
+    [S] chi^2 base / strong-evidence cutoffs; gaps [S] int32 hist->cur
+    gap steps (phase advance — the arena state itself stays pristine).
+    Returns anomaly flags [S, tc] bool."""
+    ae_flags, _err = score_rows_cutoff(
+        state["ae"], rows, x, mask[:, None, :], cut
+    )
+    ae_flags = ae_flags[:, 0, :]
+    st = jax.tree.map(
+        lambda leaf: jnp.take(leaf, rows, axis=0),
+        {k: v for k, v in state.items() if k != "ae"},
+    )
+    s, f = x.shape[0], x.shape[-1]
+    m = st["season"].shape[-1]
+    gap = gaps.astype(jnp.int32)
+    # phase advances by the TRUE gap (mod m); only the trend
+    # extrapolation is bounded — same rule as the object path and the
+    # univariate scorer's _advance_gap
+    phase = ((st["phase"] + gap[:, None]) % m).astype(jnp.int32)
+    level = st["level"] + st["trend"] * jnp.minimum(
+        gap, scoring.GAP_TREND_CAP_STEPS
+    ).astype(jnp.float32)[:, None]
+    hw = Forecast(
+        pred=jnp.zeros((s * f, 0), jnp.float32),
+        scale=jnp.zeros((s * f,), jnp.float32),
+        level=level.reshape(-1),
+        trend=st["trend"].reshape(-1),
+        season=st["season"].reshape(s * f, m),
+        season_phase=phase.reshape(-1),
+    )
+    mvn = MVNState(hw=hw, mu=st["rmu"], cov=st["cov"], valid=st["valid"])
+    cur_sf = jnp.swapaxes(x[:, 0], 1, 2)  # [S, F, tc]
+    d2 = residual_mvn_d2_robust(mvn, cur_sf, cutoff)
+    # confirmation band (see _judge_lstm_group): strong evidence flags
+    # alone; borderline needs AE agreement or a BORDERLINE neighbor
+    valid = st["valid"][:, None] & mask
+    over = (d2 > cutoff[:, None]) & valid
+    strong = (d2 > hi_cutoff[:, None]) & valid
+    border = over & ~strong
+    neighbor = jnp.pad(border[:, :-1], ((0, 0), (1, 0))) | jnp.pad(
+        border[:, 1:], ((0, 0), (0, 1))
+    )
+    mvn_flags = strong | (border & (ae_flags | neighbor))
+    return ae_flags | mvn_flags
+
+
 class MultivariateJudge:
     """Dispatcher: routes each job to univariate/bivariate/LSTM judgment.
 
@@ -258,6 +358,24 @@ class MultivariateJudge:
             self.univariate.config = uni_cfg
         self.cache = cache or ModelCache(self.config.max_cache_size)
         self.lstm_steps = int(os.environ.get("FOREMAST_LSTM_STEPS", "60"))
+        # Joint columnar support (ISSUE 4 tentpole): per-key warm-path
+        # metadata the slow path records next to every joint fit —
+        # aligned-history moments (the per-alias gauge bounds), the
+        # time anchors for the MVN phase advance, and the window bucket
+        # the model was fitted at. Keyed by (mode, app, aliases, the
+        # per-alias fit keys), so a redeploy with new historical ranges
+        # can never replay a stale-phase model.
+        self.joint_meta = ModelCache(self.config.max_cache_size)
+        # device arenas holding joint-model state rows (TreeArena), one
+        # per (mode, feature count); monotone counter base folds retired
+        # arenas like HealthJudge._counters_base
+        self._joint_arenas: dict = {}
+        self._joint_counters_base = {
+            "hits": 0,
+            "misses": 0,
+            "evictions": 0,
+            "fallbacks": 0,
+        }
 
     # -- public ----------------------------------------------------------
 
@@ -451,19 +569,47 @@ class MultivariateJudge:
 
         th = bucket_length(max(len(j.hist_t) for j in joints))
         tc = bucket_length(max(len(j.cur_t) for j in joints))
-        hx, hm = _pack([j.hist_v[0] for j in joints], th)
-        hy, _ = _pack([j.hist_v[1] for j in joints], th)
+        hx_np, hm_np = _pack_np([j.hist_v[0] for j in joints], th)
+        hy_np, _ = _pack_np([j.hist_v[1] for j in joints], th)
         cx, cm = _pack([j.cur_v[0] for j in joints], tc)
         cy, _ = _pack([j.cur_v[1] for j in joints], tc)
 
         eff_thr = self._effective_thresholds(pw, threshold)
-        fit = fit_bivariate(hx, hy, hm, min_points=min_pts)
+        if scoring.bf16_delta_enabled():
+            # cold joint fits ship anchor + bf16 deltas (2 B/point) —
+            # the same wire layout as the univariate cold-fit upload
+            ax, dx = _pack_bf16_delta_rows(hx_np, hm_np)
+            ay, dy = _pack_bf16_delta_rows(hy_np, hm_np)
+            fit = fit_bivariate_bf16_delta(
+                jnp.asarray(ax),
+                jnp.asarray(dx),
+                jnp.asarray(ay),
+                jnp.asarray(dy),
+                jnp.asarray(hm_np),
+                min_points=min_pts,
+            )
+        else:
+            fit = fit_bivariate(
+                jnp.asarray(hx_np),
+                jnp.asarray(hy_np),
+                jnp.asarray(hm_np),
+                min_points=min_pts,
+            )
         flags = np.asarray(detect_bivariate(fit, cx, cy, cm, jnp.asarray(eff_thr)))
         valid = np.asarray(fit.valid)
+        mean_np = np.asarray(fit.mean)
+        cov_np = np.asarray(fit.cov)
         for i, j in enumerate(joints):
             if not valid[i]:
                 out.extend(self._unknown(j.tasks, pw[i]))
             else:
+                # valid fits become warm-path state: the entry is the
+                # fitted Gaussian, the meta carries the warm-band inputs
+                # (invalid fits cache NOTHING, so the columnar path can
+                # never turn an UNKNOWN doc healthy)
+                self._record_joint(
+                    "bivariate", j, 0, entry=(mean_np[i], cov_np[i])
+                )
                 out.extend(
                     self._emit(
                         j, flags[i, : len(j.cur_t)], float(eff_thr[i]), pw[i]
@@ -642,6 +788,11 @@ class MultivariateJudge:
         # the history's last point
         for i, j in enumerate(joints):
             step = infer_step(j.hist_t)
+            # every scored joint job becomes warm-path state: entry is
+            # already in the cache (trained/refit jobs were put by
+            # _fit_mvn_batch); the meta records the warm-band inputs and
+            # the time anchors the columnar path advances phases with
+            self._record_joint("lstm", j, tc, step=step)
             k = int(round((float(j.cur_t[0]) - mvns[i][7]) / max(step, 1.0)))
             gap = max(k - 1, 0)
             # phase advances by the TRUE gap (mod m — clamping here would
@@ -739,9 +890,21 @@ class MultivariateJudge:
             nh = j.hist_v.shape[1]
             hist[i, :, :nh] = j.hist_v
             hmask[i, :nh] = True
-        st = fit_residual_mvn(
-            jnp.asarray(hist), jnp.asarray(hmask), season_length=season
-        )
+        if scoring.bf16_delta_enabled():
+            # cold joint fits ship anchor + bf16 deltas: the [S, F, Th]
+            # aligned-history upload is the H2D bound of a joint-cold
+            # tick, the same regime as the univariate cold-fit upload
+            anchor, delta = _pack_bf16_delta_rows(hist, hmask[:, None, :])
+            st = fit_residual_mvn_bf16_delta(
+                jnp.asarray(anchor),
+                jnp.asarray(delta),
+                jnp.asarray(hmask),
+                season_length=season,
+            )
+        else:
+            st = fit_residual_mvn(
+                jnp.asarray(hist), jnp.asarray(hmask), season_length=season
+            )
         n = len(need)
         lv = np.asarray(st.hw.level, np.float32).reshape(n, f)
         tr = np.asarray(st.hw.trend, np.float32).reshape(n, f)
@@ -786,3 +949,347 @@ class MultivariateJudge:
             tc,
             self.config.season_steps,
         )
+
+    # -- joint columnar fast path (ISSUE 4 tentpole) ----------------------
+    #
+    # The slow path above records, next to every joint fit, the warm-path
+    # metadata a history-free re-check needs; the worker's fast tick then
+    # admits joint docs whose (entry, meta) pair is cached and scores them
+    # through one arena-gathered program per model kind — no MetricTask
+    # objects, no history fetch, no per-tick state upload.
+
+    def _joint_keys(self, mode: str, j: _JointJob, tc: int):
+        """(cache_key, meta_key) for a joint job, or None when any alias
+        lacks a fit key (unsettled history — never warm-admissible)."""
+        aliases = tuple(t.alias for t in j.tasks)
+        app = j.tasks[0].app
+        hkeys = tuple(t.fit_key for t in j.tasks)
+        if any(k is None for k in hkeys):
+            return None
+        if mode == "bivariate":
+            # history identity IS part of the key: two live docs for the
+            # same app/aliases over different historical ranges (two
+            # deployments) must never share a fitted Gaussian — the lstm
+            # key predates this path and is instead anchored to its
+            # history via the entry's mvn[7]/mvn[8] check in
+            # columnar_joint_peek
+            key = ("bivariate", app, aliases, hkeys)
+        else:
+            key = self._key(j, tc)
+        return key, ("jmeta", mode, app, aliases, hkeys)
+
+    def _record_joint(
+        self,
+        mode: str,
+        j: _JointJob,
+        tc: int,
+        entry=None,
+        step: float | None = None,
+    ) -> None:
+        """Fold one slow-path joint judgment into warm-path state.
+
+        meta layout: (tc, hist_mu [F], hist_sd [F], step, last_ts,
+        n_hist) — the aligned-history moments reproduce `_marginal_bounds`
+        without the history, and (step, last_ts) anchor the MVN phase
+        advance. The meta is only REPLACED when its anchors change, so a
+        stable fleet keeps stable meta identity (the worker revalidates
+        admission by identity, exactly like the univariate path)."""
+        keys = self._joint_keys(mode, j, tc)
+        if keys is None:
+            return
+        key, meta_key = keys
+        if entry is not None:
+            self.cache.put(key, entry)
+        last_ts = int(j.hist_t[-1])
+        n_hist = len(j.hist_t)
+        prev = self.joint_meta.peek(meta_key)
+        if (
+            prev is not None
+            and prev[0] == tc
+            and prev[4] == last_ts
+            and prev[5] == n_hist
+        ):
+            return
+        self.joint_meta.put(
+            meta_key,
+            (
+                tc,
+                j.hist_v.mean(axis=1),
+                j.hist_v.std(axis=1),
+                infer_step(j.hist_t) if step is None else step,
+                last_ts,
+                n_hist,
+            ),
+        )
+
+    def columnar_joint_peek(self, mode: str, app: str, aliases: tuple, hist_keys: tuple):
+        """Warm-admission probe: (cache_key, entry, meta_key, meta) when
+        this joint job can be scored columnar — both the fitted state and
+        the warm metadata are cached, the history clears the same
+        measurability gates the object path applies, and (lstm) the MVN
+        state is anchored to exactly the history the meta describes.
+        None otherwise (the doc stays on the slow path). Lock-free peeks:
+        admission runs per doc per tick."""
+        meta = self.joint_meta.peek(("jmeta", mode, app, aliases, hist_keys))
+        if meta is None:
+            return None
+        tc, _mu, _sd, _step, last_ts, n_hist = meta
+        min_pts = self.config.min_historical_points
+        if mode == "bivariate":
+            if n_hist < min_pts:
+                return None
+            key = ("bivariate", app, aliases, hist_keys)
+            entry = self.cache.peek(key)
+            if entry is None:
+                return None
+        else:
+            if n_hist < max(min_pts, tc):
+                return None
+            key = (
+                "lstm",
+                app,
+                aliases,
+                len(aliases),
+                tc,
+                self.config.season_steps,
+            )
+            entry = self.cache.peek(key)
+            # orbax-restored entries coerce on the slow path first; a
+            # stale-anchored MVN (same app redeployed over a different
+            # history) must refit there too
+            if (
+                not isinstance(entry, tuple)
+                or len(entry) != 4
+                or not isinstance(entry[0], AEParams)
+            ):
+                return None
+            mvn = entry[3]
+            if mvn is None or mvn[7] != last_ts or mvn[8] != n_hist:
+                return None
+        return key, entry, ("jmeta", mode, app, aliases, hist_keys), meta
+
+    def _bi_template(self):
+        sd = jax.ShapeDtypeStruct
+        return {
+            "mean": sd((2,), jnp.float32),
+            "cov": sd((2, 2), jnp.float32),
+        }
+
+    def _lstm_template(self, f: int, m: int):
+        sd = jax.ShapeDtypeStruct
+        h = LSTMAEConfig(features=f).hidden
+
+        def cell():
+            return LSTMParams(
+                w_x=sd((f, 4 * h), jnp.float32),
+                w_h=sd((h, 4 * h), jnp.float32),
+                b=sd((4 * h,), jnp.float32),
+            )
+
+        return {
+            "ae": AEParams(
+                enc=cell(),
+                dec=cell(),
+                w_out=sd((h, f), jnp.float32),
+                b_out=sd((f,), jnp.float32),
+            ),
+            "level": sd((f,), jnp.float32),
+            "trend": sd((f,), jnp.float32),
+            "season": sd((f, m), jnp.float32),
+            "phase": sd((f,), jnp.int32),
+            "rmu": sd((f,), jnp.float32),
+            "cov": sd((f, f), jnp.float32),
+            "valid": sd((), jnp.bool_),
+        }
+
+    def _joint_sharding(self):
+        uni = self.univariate
+        return uni._arena_sharding() if isinstance(uni, HealthJudge) else None
+
+    def _joint_arena_for(self, mode: str, f: int, m_need: int):
+        """The (mode, f) TreeArena, season buffers at least m_need wide.
+        Widening rebuilds empty (host cache entries re-scatter lazily),
+        folding the dying arena's counters into the monotone base —
+        the same lifecycle as HealthJudge._arena_for. None when arenas
+        are disabled (FOREMAST_ARENA_BYTES=0)."""
+        from foremast_tpu.engine.arena import TreeArena, _arena_bytes
+
+        if _arena_bytes() <= 0:
+            return None
+        key = (mode, f)
+        arena = self._joint_arenas.get(key)
+        if arena is None or getattr(arena, "season_m", 0) < m_need:
+            if arena is not None:
+                self._retire_joint(arena)
+            template = (
+                self._bi_template()
+                if mode == "bivariate"
+                else self._lstm_template(f, m_need)
+            )
+            arena = TreeArena(template, sharding=self._joint_sharding())
+            arena.season_m = m_need
+            self._joint_arenas[key] = arena
+        return arena
+
+    def _retire_joint(self, arena) -> None:
+        c = arena.counters()
+        for k in ("hits", "misses", "evictions"):
+            self._joint_counters_base[k] += c[k]
+
+    def joint_state_counters(self) -> dict:
+        """Aggregated joint-arena counters, monotone across rebuilds
+        (mirrors HealthJudge.device_state_counters)."""
+        agg = dict(self._joint_counters_base, rows_live=0, capacity_rows=0)
+        for arena in self._joint_arenas.values():
+            c = arena.counters()
+            for k in ("hits", "misses", "evictions", "rows_live", "capacity_rows"):
+                agg[k] += c[k]
+        return agg
+
+    def _row_tree(self, mode: str, entry, m: int):
+        """One arena row (host numpy pytree) from a cache entry."""
+        if mode == "bivariate":
+            return {"mean": entry[0], "cov": entry[1]}
+        mvn = entry[3]
+        return {
+            "ae": jax.tree.map(np.asarray, entry[0]),
+            "level": mvn[0],
+            "trend": mvn[1],
+            "season": scoring.tile_season(mvn[2], m),
+            "phase": mvn[3].astype(np.int32),
+            "rmu": mvn[4],
+            "cov": mvn[5],
+            "valid": np.bool_(mvn[6]),
+        }
+
+    def joint_columnar(
+        self,
+        mode: str,
+        keys: list,
+        entries: list,
+        metas: list,
+        cur: np.ndarray,
+        mask: np.ndarray,
+        gaps: np.ndarray | None = None,
+    ) -> np.ndarray:
+        """Batched warm judgment of admitted joint docs — arrays in,
+        anomaly flags out (the joint counterpart of `judge_columnar`).
+
+        cur [S, F, tcb] aligned current windows (caller-packed), mask
+        [S, tcb] real points, keys/entries/metas per doc from
+        `columnar_joint_peek`, gaps [S] int32 hist->cur steps (lstm).
+        Returns flags [S, tcb] bool (host numpy). The batch axis is
+        pow2-padded (dup of row 0, mask all-False => flags all-False) so
+        claim-size jitter cannot force recompiles."""
+        s0, f, tcb = cur.shape
+        thr = float(self.config.anomaly.rule_for(None).threshold)
+        m_need = (
+            1
+            if mode == "bivariate"
+            else max(e[3][2].shape[-1] for e in entries)
+        )
+        arena = self._joint_arena_for(mode, f, m_need)
+        rows = None
+        state = None
+        if arena is not None:
+            re_ = arena.row_entry
+            force = [
+                i
+                for i, (k, e) in enumerate(zip(keys, entries))
+                if re_.get(k) is not None and re_.get(k) is not e
+            ]
+            with span(
+                "judge.arena_assemble",
+                stage="arena_assemble",
+                rows=s0,
+                device=True,
+            ):
+                assigned = arena.assign(keys, force)
+                if assigned is not None:
+                    rows_idx, scat = assigned
+                    if scat:
+                        trees = [None] * len(entries)
+                        for i in scat:
+                            trees[i] = self._row_tree(
+                                mode, entries[i], arena.season_m
+                            )
+                            re_[keys[i]] = entries[i]
+                        arena.scatter(rows_idx, scat, trees)
+                    state = arena.state
+                    rows = rows_idx
+        if rows is None:
+            # arena disabled or batch over the hard byte cap: one-off
+            # host stack + upload — counted, never silent (same contract
+            # as the univariate fallback)
+            if arena is not None:
+                self._joint_counters_base["fallbacks"] += 1
+                log.warning(
+                    "joint arena fallback: %d %s rows exceed the hard "
+                    "cap — full state restack this tick; raise "
+                    "FOREMAST_ARENA_MAX_BYTES",
+                    s0,
+                    mode,
+                )
+            trees = [
+                self._row_tree(mode, e, m_need) for e in entries
+            ]
+            state = jax.tree.map(
+                lambda *ls: jnp.asarray(np.stack(ls)), *trees
+            )
+            rows = np.arange(s0, dtype=np.int64)
+        sb = bucket_length(s0)
+        if sb != s0:
+            pad = sb - s0
+            cur = np.concatenate(
+                [cur, np.zeros((pad, f, tcb), np.float32)]
+            )
+            mask = np.concatenate([mask, np.zeros((pad, tcb), bool)])
+            rows = np.concatenate([rows, np.full(pad, rows[0], rows.dtype)])
+            if gaps is not None:
+                gaps = np.concatenate([gaps, np.zeros(pad, np.int32)])
+        rows_j = jnp.asarray(rows)
+        with span(
+            "judge.score", stage="score", rows=sb, device=True
+        ):
+            if mode == "bivariate":
+                flags = detect_bivariate_from_rows(
+                    state["mean"],
+                    state["cov"],
+                    rows_j,
+                    jnp.asarray(cur[:, 0]),
+                    jnp.asarray(cur[:, 1]),
+                    jnp.asarray(mask),
+                    jnp.full((sb,), thr, jnp.float32),
+                )
+            else:
+                thr_arr = np.full(sb, thr, np.float32)
+                cut = ae_cutoff(
+                    np.asarray([e[1] for e in entries] + [1.0] * (sb - s0)),
+                    np.asarray([e[2] for e in entries] + [1.0] * (sb - s0)),
+                    thr_arr,
+                )
+                cutoff = np.full(sb, chi2_quantile(thr, f), np.float32)
+                hi = np.full(
+                    sb,
+                    chi2_quantile(thr + MVN_CONFIRM_MARGIN, f),
+                    np.float32,
+                )
+                x = jnp.asarray(
+                    np.ascontiguousarray(cur.transpose(0, 2, 1))[:, None]
+                )
+                flags = lstm_joint_score_from_rows(
+                    state,
+                    rows_j,
+                    x,
+                    jnp.asarray(mask),
+                    jnp.asarray(cut),
+                    jnp.asarray(cutoff),
+                    jnp.asarray(hi),
+                    jnp.asarray(
+                        gaps
+                        if gaps is not None
+                        else np.zeros(sb, np.int32)
+                    ),
+                )
+        with span("judge.decode", stage="decode", rows=sb, device=True):
+            return np.asarray(flags)[:s0]

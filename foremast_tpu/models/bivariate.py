@@ -14,6 +14,7 @@ so the whole detector is a handful of fused VPU ops.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -89,3 +90,53 @@ def detect_bivariate(
         threshold = threshold[:, None]
     d2 = mahalanobis2(fit, x, y)
     return mask & (d2 > threshold * threshold) & fit.valid[:, None]
+
+
+@partial(jax.jit, static_argnames=("min_points",))
+def fit_bivariate_bf16_delta(
+    anchor_x: jax.Array,
+    delta_x: jax.Array,
+    anchor_y: jax.Array,
+    delta_y: jax.Array,
+    mask: jax.Array,
+    min_points: int = 10,
+) -> BivariateFit:
+    """`fit_bivariate` from an anchor-shifted bf16-delta history upload.
+
+    Mirrors `scoring.fit_forecast_bf16_delta`: the paired histories ship
+    as (f32 anchor [B], bf16 delta [B, T]) per metric — 2 B/point on the
+    wire instead of f32's 4 — and f32 values are reconstructed
+    in-program (transient HBM; the saving is the H2D, which bounds cold
+    joint fleet ticks over a degraded tunnel). Deltas are packed masked
+    (exact zeros in masked slots), so reconstruction multiplies the mask
+    back in to keep masked slots at exact zero like the f32 pack."""
+    m = mask.astype(jnp.float32)
+    x = (anchor_x[:, None] + delta_x.astype(jnp.float32)) * m
+    y = (anchor_y[:, None] + delta_y.astype(jnp.float32)) * m
+    return fit_bivariate(x, y, mask, min_points=min_points)
+
+
+@jax.jit
+def detect_bivariate_from_rows(
+    mean: jax.Array,
+    cov: jax.Array,
+    rows: jax.Array,
+    x: jax.Array,
+    y: jax.Array,
+    mask: jax.Array,
+    threshold: jax.Array,
+) -> jax.Array:
+    """`detect_bivariate` against ARENA-resident fits (engine.arena
+    .TreeArena): `mean` [capacity, 2] / `cov` [capacity, 2, 2] hold one
+    fitted Gaussian per arena row and `rows` [B] indexes the batch's
+    fits, so a warm re-check tick ships only the current windows and a
+    row-index vector — the joint counterpart of
+    `scoring.score_from_arena`. Only VALID fits are ever admitted to the
+    arena (the judge caches invalid fits nowhere), so the gathered state
+    carries no validity flag."""
+    fit = BivariateFit(
+        mean=jnp.take(mean, rows, axis=0),
+        cov=jnp.take(cov, rows, axis=0),
+        valid=jnp.ones(rows.shape, bool),
+    )
+    return detect_bivariate(fit, x, y, mask, threshold)

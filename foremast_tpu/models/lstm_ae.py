@@ -274,3 +274,15 @@ def score_many_cutoff(params, x, mask, cutoff):
     err = jax.vmap(recon_error)(params, x, mask)
     flags = mask & (err > cutoff[:, None, None])
     return flags, err
+
+
+@jax.jit
+def score_rows_cutoff(params, rows, x, mask, cutoff):
+    """`score_many_cutoff` against ARENA-resident models (engine.arena
+    .TreeArena): `params` leaves are [capacity, ...]-stacked and `rows`
+    [S] indexes the batch's models, gathered ON DEVICE — the LSTM-AE
+    counterpart of `scoring.score_from_arena`, so a warm joint re-check
+    tick ships only the current windows and a row-index vector, never
+    the ~60 KB/model parameter stack. Returns (flags [S, B, T], errors)."""
+    gathered = jax.tree.map(lambda leaf: jnp.take(leaf, rows, axis=0), params)
+    return score_many_cutoff(gathered, x, mask, cutoff)

@@ -130,6 +130,35 @@ def fit_residual_mvn(
     return MVNState(hw=fc, mu=mu, cov=cov, valid=valid)
 
 
+@partial(jax.jit, static_argnames=("season_length", "min_points", "ridge"))
+def fit_residual_mvn_bf16_delta(
+    anchor: jax.Array,
+    delta: jax.Array,
+    mask: jax.Array | None = None,
+    season_length: int = SEASON_LENGTH,
+    min_points: int = 10,
+    ridge: float = 1e-6,
+) -> MVNState:
+    """`fit_residual_mvn` from an anchor-shifted bf16-delta upload.
+
+    hist ships as (f32 anchor [B, F], bf16 delta [B, F, Th]) — the same
+    2 B/point wire layout as `scoring.fit_forecast_bf16_delta`; f32
+    values are reconstructed in-program (transient HBM, the saving is
+    the H2D bound of cold joint fleet ticks). Deltas are packed masked
+    (exact zeros), so masked slots reconstruct to exact zero like the
+    f32 pack path."""
+    values = anchor[:, :, None] + delta.astype(jnp.float32)
+    if mask is not None:
+        values = values * mask[:, None, :]
+    return fit_residual_mvn(
+        values,
+        mask,
+        season_length=season_length,
+        min_points=min_points,
+        ridge=ridge,
+    )
+
+
 def _d2(state: MVNState, cur: jax.Array, upd: jax.Array) -> jax.Array:
     """d^2 [B, Tc] with per-(job, t) state-update gating.
 

@@ -281,6 +281,16 @@ class WorkerMetrics:
             ["event"],
             registry=reg,
         )
+        # per-kind columnar-path doc counts (ISSUE 4): joint kinds
+        # (bivariate/lstm) > 0 is the observable proof that multi-alias
+        # docs ride the fast tick instead of the per-task object path
+        self.fast_docs = Counter(
+            "foremast_worker_fast_docs_total",
+            "documents scored on the columnar fast path, by model kind "
+            "(univariate / bivariate / lstm)",
+            ["kind"],
+            registry=reg,
+        )
         self._arena_last = {
             "hits": 0,
             "misses": 0,

@@ -33,6 +33,7 @@ ALLOWED_LABELS: dict[str, frozenset[str]] = {
     "foremast_worker_windows": frozenset(),
     "foremast_worker_tick_seconds": frozenset(),
     "foremast_worker_arena_events": frozenset({"event"}),
+    "foremast_worker_fast_docs": frozenset({"kind"}),
     "foremast_service_requests": frozenset({"route", "code"}),
     "foremast_controller_transitions": frozenset({"phase"}),
     "foremastbrain_gauge_families_dropped": frozenset(),
@@ -85,6 +86,8 @@ def default_registry_families():
     metrics.observe_doc("completed_health", 1)
     metrics.observe_arena({"hits": 1, "misses": 1, "evictions": 0, "fallbacks": 0})
     metrics.tick_seconds.observe(0.01)
+    for kind in ("univariate", "bivariate", "lstm"):
+        metrics.fast_docs.labels(kind=kind).inc()
     tracer = Tracer(service="lint", registry=registry, trace_dir=None)
     from foremast_tpu.observe.spans import TICK_STAGES
 

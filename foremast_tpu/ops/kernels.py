@@ -176,6 +176,113 @@ def _judgment_kernel(
     lower_ref[:] = jnp.broadcast_to(lo, cur.shape)
 
 
+def _judgment_bf16_kernel(
+    anchor_ref, delta_ref, lens_ref, cv_ref, cm_ref, thr_ref, bnd_ref,
+    mlb_ref, mnp_ref, verdict_ref, anom_ref, upper_ref, lower_ref,
+):
+    # moments straight off the bf16 deltas with f32 accumulation —
+    # E[v] = anchor + E[d], Var[v] = Var[d]; left-packed deltas are
+    # exact zeros past `lens`, so plain sums ARE the masked sums
+    # (identical algebra to scoring.score_bf16_delta)
+    d = delta_ref[:].astype(jnp.float32)
+    n = lens_ref[:]  # [TB, 1] f32 valid counts
+    c = jnp.maximum(n, 1.0)
+    s1 = jnp.sum(d, axis=-1, keepdims=True)
+    s2 = jnp.sum(d * d, axis=-1, keepdims=True)
+    mean_d = s1 / c
+    mean = jnp.where(n > 0, anchor_ref[:] + mean_d, 0.0)
+    var = jnp.where(
+        n > 0, jnp.maximum(s2 / c - mean_d * mean_d, 0.0), 0.0
+    )
+    sigma = jnp.sqrt(var)
+
+    band = thr_ref[:] * sigma
+    up = mean + band
+    lo = jnp.maximum(mean - band, mlb_ref[:])
+
+    cur = cv_ref[:]
+    curm = cm_ref[:] > 0.0
+    bnd = bnd_ref[:].astype(jnp.int32)
+    use_up = (bnd == 1) | (bnd == 3)
+    use_lo = (bnd == 2) | (bnd == 3)
+    flags = curm & (((cur > up) & use_up) | ((cur < lo) & use_lo))
+
+    ncur = jnp.sum(cm_ref[:], axis=-1, keepdims=True)
+    measurable = (n >= mnp_ref[:]) & (ncur > 0.0)
+    flags = flags & measurable
+    any_anom = jnp.any(flags, axis=-1, keepdims=True)
+    verdict_ref[:] = jnp.where(
+        measurable,
+        jnp.where(any_anom, _UNHEALTHY, _HEALTHY),
+        _UNKNOWN,
+    ).astype(jnp.int32)
+    anom_ref[:] = flags.astype(jnp.float32)
+    upper_ref[:] = jnp.broadcast_to(up, cur.shape)
+    lower_ref[:] = jnp.broadcast_to(lo, cur.shape)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def ma_judgment_bf16_delta(
+    anchor: jax.Array,
+    delta: jax.Array,
+    lens: jax.Array,
+    cur_values: jax.Array,
+    cur_mask: jax.Array,
+    threshold: jax.Array,
+    bound: jax.Array,
+    min_lower_bound: jax.Array,
+    min_points: jax.Array,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """`ma_judgment` on the bf16-delta history layout (VERDICT r5 #5).
+
+    anchor [B] f32, delta [B, Th] bf16 (anchor-shifted, LEFT-PACKED:
+    exact zeros past `lens`), lens [B] int32 — the same wire layout as
+    `scoring.score_bf16_delta`/`fit_ma_from_bf16_delta`, so the kernel
+    reads 2 B/point like the shipped XLA program instead of the f32
+    kernel's 5 B/point. Same outputs/semantics as `ma_judgment` up to
+    bf16 rounding of the deviations (parity pinned by tests)."""
+    b, tc = cur_values.shape
+    dv = _pad_axis(_pad_axis(delta, LANE, 1, 0), TILE_B, 0, 0)
+    cv, cm = _pad_bt(cur_values.astype(jnp.float32), cur_mask)
+    bp = dv.shape[0]
+    thp = dv.shape[1]
+    tcp = cv.shape[1]
+    f32 = jnp.float32
+    anc = _col(anchor, bp, f32)
+    nvl = _col(lens, bp, f32)
+    thr = _col(threshold, bp, f32)
+    bnd = _col(bound, bp, jnp.int32)
+    mlb = _col(min_lower_bound, bp, f32)
+    mnp = _col(min_points, bp, f32)
+
+    grid = (bp // TILE_B,)
+    hist_spec = pl.BlockSpec((TILE_B, thp), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    cur_spec = pl.BlockSpec((TILE_B, tcp), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    col_spec = pl.BlockSpec((TILE_B, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+    verdict, anom, upper, lower = pl.pallas_call(
+        _judgment_bf16_kernel,
+        grid=grid,
+        in_specs=[col_spec, hist_spec, col_spec, cur_spec, cur_spec,
+                  col_spec, col_spec, col_spec, col_spec],
+        out_specs=(col_spec, cur_spec, cur_spec, cur_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((bp, 1), jnp.int32),
+            jax.ShapeDtypeStruct((bp, tcp), f32),
+            jax.ShapeDtypeStruct((bp, tcp), f32),
+            jax.ShapeDtypeStruct((bp, tcp), f32),
+        ),
+        interpret=_interpret(interpret),
+    )(anc, dv, nvl, cv, cm, thr, bnd, mlb, mnp)
+    return (
+        verdict[:b, 0],
+        anom[:b, :tc] > 0.0,
+        upper[:b, :tc],
+        lower[:b, :tc],
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def ma_judgment(
     hist_values: jax.Array,

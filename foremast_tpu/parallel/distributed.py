@@ -36,7 +36,9 @@ rows.
 from __future__ import annotations
 
 import logging
+import os
 import pickle
+import threading
 import time
 
 import jax
@@ -53,14 +55,25 @@ def is_leader() -> bool:
     return jax.process_index() == 0
 
 
-def broadcast_obj(obj=None):
-    """Broadcast a picklable host object from process 0 to every process.
+class PodCollectiveTimeout(RuntimeError):
+    """A pod broadcast did not complete within the watchdog budget —
+    a peer process (usually the leader) is dead or wedged. Raised so
+    the process FAILS FAST instead of hanging inside a collective
+    forever: the tick aborts, the process exits, and the in-flight
+    claims age out into another worker via MAX_STUCK_IN_SECONDS
+    (docs/operations.md, "Pod-mode failure and recovery")."""
 
-    Followers pass anything (ignored) and receive the leader's object.
-    Single-process: returns `obj` unchanged with zero collectives.
-    """
-    if jax.process_count() == 1:
-        return obj
+
+def _pod_timeout_seconds() -> float | None:
+    raw = os.environ.get("FOREMAST_POD_TIMEOUT_SECONDS", "300")
+    try:
+        t = float(raw)
+    except ValueError:
+        return 300.0
+    return t if t > 0 else None
+
+
+def _broadcast_raw(obj=None):
     from jax.experimental import multihost_utils as mhu
 
     leader = is_leader()
@@ -70,10 +83,94 @@ def broadcast_obj(obj=None):
     else:
         payload = None
         size = np.zeros(1, np.int64)
-    size = mhu.broadcast_one_to_all(size)
+    # np.asarray + explicit dtype restore: depending on jax/collectives
+    # version the broadcast returns the payload UPCAST to a wider
+    # integer type (observed with 0.4.x gloo CPU collectives: uint8 in,
+    # int out — element values correct, so `.tobytes()` silently
+    # interleaves zero bytes and the pickle stream corrupts)
+    size = np.asarray(mhu.broadcast_one_to_all(size))
     buf = payload if leader else np.zeros(int(size[0]), np.uint8)
-    buf = mhu.broadcast_one_to_all(buf)
+    buf = np.asarray(mhu.broadcast_one_to_all(buf)).astype(np.uint8)
     return obj if leader else pickle.loads(buf.tobytes())
+
+
+class _BroadcastWorker:
+    """ONE persistent daemon thread executing pod broadcasts in order.
+
+    Per-call thread spawn would land on the per-fetch hot path (a
+    fleet-cold pod tick issues tens of thousands of broadcasts); a
+    single worker keeps the watchdog at one Event wait per call and —
+    unlike ThreadPoolExecutor — never registers an atexit join, so a
+    thread wedged inside a dead peer's collective cannot block the
+    fail-fast process exit the watchdog exists to guarantee."""
+
+    def __init__(self):
+        import queue
+
+        self._tasks: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="foremast-pod-broadcast"
+        )
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            obj, box, done = self._tasks.get()
+            try:
+                box.append(("ok", _broadcast_raw(obj)))
+            except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+                box.append(("err", e))
+            finally:
+                done.set()
+
+    def run(self, obj, timeout: float):
+        done = threading.Event()
+        box: list = []
+        self._tasks.put((obj, box, done))
+        if not done.wait(timeout):
+            # the worker stays wedged in the dead collective; that is
+            # fine — the contract is that the caller now aborts the
+            # tick and the process EXITS (daemon thread, no atexit join)
+            raise PodCollectiveTimeout(
+                f"pod broadcast incomplete after {timeout:.0f}s — a peer "
+                "process is dead or wedged; aborting the tick so "
+                "in-flight claims can age out (MAX_STUCK_IN_SECONDS "
+                "takeover)"
+            )
+        kind, val = box[0]
+        if kind == "err":
+            raise val
+        return val
+
+
+_broadcast_worker: _BroadcastWorker | None = None
+
+
+def broadcast_obj(obj=None):
+    """Broadcast a picklable host object from process 0 to every process.
+
+    Followers pass anything (ignored) and receive the leader's object.
+    Single-process: returns `obj` unchanged with zero collectives.
+
+    Every pod broadcast runs under a WATCHDOG
+    (`FOREMAST_POD_TIMEOUT_SECONDS`, default 300; `0` disables): the
+    runtime's own failure detection takes minutes to notice a dead
+    coordinator, and a follower blocked inside a collective would
+    otherwise hang the pod silently while its claims sit un-aged on the
+    store. On timeout `PodCollectiveTimeout` propagates — the worker
+    tick aborts, the process exits, and the reference's stuck-claim
+    takeover recovers the in-flight documents (VERDICT r5 #6). Only the
+    tick thread may call this (collective ORDER is load-bearing), so
+    the single persistent worker thread preserves sequencing."""
+    if jax.process_count() == 1:
+        return obj
+    timeout = _pod_timeout_seconds()
+    if timeout is None:
+        return _broadcast_raw(obj)
+    global _broadcast_worker
+    if _broadcast_worker is None:
+        _broadcast_worker = _BroadcastWorker()
+    return _broadcast_worker.run(obj, timeout)
 
 
 class LeaderStore(JobStore):

@@ -75,6 +75,18 @@ def pad_to_multiple(n: int, k: int) -> int:
 # ---------------------------------------------------------------------------
 
 
+def _distributed_initialized() -> bool:
+    """`jax.distributed.is_initialized()` with a 0.4.x fallback (the
+    accessor only gained the public spelling in later jax; on 0.4.x the
+    global client being set IS the initialized marker)."""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    from jax._src import distributed as _dist
+
+    return _dist.global_state.client is not None
+
+
 def init_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -103,13 +115,23 @@ def init_distributed(
     )
     if coordinator_address is None and num_processes is None:
         return False  # single-host: nothing to coordinate
-    if jax.distributed.is_initialized():
+    if _distributed_initialized():
         # idempotent: a prior initialize (ours, the runtime's TPU-pod
         # auto-init, or an embedding application's) wins. Re-calling
         # jax.distributed.initialize here would raise the generic
         # "must be called before any JAX calls" error, not a clean
         # already-initialized signal.
         return True
+    # CPU multi-process needs an explicit collectives backend on older
+    # jax (0.4.x): without gloo, cross-process programs raise
+    # "Multiprocess computations aren't implemented on the CPU backend".
+    # Set unconditionally BEFORE backends initialize (probing the
+    # backend here would itself initialize it); the option only affects
+    # the CPU client and disappears once the default grows collectives.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 — newer jax handles this itself
+        pass
     # a connect or barrier failure surfaces to the caller — swallowing it
     # would leave this process on a local-only "global" mesh while its
     # peers hang at the init barrier

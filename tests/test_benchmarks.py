@@ -125,6 +125,57 @@ def test_pipeline_bench_small_smoke(capsys):
     assert 0.0 <= line["overlap_ratio"] < 1.0
 
 
+def test_worker_bench_mixed_fleet_small():
+    """`make bench-mixed --small` smoke (ISSUE 4): a mixed fleet (15%
+    joint docs) must run cold + warm, with the JOINT docs scored on the
+    columnar path during the warm ticks (per-kind counters > 0 is the
+    acceptance signal) and zero joint-arena fallbacks."""
+    from benchmarks.worker_bench import run
+
+    out = run(
+        services=40,
+        ticks=2,
+        algorithm="auto",
+        season=24,
+        hist_len=256,
+        cur_len=30,
+        joint_frac=0.15,
+    )
+    assert out["joint_services"] == 6
+    fast = out["fast_path_docs"]
+    assert fast["bivariate"] > 0 and fast["lstm"] > 0, fast
+    assert fast["univariate"] > 0, fast
+    assert out["joint_arena"]["fallbacks"] == 0
+    assert out["joint_arena"]["rows_live"] > 0
+    assert out["warm_windows_per_sec"] > 0
+
+
+def test_plane_bench_small_smoke():
+    """Watch-plane scale benchmark (VERDICT r5 #7) at CI shapes: the
+    informer resync and the controller poll tick must run and stay
+    inside the ~1 s budget (at 10k monitors the measured full-scale
+    numbers are ~12/48 ms — BENCHMARKS.md)."""
+    from benchmarks.plane_bench import run
+
+    out = run(monitors=64, ticks=2)
+    assert out["events_handled"] == 64
+    assert out["within_budget"] is True
+    assert out["poll_tick_seconds"] >= 0
+
+
+def test_fleet_mix_f1_pinned():
+    """Regression pin for the fleet-mix quality scenario (ISSUE 4: the
+    joint columnar path must not move univariate routing quality): at
+    the CI shape, `auto_univariate` over one batch mixing all five
+    shapes holds the round-5 floors."""
+    from benchmarks.quality import fleet_mix
+
+    f1, precision, recall, by_kind = fleet_mix(32, 240, 30)
+    assert f1 >= 0.97, (f1, by_kind)
+    assert precision >= 0.99, (precision, by_kind)
+    assert all(v >= 0.95 for v in by_kind.values()), by_kind
+
+
 def test_mixed_univariate_joint_worker_tick():
     """VERDICT r4 #5: ONE worker claim set mixing all five univariate
     shapes with bivariate + LSTM-hybrid joint jobs under the `auto`

@@ -1,0 +1,260 @@
+"""Joint (multi-alias) columnar fast-path coverage — ISSUE 4 tentpole.
+
+Serial-vs-columnar equivalence for mixed univariate/joint claim sets:
+once a joint doc's bivariate/LSTM-hybrid fit is cached, the warm tick
+claims it onto the columnar path (`worker._judge_joint_fast` +
+`MultivariateJudge.joint_columnar`, scoring from arena-resident state)
+— and must produce the SAME statuses, anomaly payloads, store-write
+set, fit-cache keys, and hook verdicts as the per-task object path on
+identical claims.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from benchmarks.worker_bench import build_mixed_fleet
+from foremast_tpu.config import BrainConfig
+from foremast_tpu.jobs import (
+    BrainWorker,
+    STATUS_COMPLETED_UNHEALTH,
+    STATUS_PREPROCESS_COMPLETED,
+)
+
+NOW = 1_760_000_000.0
+HIST_LEN = 256
+CUR_LEN = 30
+SERVICES = 12  # 2 joint (1 bivariate + 1 lstm) + 10 single-alias
+
+
+def _mk_worker(joint_fast: bool, hook=None, services: int = SERVICES,
+               algorithm: str = "auto", joint_frac: float = 0.17):
+    store, source, windows = build_mixed_fleet(
+        services, HIST_LEN, CUR_LEN, NOW, joint_frac=joint_frac
+    )
+    cfg = BrainConfig(algorithm=algorithm, season_steps=24,
+                      max_cache_size=4 * services + 64)
+    # joint detectors read the base threshold; calibrate at 4 sigma like
+    # the quality scenarios (2.0 would page on clean windows)
+    cfg = dataclasses.replace(
+        cfg, anomaly=dataclasses.replace(cfg.anomaly, threshold=4.0)
+    )
+    worker = BrainWorker(
+        store, source, config=cfg, claim_limit=2 * services,
+        worker_id="joint-w", on_verdict=hook,
+    )
+    worker.judge.lstm_steps = 10  # CI speed; identical on both workers
+    if not joint_fast:
+        worker._joint_fast = False
+    return worker, store, source, windows
+
+
+def _statuses(store):
+    return {
+        d.id: (d.status, d.reason, d.anomaly_info)
+        for d in store._docs.values()
+    }
+
+
+def _record_writes(store):
+    writes = []
+    orig_update, orig_many = store.update, store.update_many
+
+    def _u(doc):
+        writes.append((doc.id, doc.status))
+        return orig_update(doc)
+
+    def _um(docs):
+        writes.extend((d.id, d.status) for d in docs)
+        return orig_many(docs)
+
+    store.update, store.update_many = _u, _um
+    return writes
+
+
+def _spike_joint(source, sid: str, f: int):
+    """Push every metric of a joint service up 0.6 (≈8 idio-sigmas) on
+    the last 3 points — the quality scenarios' all-metric spike."""
+    for m in range(f):
+        url = f"http://prom/cur?q=m{m}:app{sid}&step=60"
+        ct, cv = source.data[url]
+        spiked = cv.copy()
+        spiked[-3:] += 0.6
+        source.data[url] = (ct, spiked)
+
+
+def test_joint_fast_path_engages_and_matches_object_path():
+    """Tick 1 is cold (object path fits + caches joint models); tick 2
+    must claim the joint docs onto the columnar path and produce the
+    same statuses, anomaly_info, write set, and fit-cache keys the
+    object path would."""
+    verdicts_a, verdicts_b = {}, {}
+    hook_a = lambda doc, vs: verdicts_a.setdefault(doc.id, []).append(vs)
+    hook_b = lambda doc, vs: verdicts_b.setdefault(doc.id, []).append(vs)
+    a, a_store, a_src, windows = _mk_worker(True, hook=hook_a)
+    b, b_store, b_src, _ = _mk_worker(False, hook=hook_b)
+
+    assert a.tick(now=NOW + 150) == SERVICES
+    assert b.tick(now=NOW + 150) == SERVICES
+    assert _statuses(a_store) == _statuses(b_store)
+    assert a._fast_kinds["bivariate"] == 0  # cold tick: slow path only
+    assert a._fast_kinds["lstm"] == 0
+
+    # spike the lstm joint doc (sid 1, f=4) so anomaly pairs cross the
+    # columnar path; the bivariate doc (sid 0) stays clean
+    for src in (a_src, b_src):
+        _spike_joint(src, "1", 4)
+
+    writes_a = _record_writes(a_store)
+    writes_b = _record_writes(b_store)
+    assert a.tick(now=NOW + 200) == SERVICES
+    assert b.tick(now=NOW + 200) == SERVICES
+    sa, sb = _statuses(a_store), _statuses(b_store)
+    assert sa == sb
+    assert sa["job-1"][0] == STATUS_COMPLETED_UNHEALTH
+    assert set(sa["job-1"][2]["values"]) == {"m0", "m1", "m2", "m3"}
+    assert sa["job-0"][0] == STATUS_PREPROCESS_COMPLETED
+
+    # the columnar worker actually took the joint fast path; the object
+    # worker never did
+    assert a._fast_kinds["bivariate"] == 1 and a._fast_kinds["lstm"] == 1
+    assert b._fast_kinds["bivariate"] == 0 and b._fast_kinds["lstm"] == 0
+    ja = a._mvj.joint_state_counters()
+    assert ja["misses"] == 2 and ja["rows_live"] == 2
+
+    # same write SET (the columnar path batches its update_many, so the
+    # order differs; the persisted outcomes may not)
+    assert sorted(writes_a) == sorted(writes_b)
+    # same joint fit-cache key population
+    assert set(a._mvj.cache._d) == set(b._mvj.cache._d)
+    assert set(a._mvj.joint_meta._d) == set(b._mvj.joint_meta._d)
+
+    # hook verdict parity on the warm tick for the joint docs: same
+    # verdicts, pairs, FULL marginal bands, and pairwise evidence
+    for doc_id in ("job-0", "job-1"):
+        va, vb = verdicts_a[doc_id][-1], verdicts_b[doc_id][-1]
+        assert len(va) == len(vb)
+        for x, y in zip(va, vb):
+            assert (x.alias, x.verdict, x.anomaly_pairs) == (
+                y.alias, y.verdict, y.anomaly_pairs
+            )
+            np.testing.assert_array_equal(x.upper, y.upper)
+            np.testing.assert_array_equal(x.lower, y.lower)
+            assert (x.p_value, x.dist_differs) == (y.p_value, y.dist_differs)
+
+
+def test_joint_admission_revalidates_by_identity():
+    """A joint-cache version bump (unrelated churn) must not evict the
+    admission cache: entries revalidate by identity and stay admitted."""
+    a, a_store, _, _ = _mk_worker(True)
+    a.tick(now=NOW + 150)
+    a.tick(now=NOW + 160)
+    assert len(a._jadmit) == 2  # both joint docs admitted
+    token0 = {k: v[2] for k, v in a._jadmit.items()}
+    jinfo0 = {k: v[1] for k, v in a._jadmit.items()}
+
+    # unrelated churn: bump both cache versions without touching the
+    # admitted entries
+    a._mvj.cache.put(("unrelated",), (1,))
+    a._mvj.joint_meta.put(("unrelated-meta",), (1,))
+    a.tick(now=NOW + 170)
+    assert len(a._jadmit) == 2
+    for k in a._jadmit:
+        assert a._jadmit[k][2] != token0[k]  # restamped
+        assert a._jadmit[k][1] is jinfo0[k]  # jinfo NOT rebuilt
+    counters = a._mvj.joint_state_counters()
+    assert counters["hits"] >= 2  # tick 3 gathered, not re-scattered
+
+
+def test_joint_fast_matches_slow_under_explicit_bivariate_algorithm():
+    """ML_ALGORITHM=bivariate_normal: 2-alias docs ride the joint
+    columnar path; the 1-alias docs fall to the univariate fallback
+    (still columnar, kind=univariate)."""
+    a, a_store, a_src, _ = _mk_worker(True, algorithm="bivariate_normal")
+    b, b_store, b_src, _ = _mk_worker(False, algorithm="bivariate_normal")
+    assert a.tick(now=NOW + 150) == SERVICES
+    assert b.tick(now=NOW + 150) == SERVICES
+    # off-ridge spike on the bivariate doc (sid 0): x up, y down
+    for src in (a_src, b_src):
+        u0 = "http://prom/cur?q=m0:app0&step=60"
+        u1 = "http://prom/cur?q=m1:app0&step=60"
+        ct, cv = src.data[u0]
+        s = cv.copy()
+        s[-2:] += 1.0
+        src.data[u0] = (ct, s)
+        ct, cv = src.data[u1]
+        s = cv.copy()
+        s[-2:] -= 1.0
+        src.data[u1] = (ct, s)
+    assert a.tick(now=NOW + 200) == SERVICES
+    assert b.tick(now=NOW + 200) == SERVICES
+    assert _statuses(a_store) == _statuses(b_store)
+    assert _statuses(a_store)["job-0"][0] == STATUS_COMPLETED_UNHEALTH
+    assert a._fast_kinds["bivariate"] == 1
+    assert a._fast_kinds["univariate"] > 0
+
+
+def test_joint_window_bucket_drift_demotes_to_slow_path():
+    """A joint doc whose current-window bucket drifts from the fitted
+    one must be refit on the slow path, not scored through the wrong
+    compiled program — and the verdict must match the object path's."""
+    a, a_store, a_src, _ = _mk_worker(True)
+    b, b_store, b_src, _ = _mk_worker(False)
+    assert a.tick(now=NOW + 150) == SERVICES
+    assert b.tick(now=NOW + 150) == SERVICES
+    # grow the lstm doc's current windows past the 32-bucket (33 > 32)
+    for src in (a_src, b_src):
+        for m in range(4):
+            url = f"http://prom/cur?q=m{m}:app1&step=60"
+            ct, cv = src.data[url]
+            ct2 = np.concatenate([ct, ct[-1:] + 60 * np.arange(1, 4)])
+            cv2 = np.concatenate([cv, cv[-3:]]).astype(np.float32)
+            src.data[url] = (ct2, cv2)
+    assert a.tick(now=NOW + 200) == SERVICES
+    assert b.tick(now=NOW + 200) == SERVICES
+    assert _statuses(a_store) == _statuses(b_store)
+    # the drifted doc went through the slow path, not the lstm bucket
+    assert a._fast_kinds["lstm"] == 0
+
+
+def test_joint_fast_disabled_by_env(monkeypatch):
+    """FOREMAST_JOINT_COLUMNAR=0 restores the object-path routing."""
+    monkeypatch.setenv("FOREMAST_JOINT_COLUMNAR", "0")
+    a, _, _, _ = _mk_worker(True)
+    assert not a._joint_fast
+    a.tick(now=NOW + 150)
+    a.tick(now=NOW + 200)
+    assert a._fast_kinds["bivariate"] == 0 and a._fast_kinds["lstm"] == 0
+
+
+def test_debug_state_carries_joint_counters():
+    a, _, _, _ = _mk_worker(True)
+    a.tick(now=NOW + 150)
+    a.tick(now=NOW + 200)
+    state = a.debug_state()
+    assert state["fast_path_docs"]["bivariate"] == 1
+    assert state["fast_path_docs"]["lstm"] == 1
+    assert state["joint_arena"]["rows_live"] == 2
+
+
+def test_worker_metrics_fast_docs_counter():
+    from prometheus_client import CollectorRegistry
+
+    from foremast_tpu.observe.gauges import WorkerMetrics
+
+    reg = CollectorRegistry()
+    a, a_store, a_src, _ = _mk_worker(True)
+    a.metrics = WorkerMetrics(registry=reg)
+    a.tick(now=NOW + 150)
+    a.tick(now=NOW + 200)
+    got = {
+        s.labels["kind"]: s.value
+        for fam in reg.collect()
+        if fam.name == "foremast_worker_fast_docs"
+        for s in fam.samples
+        if s.name.endswith("_total")
+    }
+    assert got.get("bivariate") == 1.0
+    assert got.get("lstm") == 1.0
+    assert got.get("univariate", 0) >= 1.0

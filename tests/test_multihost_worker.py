@@ -83,6 +83,10 @@ import os, sys, json
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 import jax
 jax.config.update("jax_platforms", "cpu")
+try:  # CPU multi-process collectives (older jax needs explicit gloo)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
 addr, pid = sys.argv[1], int(sys.argv[2])
 jax.distributed.initialize(addr, 2, pid)
 
@@ -247,6 +251,9 @@ import os, sys, json, time
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 import jax
 jax.config.update("jax_platforms", "cpu")
+# NO gloo collectives here: shared-nothing workers run WITHOUT
+# jax.distributed, and the gloo CPU client requires a distributed
+# runtime handle (it is only configured in the pod-mode children)
 sys.path.insert(0, {repo!r})
 url, wid, sync = sys.argv[1], sys.argv[2], sys.argv[3]
 
