@@ -24,6 +24,11 @@ bench-mixed:
 bench-plane:
 	$(PY) -m benchmarks.plane_bench
 
+# push-based ingest plane (ISSUE 5): warm RingSource vs
+# PrometheusSource-over-localhost on a 4k-doc fleet
+bench-ingest:
+	$(PY) -m benchmarks.ingest_bench
+
 native:
 	$(MAKE) -C native
 
@@ -51,4 +56,4 @@ clean:
 	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 
-.PHONY: test bench bench-suite bench-pipeline bench-mixed bench-plane native deploy-render check metrics-lint env-docs docker-build clean
+.PHONY: test bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest native deploy-render check metrics-lint env-docs docker-build clean

@@ -216,6 +216,32 @@ def _enable_compile_cache() -> None:
     )
 
 
+def _mount_ingest(inner, gauge_port: int):
+    """FOREMAST_INGEST=1: wrap the pull source in the push-plane
+    RingSource (docs/operations.md "Ingest plane") — warm fetches become
+    resident ring gathers, cold misses fall back to `inner` and are
+    backfilled so the next tick hits. Starts the remote-write receiver
+    (FOREMAST_INGEST_PORT; 0 = direct push/backfill only) and registers
+    the foremast_ingest_* families when a scrape port is live."""
+    from foremast_tpu.ingest import (
+        IngestCollector,
+        RingSource,
+        RingStore,
+        start_ingest_server,
+    )
+
+    ring = RingStore.from_env()
+    source = RingSource(ring, fallback=inner)
+    port = _env_int("FOREMAST_INGEST_PORT", 9009)
+    if port:
+        start_ingest_server(port, ring, book=source.book)
+    if gauge_port:
+        from prometheus_client import REGISTRY
+
+        REGISTRY.register(IngestCollector(ring, book=source.book))
+    return source
+
+
 def cmd_worker(args: argparse.Namespace) -> int:
     from foremast_tpu import native
     from foremast_tpu.config import BrainConfig
@@ -345,6 +371,11 @@ def cmd_worker(args: argparse.Namespace) -> int:
         gauges = BrainGauges()
         worker_metrics = WorkerMetrics()
         on_verdict = make_verdict_hook(gauges)
+    # push-based ingest plane (opt-in): the ring + receiver live where
+    # the fetches happen — the single worker, or the pod leader (the
+    # only process whose LeaderSource.inner is real; follower fetches
+    # stay leader-broadcast collectives, semantics unchanged)
+    ingest_on = os.environ.get("FOREMAST_INGEST", "0") == "1"
     if pod_mode:
         # One logical worker spanning the jax.distributed cluster: the
         # leader claims/fetches/writes, everything is broadcast, the
@@ -353,9 +384,12 @@ def cmd_worker(args: argparse.Namespace) -> int:
         # docs into one SPMD program (docs/operations.md runbook).
         from foremast_tpu.parallel import LeaderSource, LeaderStore, PodWorker
 
+        pod_inner = PrometheusSource() if store is not None else None
+        if ingest_on and pod_inner is not None:
+            pod_inner = _mount_ingest(pod_inner, args.gauge_port)
         worker = PodWorker(
             LeaderStore(store),
-            LeaderSource(PrometheusSource() if store is not None else None),
+            LeaderSource(pod_inner),
             config=config,
             judge=judge,
             claim_limit=args.claim_limit,
@@ -364,9 +398,12 @@ def cmd_worker(args: argparse.Namespace) -> int:
             tracer=tracer,
         )
     else:
+        single_source = PrometheusSource()
+        if ingest_on:
+            single_source = _mount_ingest(single_source, args.gauge_port)
         worker = BrainWorker(
             store,
-            PrometheusSource(),
+            single_source,
             config=config,
             judge=judge,
             claim_limit=args.claim_limit,

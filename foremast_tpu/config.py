@@ -525,6 +525,67 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "broadcasts the leader's value (engine.scoring.set_bf16_delta)",
     ),
     EnvKnob(
+        "FOREMAST_FETCH_RETRIES",
+        "2",
+        "int",
+        "transient-failure retries per metric fetch (HTTP 429/5xx and "
+        "connection errors), with exponential jittered backoff; `0` "
+        "restores fail-on-first-error. A retry budget is per URL, so a "
+        "doc's preprocess stage survives one flaky round trip instead "
+        "of failing the whole document",
+    ),
+    EnvKnob(
+        "FOREMAST_INGEST",
+        "0",
+        "bool",
+        "`1` mounts the push-based ingest plane (docs/operations.md "
+        "\"Ingest plane\"): a remote-write receiver feeding a sharded "
+        "in-memory ring TSDB, with the worker's fetches served from "
+        "resident series and falling back to Prometheus on cold miss",
+    ),
+    EnvKnob(
+        "FOREMAST_INGEST_PORT",
+        "9009",
+        "int",
+        "ingest receiver port (POST /api/v1/write, JSON remote-write "
+        "style); `0` disables the HTTP receiver — the ring then only "
+        "fills through backfill and the direct push API",
+    ),
+    EnvKnob(
+        "FOREMAST_INGEST_BUDGET_BYTES",
+        "268435456",
+        "int",
+        "resident-series byte budget for the ring TSDB (default "
+        "256 MB), split evenly across shards; past it, "
+        "least-recently-used series are evicted whole (they re-warm "
+        "via the cold-miss fallback). Sizing rule: 12 B/point at pow2 "
+        "capacities — a full 7-day 60 s history rounds to 16,384 "
+        "points ≈ 192 KB/series",
+    ),
+    EnvKnob(
+        "FOREMAST_INGEST_SHARDS",
+        "8",
+        "int",
+        "ring TSDB shard count — receiver push threads, tick fetches "
+        "and scrapes contend on 1/N of the keyspace per lock",
+    ),
+    EnvKnob(
+        "FOREMAST_INGEST_STALE_SECONDS",
+        "300",
+        "float",
+        "staleness watermark: a fetch is only served from the ring "
+        "when the newest resident sample is within this many seconds "
+        "of the requested window head — a dead pusher degrades to the "
+        "pull path instead of freezing verdicts",
+    ),
+    EnvKnob(
+        "FOREMAST_INGEST_MAX_POINTS",
+        "16384",
+        "int",
+        "per-series ring capacity ceiling (pow2-rounded); older "
+        "samples are overwritten past it",
+    ),
+    EnvKnob(
         "FOREMAST_MAX_GAUGE_FAMILIES",
         "512",
         "int",

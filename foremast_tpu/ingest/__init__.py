@@ -1,0 +1,47 @@
+"""Push-based metric ingest plane (ISSUE 5).
+
+The scrape-vs-remote-write inversion: instead of the worker HTTP-GETing
+every document's `query_range` URL from Prometheus each tick (the
+reference brain's shape, SURVEY §3.2 — and ~half of a cold tick's wall
+clock, BENCHMARKS.md round 6), pushers remote-write samples INTO the
+worker's resident ring TSDB and a warm fetch becomes an in-memory
+columnar gather — the same shape as serving an inference stack from a
+resident feature store instead of a remote database.
+
+Modules:
+    wire      push payload codec + query_range key resolution
+    ring      per-series pow2 (int64, float32) ring buffers
+    shards    sharded, byte-budgeted, LRU-evicting RingStore
+    backfill  cold-miss subscriptions + fallback-result backfill
+    source    RingSource(MetricSource) — what the worker mounts
+    receiver  HTTP push endpoint + foremast_ingest_* exposition
+
+Opt-in via `FOREMAST_INGEST=1` (docs/operations.md "Ingest plane").
+"""
+
+from foremast_tpu.ingest.backfill import SubscriptionBook, backfill
+from foremast_tpu.ingest.receiver import IngestCollector, start_ingest_server
+from foremast_tpu.ingest.ring import SeriesRing
+from foremast_tpu.ingest.shards import RingShard, RingStore
+from foremast_tpu.ingest.source import RingSource
+from foremast_tpu.ingest.wire import (
+    canonical_series,
+    parse_push,
+    resolve_query_range,
+    series_key,
+)
+
+__all__ = [
+    "IngestCollector",
+    "RingShard",
+    "RingSource",
+    "RingStore",
+    "SeriesRing",
+    "SubscriptionBook",
+    "backfill",
+    "canonical_series",
+    "parse_push",
+    "resolve_query_range",
+    "series_key",
+    "start_ingest_server",
+]

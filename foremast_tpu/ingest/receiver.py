@@ -1,0 +1,173 @@
+"""Remote-write receiver + Prometheus exposition of the ingest plane.
+
+`start_ingest_server` serves the push endpoint the way
+`observe.spans.start_observe_server` serves the scrape endpoint — a
+daemon-threaded `ThreadingHTTPServer`, so each pusher connection gets a
+handler thread and the sharded store's per-shard locks absorb the
+concurrency:
+
+    POST /api/v1/write   remote-write-style JSON (wire.parse_push);
+                         200 + {"accepted_samples", "series"} on
+                         success, 400 with the reason on a malformed
+                         payload — one bad entry rejects the batch so
+                         pushers notice instead of silently losing
+                         series
+    GET  /healthz        liveness + version
+    GET  /debug/state    the store's stats (series resident, bytes,
+                         evictions, hit ratio, receiver lag)
+
+`IngestCollector` exports the same stats as the `foremast_ingest_*`
+metric families (docs/observability.md) via a custom collector —
+counters and gauges are materialized from `RingStore.stats()` at scrape
+time, so the hot push/fetch paths never touch prometheus_client.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+
+from foremast_tpu.ingest.shards import RingStore
+from foremast_tpu.ingest.wire import WireError, parse_push
+
+log = logging.getLogger("foremast_tpu.ingest")
+
+WRITE_PATH = "/api/v1/write"
+
+
+class IngestCollector:
+    """prometheus_client custom collector over `RingStore.stats()`."""
+
+    def __init__(self, store: RingStore, book=None):
+        self._store = store
+        self._book = book
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        s = self._store.stats()
+        fetches = CounterMetricFamily(
+            "foremast_ingest_fetches",
+            "ring TSDB fetch outcomes (hit=resident slice served, "
+            "miss=series not resident, stale=pusher behind the window, "
+            "uncovered=resident but not authoritative back to start)",
+            labels=["result"],
+        )
+        for result, count_key in (
+            ("hit", "hits"),
+            ("miss", "misses"),
+            ("stale", "stale"),
+            ("uncovered", "uncovered"),
+        ):
+            fetches.add_metric([result], s[count_key])
+        yield fetches
+        yield CounterMetricFamily(
+            "foremast_ingest_samples",
+            "samples accepted by the ingest plane (receiver + direct push)",
+            value=s["samples"],
+        )
+        yield CounterMetricFamily(
+            "foremast_ingest_evictions",
+            "whole series evicted under FOREMAST_INGEST_BUDGET_BYTES",
+            value=s["evictions"],
+        )
+        yield GaugeMetricFamily(
+            "foremast_ingest_series_resident",
+            "series currently resident in the ring TSDB",
+            value=s["series"],
+        )
+        yield GaugeMetricFamily(
+            "foremast_ingest_bytes_resident",
+            "column bytes currently allocated by resident series",
+            value=s["bytes"],
+        )
+        lag = s.get("receiver_lag_seconds")
+        yield GaugeMetricFamily(
+            "foremast_ingest_receiver_lag_seconds",
+            "now minus the newest sample timestamp of the latest push "
+            "(-1 until the first push arrives)",
+            value=-1.0 if lag is None else lag,
+        )
+
+
+def start_ingest_server(
+    port: int,
+    store: RingStore,
+    host: str = "0.0.0.0",
+    book=None,
+):
+    """Serve the push plane; returns (server, thread). Port 0 binds an
+    ephemeral port (tests) — read it back from server.server_address."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # push traffic must not spam stderr
+            pass
+
+        def _send(self, code: int, body: bytes, ctype: str = "application/json"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            path = self.path.split("?", 1)[0]
+            if path != WRITE_PATH:
+                self._send(404, b'{"reason": "not found"}')
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0") or 0)
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                entries = parse_push(payload)
+            # TypeError/KeyError/AttributeError backstop: a payload
+            # shape the codec's explicit checks missed must still be a
+            # 400 to the pusher, never a dropped handler thread
+            except (WireError, ValueError, TypeError, KeyError,
+                    AttributeError) as e:
+                self._send(
+                    400, json.dumps({"reason": str(e)}).encode()
+                )
+                return
+            accepted = 0
+            for key, ts, vs, start in entries:
+                accepted += store.push(key, ts, vs, start=start)
+            self._send(
+                200,
+                json.dumps(
+                    {"accepted_samples": accepted, "series": len(entries)}
+                ).encode(),
+            )
+
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            if path == "/healthz":
+                from foremast_tpu import __version__
+
+                self._send(
+                    200,
+                    json.dumps(
+                        {"ok": True, "version": __version__}
+                    ).encode(),
+                )
+            elif path == "/debug/state":
+                state = store.stats()
+                if book is not None:
+                    state["subscriptions"] = book.snapshot()
+                self._send(
+                    200, json.dumps(state, default=str, indent=2).encode()
+                )
+            else:
+                self._send(404, b'{"reason": "not found"}')
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(
+        target=srv.serve_forever, name="foremast-ingest", daemon=True
+    )
+    thread.start()
+    log.info("ingest receiver listening on :%d%s", srv.server_address[1], WRITE_PATH)
+    return srv, thread

@@ -1,0 +1,235 @@
+"""Sharded, byte-budgeted resident-series store: the ring TSDB proper.
+
+`RingStore` spreads series over N `RingShard`s by key hash (crc32 —
+deterministic across processes, unlike Python's randomized `hash`).
+Each shard owns one `threading.Lock` guarding its series map, its LRU
+order, and its byte account, so the receiver's push threads, the
+worker's tick-thread fetches, and the varz scrape handler contend on
+1/N of the keyspace instead of one global lock — the same reasoning as
+the per-thread Sessions in `PrometheusSource`.
+
+Budget + eviction: `FOREMAST_INGEST_BUDGET_BYTES` divides evenly across
+shards; when a push overflows a shard's slice, least-recently-USED
+series (queries refresh recency, not just pushes) are dropped whole —
+an evicted-but-subscribed series re-warms through the source's
+cold-miss fallback on its next fetch, so eviction degrades to the pull
+path rather than to wrong answers. A shard never evicts its last
+resident series: one series larger than the slice must not thrash.
+
+Staleness: a query whose window reaches `min(end, now)` is only a hit
+when the newest resident sample is within `stale_seconds` of it — a
+pusher that died must degrade to the pull path, not freeze every
+verdict at its last pushed value.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import zlib
+from collections import OrderedDict
+
+import numpy as np
+
+from foremast_tpu.ingest.ring import (
+    DEFAULT_MAX_POINTS,
+    SeriesRing,
+    empty_series as _empty,
+)
+from foremast_tpu.ingest.wire import canonical_series
+
+DEFAULT_BUDGET_BYTES = 268_435_456  # 256 MB
+DEFAULT_SHARDS = 8
+DEFAULT_STALE_SECONDS = 300.0
+
+_COUNT_KEYS = ("hits", "misses", "stale", "uncovered", "samples", "evictions")
+
+
+class RingShard:
+    """One lock's worth of series. All state behind `_lock`; the
+    SeriesRing objects inside are only touched while holding it."""
+
+    def __init__(self, budget_bytes: int, max_points: int):
+        self.budget_bytes = int(budget_bytes)
+        self.max_points = int(max_points)
+        self._lock = threading.Lock()
+        self._series: OrderedDict[str, SeriesRing] = OrderedDict()
+        self._bytes = 0
+        self._counts = dict.fromkeys(_COUNT_KEYS, 0)
+
+    def push(
+        self,
+        key: str,
+        times,
+        values,
+        start: float | None = None,
+        end: float | None = None,
+        slack: float = 0.0,
+    ) -> int:
+        with self._lock:
+            ring = self._series.get(key)
+            prev = 0
+            if ring is None:
+                ring = SeriesRing(max_points=self.max_points)
+                self._series[key] = ring
+            else:
+                prev = ring.nbytes
+            n = ring.append(times, values, start=start, end=end, slack=slack)
+            self._bytes += ring.nbytes - prev
+            self._series.move_to_end(key)
+            self._counts["samples"] += n
+            while self._bytes > self.budget_bytes and len(self._series) > 1:
+                _, old = self._series.popitem(last=False)
+                self._bytes -= old.nbytes
+                self._counts["evictions"] += 1
+            return n
+
+    def query(
+        self,
+        key: str,
+        t0: float | None,
+        t1: float | None,
+        now: float,
+        step: float,
+        stale_seconds: float,
+    ) -> tuple[str, np.ndarray, np.ndarray]:
+        """(status, times, values); status "hit" | "miss" (not resident)
+        | "uncovered" (the window reaches outside the ring's contiguous
+        authoritative interval — including the gap between two disjoint
+        fetched windows) | "stale" (coverage head too far behind the
+        window head: pusher dead or backfill aged out)."""
+        with self._lock:
+            ring = self._series.get(key)
+            if ring is None:
+                self._counts["misses"] += 1
+                return ("miss",) + _empty()
+            self._series.move_to_end(key)  # queries refresh LRU recency
+            if ring.covered_from is None or ring.covered_to is None or (
+                t0 is not None and ring.covered_from > t0 + step
+            ):
+                self._counts["uncovered"] += 1
+                return ("uncovered",) + _empty()
+            head = now if t1 is None else min(t1, now)
+            if ring.covered_to < head - stale_seconds or (
+                # a window starting past the coverage head has ZERO
+                # overlap with what the ring can vouch for — an "empty
+                # hit" there would hide samples the pull path has
+                t0 is not None
+                and ring.covered_to < t0 - step
+            ):
+                self._counts["stale"] += 1
+                return ("stale",) + _empty()
+            self._counts["hits"] += 1
+            return ("hit",) + ring.window(t0, t1)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "series": len(self._series),
+                "bytes": self._bytes,
+                **self._counts,
+            }
+
+
+class RingStore:
+    """The sharded ring TSDB: push/query/stats over canonical series
+    keys (`wire.canonical_series` — push and query sides agree on label
+    order by construction)."""
+
+    def __init__(
+        self,
+        budget_bytes: int = DEFAULT_BUDGET_BYTES,
+        shards: int = DEFAULT_SHARDS,
+        stale_seconds: float = DEFAULT_STALE_SECONDS,
+        max_points: int = DEFAULT_MAX_POINTS,
+    ):
+        shards = max(1, int(shards))
+        self.budget_bytes = int(budget_bytes)
+        self.stale_seconds = float(stale_seconds)
+        self.max_points = int(max_points)
+        self._shards = tuple(
+            RingShard(max(self.budget_bytes // shards, 1), self.max_points)
+            for _ in range(shards)
+        )
+        self._lock = threading.Lock()
+        self._lag = {"receiver_lag_seconds": None, "last_push_at": None}
+
+    @staticmethod
+    def from_env(env=None) -> "RingStore":
+        e = os.environ if env is None else env
+        return RingStore(
+            budget_bytes=int(
+                e.get("FOREMAST_INGEST_BUDGET_BYTES", "")
+                or DEFAULT_BUDGET_BYTES
+            ),
+            shards=int(e.get("FOREMAST_INGEST_SHARDS", "") or DEFAULT_SHARDS),
+            stale_seconds=float(
+                e.get("FOREMAST_INGEST_STALE_SECONDS", "")
+                or DEFAULT_STALE_SECONDS
+            ),
+            max_points=int(
+                e.get("FOREMAST_INGEST_MAX_POINTS", "") or DEFAULT_MAX_POINTS
+            ),
+        )
+
+    def _shard(self, key: str) -> RingShard:
+        return self._shards[zlib.crc32(key.encode()) % len(self._shards)]
+
+    def push(
+        self,
+        alias: str,
+        times,
+        values,
+        start: float | None = None,
+        end: float | None = None,
+        now: float | None = None,
+        record_lag: bool = True,
+    ) -> int:
+        """Direct push API (the receiver, backfill, and tests all land
+        here). `alias` is the series identity — a bare selector in any
+        label order, or an opaque expression string. `start`/`end`
+        bound the batch's authoritative window (backfill); plain pushes
+        cover their own sample span, with gaps up to the staleness
+        cutoff treated as contiguous. `record_lag=False` keeps a
+        backfill of old history from reporting as receiver lag."""
+        key = canonical_series(alias)
+        n = self._shard(key).push(
+            key, times, values, start=start, end=end,
+            slack=self.stale_seconds,
+        )
+        if n and record_lag:
+            now = time.time() if now is None else now
+            newest = float(np.max(np.asarray(times, np.int64)))
+            with self._lock:
+                self._lag["receiver_lag_seconds"] = max(0.0, now - newest)
+                self._lag["last_push_at"] = now
+        return n
+
+    def query(
+        self,
+        key: str,
+        t0: float | None,
+        t1: float | None,
+        now: float,
+        step: float = 60.0,
+    ) -> tuple[str, np.ndarray, np.ndarray]:
+        return self._shard(key).query(
+            key, t0, t1, now, step, self.stale_seconds
+        )
+
+    def stats(self) -> dict:
+        out = {"series": 0, "bytes": 0}
+        out.update(dict.fromkeys(_COUNT_KEYS, 0))
+        for shard in self._shards:
+            for k, v in shard.stats().items():
+                out[k] += v
+        out["shards"] = len(self._shards)
+        out["budget_bytes"] = self.budget_bytes
+        looked = (
+            out["hits"] + out["misses"] + out["stale"] + out["uncovered"]
+        )
+        out["hit_ratio"] = round(out["hits"] / looked, 4) if looked else None
+        with self._lock:
+            out.update(self._lag)
+        return out

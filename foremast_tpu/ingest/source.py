@@ -1,0 +1,82 @@
+"""`RingSource` — the worker-facing adapter of the ingest plane.
+
+A `MetricSource` whose warm path is a resident ring-slice gather: the
+worker's slow and fast tick paths only ever see the `MetricSource`
+interface, so pull/push parity is structural — the same `_fetch_tasks`
+/ `_fast_tick` code runs either way, only `fetch()`'s cost changes.
+`concurrent_fetch` DELEGATES to the wrapped fallback: a warm fetch is
+an in-memory gather, but the miss/stale path is the fallback's real
+blocking I/O — a fleet-cold first tick (or a dead-pusher tick) must
+fan 16k HTTP round trips over the fetch pool, not serialize them on
+the tick thread. Pure-push mode (no fallback) declares False like the
+other in-memory sources.
+
+Miss handling (see `backfill`): unresolvable URLs bypass the ring;
+resolvable misses are recorded in the subscription book, served by the
+wrapped fallback source (the real `PrometheusSource` in production),
+and backfilled so the next tick hits. With no fallback the source is
+pure-push: a miss returns the empty series and the brain yields
+UNKNOWN, never a crash.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from foremast_tpu.ingest.backfill import SubscriptionBook, backfill
+from foremast_tpu.ingest.ring import empty_series as _empty
+from foremast_tpu.ingest.shards import RingStore
+from foremast_tpu.ingest.wire import resolve_query_range
+from foremast_tpu.metrics.source import MetricSource, Series
+
+log = logging.getLogger("foremast_tpu.ingest")
+
+
+class RingSource(MetricSource):
+    def __init__(
+        self,
+        ring: RingStore,
+        fallback: MetricSource | None = None,
+        clock=time.time,
+    ):
+        self.ring = ring
+        self.fallback = fallback
+        self.book = SubscriptionBook()
+        self._clock = clock
+
+    @property
+    def concurrent_fetch(self) -> bool:
+        # see module docstring: the miss path is the fallback's I/O
+        return bool(
+            self.fallback is not None
+            and getattr(self.fallback, "concurrent_fetch", True)
+        )
+
+    def fetch(self, url: str) -> Series:
+        key, t0, t1, step = resolve_query_range(url)
+        if key is None:
+            # no recognizable series identity: never warmable, straight
+            # through to the wrapped source
+            if self.fallback is None:
+                return _empty()
+            return self.fallback.fetch(url)
+        now = self._clock()
+        status, ts, vs = self.ring.query(key, t0, t1, now, step=step)
+        if status == "hit":
+            return ts, vs
+        self.book.record(key, url, status)
+        if self.fallback is None:
+            return ts, vs  # pure-push mode: empty series => UNKNOWN
+        series = self.fallback.fetch(url)
+        head = now if t1 is None else min(t1, now)
+        backfill(self.ring, key, series, start=t0, end=head, now=now)
+        return series
+
+    def ingest_debug_state(self) -> dict:
+        """The worker `/debug/state` `ingest` section (duck-typed hook:
+        `BrainWorker.debug_state` includes any source exposing this)."""
+        state = self.ring.stats()
+        state["subscriptions"] = self.book.snapshot()
+        state["fallback"] = type(self.fallback).__name__ if self.fallback else None
+        return state

@@ -1,0 +1,219 @@
+"""Ingest wire format: push payloads and query_range key resolution.
+
+The ingest plane inverts the reference's scrape direction (SURVEY §3.2:
+the brain HTTP-GETs every document's `query_range` URL from Prometheus
+each tick). Pushers — a vmagent-style forwarder, a recording-rule
+evaluator, or the tests' direct `RingStore.push` — send samples keyed
+by the SAME series identity the documents' query strings carry, so a
+warm fetch is a dictionary gather instead of an HTTP round trip.
+
+Two codecs live here, both pure functions with no locking or I/O:
+
+  * ``parse_push`` — the receiver's remote-write-style JSON body:
+    ``{"timeseries": [...]}`` where each entry carries either Prometheus
+    remote-write shaped ``labels`` + ``samples`` pairs, or the direct
+    ``alias``/``times``/``values`` arrays. Timestamps are unix SECONDS
+    (the judgment plane's resolution; the 60 s recording-rule step makes
+    sub-second precision meaningless here).
+  * ``resolve_query_range`` — a document's datasource URL → the ring
+    key plus the requested (start, end, step) window. Handles both URL
+    shapes the brain fetches (Prometheus ``query_range?query=...`` per
+    `prometheushelper.go:12-27` and the wavefront ``&&`` encoding per
+    `wavefronthelper.go:20-29`).
+
+Series identity: ``canonical_series`` normalizes a bare PromQL selector
+(`name{a="1",b="2"}`) by sorting its label matchers, so a push built
+from a labels map and a query string written in any label order land on
+the same ring slot. Non-selector expressions (wrapped in functions)
+pass through verbatim — pushers for those use the alias form with the
+exact expression text.
+"""
+
+from __future__ import annotations
+
+import re
+import urllib.parse
+
+import numpy as np
+
+from foremast_tpu.metrics.source import Series  # noqa: F401 — shared alias
+
+_SELECTOR_RE = re.compile(
+    r"^\s*([a-zA-Z_:][a-zA-Z0-9_:]*)\s*(?:\{(.*)\})?\s*$", re.DOTALL
+)
+_MATCHER_RE = re.compile(
+    r'\s*([a-zA-Z_][a-zA-Z0-9_]*)\s*(=~|!=|!~|=)\s*"((?:[^"\\]|\\.)*)"\s*(?:,|$)'
+)
+
+
+def canonical_series(expr: str) -> str:
+    """Label-order-independent form of a bare PromQL selector; any
+    expression that is not a plain ``name{matchers}`` selector is
+    returned stripped-but-verbatim (pushers then use the exact text)."""
+    m = _SELECTOR_RE.match(expr or "")
+    if m is None:
+        return (expr or "").strip()
+    name, body = m.group(1), m.group(2)
+    if body is None or not body.strip():
+        return name
+    matchers = []
+    pos = 0
+    for mm in _MATCHER_RE.finditer(body):
+        if mm.start() != pos:
+            return expr.strip()  # unparsed residue: not a bare selector
+        matchers.append((mm.group(1), mm.group(2), mm.group(3)))
+        pos = mm.end()
+    if pos != len(body):
+        return expr.strip()
+    matchers.sort()
+    inner = ",".join(f'{k}{op}"{v}"' for k, op, v in matchers)
+    return f"{name}{{{inner}}}"
+
+
+def series_key(labels: dict) -> str:
+    """Ring key for a labels map (`__name__` + sorted matchers) — the
+    push-side mirror of `canonical_series` on the query side. Label
+    values are rendered in PromQL's escaped form (backslash and quote),
+    matching the escaped text a query selector carries — an unescaped
+    render would let a value containing `","` inject fake matchers and
+    collide with a different series' key."""
+    name = str(labels.get("__name__", ""))
+    rest = sorted(
+        (str(k), str(v)) for k, v in labels.items() if k != "__name__"
+    )
+    if not rest:
+        return name
+    inner = ",".join(
+        '{}="{}"'.format(k, v.replace("\\", "\\\\").replace('"', '\\"'))
+        for k, v in rest
+    )
+    return f"{name}{{{inner}}}"
+
+
+class WireError(ValueError):
+    """Malformed push payload (the receiver answers 400 with the text)."""
+
+
+def _entry_series(entry: dict) -> tuple[np.ndarray, np.ndarray]:
+    if "samples" in entry:
+        samples = entry["samples"]
+        ts = np.asarray([s[0] for s in samples], np.int64)
+        vs = np.asarray([s[1] for s in samples], np.float32)
+    elif "times" in entry and "values" in entry:
+        ts = np.asarray(entry["times"], np.int64)
+        vs = np.asarray(entry["values"], np.float32)
+    else:
+        raise WireError(
+            "timeseries entry needs `samples` or `times`+`values`"
+        )
+    if ts.ndim != 1 or vs.ndim != 1 or len(ts) != len(vs):
+        raise WireError("times/values must be equal-length 1-d arrays")
+    return ts, vs
+
+
+def parse_push(body) -> list[tuple[str, np.ndarray, np.ndarray, float | None]]:
+    """Decode one push payload into ``(key, times, values, start)``
+    tuples. `start` is the entry's optional coverage watermark: a
+    backfill-style push may assert "there is no data before start" so
+    queries reaching back to it count as covered."""
+    if not isinstance(body, dict):
+        raise WireError("push body must be a JSON object")
+    series = body.get("timeseries")
+    if not isinstance(series, list):
+        raise WireError("push body needs a `timeseries` list")
+    out = []
+    for entry in series:
+        if not isinstance(entry, dict):
+            raise WireError("timeseries entries must be objects")
+        labels = entry.get("labels")
+        if labels is not None:
+            if isinstance(labels, list):  # proto-JSON [{name,value}] shape
+                if not all(
+                    isinstance(lb, dict) and "name" in lb and "value" in lb
+                    for lb in labels
+                ):
+                    raise WireError(
+                        "label list entries must be {name, value} objects"
+                    )
+                labels = {
+                    str(lb["name"]): str(lb["value"]) for lb in labels
+                }
+            if not isinstance(labels, dict) or not labels.get("__name__"):
+                raise WireError("labels need a `__name__`")
+            key = series_key(labels)
+        else:
+            alias = entry.get("alias") or entry.get("series")
+            if not alias:
+                raise WireError(
+                    "timeseries entry needs `labels` or `alias`"
+                )
+            key = canonical_series(str(alias))
+        try:
+            ts, vs = _entry_series(entry)
+        except WireError:
+            raise
+        except (TypeError, ValueError, IndexError, KeyError) as e:
+            raise WireError(f"bad samples for {key!r}: {e}") from None
+        start = entry.get("start")
+        if start is not None:
+            try:
+                start = float(start)
+            except (TypeError, ValueError):
+                raise WireError(
+                    f"bad `start` for {key!r}: {start!r}"
+                ) from None
+        out.append((key, ts, vs, start))
+    return out
+
+
+def _qs_float(qs: dict, name: str) -> float | None:
+    raw = qs.get(name, [None])[0]
+    if raw in (None, ""):
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        from foremast_tpu.jobs.store import parse_time
+
+        t = parse_time(raw)  # Prometheus accepts RFC3339 too
+        return t if t > 0 else None
+
+
+def resolve_query_range(
+    url: str,
+) -> tuple[str | None, float | None, float | None, float]:
+    """Document URL → ``(key, start, end, step)``; key None when the URL
+    carries no recognizable query (the source then bypasses the ring).
+    Both the Prometheus `query_range?query=...&start=&end=&step=` shape
+    and the wavefront `<query>&&<start>&&<unit>&&<end>` shape resolve."""
+    if "&&" in url and "query_range" not in url:
+        parts = url.split("&&")
+        if len(parts) >= 4:
+            key = canonical_series(urllib.parse.unquote(parts[0]))
+
+            def _f(raw):
+                try:
+                    return float(raw)
+                except ValueError:
+                    return None
+
+            # the inverse of promql.wavefront_url's granularity map
+            step = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}.get(
+                parts[2], 60.0
+            )
+            return key or None, _f(parts[1]), _f(parts[3]), step
+        return None, None, None, 60.0
+    try:
+        qs = urllib.parse.parse_qs(urllib.parse.urlparse(url).query)
+    except ValueError:
+        return None, None, None, 60.0
+    raw_q = qs.get("query", [None])[0] or qs.get("q", [None])[0]
+    if not raw_q:
+        return None, None, None, 60.0
+    step = _qs_float(qs, "step") or 60.0
+    return (
+        canonical_series(raw_q),
+        _qs_float(qs, "start"),
+        _qs_float(qs, "end"),
+        step,
+    )

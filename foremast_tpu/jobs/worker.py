@@ -1550,6 +1550,20 @@ class BrainWorker:
         joint_arena = None
         if self._mvj is not None:
             joint_arena = self._mvj.joint_state_counters()
+        # push-based ingest plane (duck-typed: any source exposing
+        # ingest_debug_state — RingSource directly, or wrapped inside a
+        # pod-mode LeaderSource via .inner)
+        ingest_fn = getattr(self.source, "ingest_debug_state", None)
+        if ingest_fn is None:
+            ingest_fn = getattr(
+                getattr(self.source, "inner", None),
+                "ingest_debug_state",
+                None,
+            )
+        try:
+            ingest = ingest_fn() if ingest_fn is not None else None
+        except Exception:  # noqa: BLE001 - varz must not depend on ingest
+            ingest = None
         state = {
             "worker_id": self.worker_id,
             "version": __version__,
@@ -1568,6 +1582,10 @@ class BrainWorker:
             # LSTM-AE params + residual-MVN state); None when the judge
             # has no joint dispatch
             "joint_arena": joint_arena,
+            # push-based ingest plane (FOREMAST_INGEST=1): series
+            # resident, bytes, evictions, hit ratio, receiver lag,
+            # subscriptions; None when the worker runs pure-pull
+            "ingest": ingest,
             # cumulative columnar-path docs per model kind — joint kinds
             # > 0 is the observable proof multi-alias docs ride the fast
             # path (ISSUE 4 acceptance)

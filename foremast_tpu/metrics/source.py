@@ -17,8 +17,12 @@ All return (times: int64[N], values: float32[N]) numpy arrays.
 from __future__ import annotations
 
 import csv
+import functools
 import math
+import os
+import random
 import threading
+import time
 from datetime import datetime, timezone
 from typing import Callable, Mapping
 
@@ -41,6 +45,30 @@ class MetricSource:
         raise NotImplementedError
 
 
+# HTTP statuses worth retrying: throttling and transient server-side
+# failures; 4xx configuration errors (bad query) fail immediately
+RETRY_STATUSES = frozenset({429, 500, 502, 503, 504})
+
+
+@functools.lru_cache(maxsize=1)
+def _transient_exceptions() -> tuple:
+    """The retryable exception types, computed once per process:
+    builtin ConnectionError/TimeoutError cover injected test sessions;
+    the requests types (which do NOT subclass them) are added when
+    requests is importable."""
+    excs: tuple = (ConnectionError, TimeoutError)
+    try:
+        import requests
+
+        excs += (
+            requests.exceptions.ConnectionError,
+            requests.exceptions.Timeout,
+        )
+    except ImportError:
+        pass
+    return excs
+
+
 class PrometheusSource(MetricSource):
     """Fetches query_range URLs; merges a multi-series result by summing
     values per timestamp (recording rules normally return one series).
@@ -49,12 +77,28 @@ class PrometheusSource(MetricSource):
     and requests.Session is not safe for concurrent use (cookie jar /
     redirect state), so each thread gets its own Session. An explicitly
     injected `session` (tests) is used as-is.
+
+    Transient failures (HTTP 429/5xx, connection/timeout errors) are
+    retried up to `FOREMAST_FETCH_RETRIES` times (default 2) with
+    exponential jittered backoff — a single flaky round trip must not
+    fail the whole document's preprocess stage. Non-transient errors
+    (4xx, parse errors) still raise on the first attempt.
     """
 
-    def __init__(self, session=None, timeout: float = 10.0):
+    def __init__(
+        self,
+        session=None,
+        timeout: float = 10.0,
+        retries: int | None = None,
+        backoff_seconds: float = 0.25,
+    ):
         self._injected = session
         self._local = threading.local()
         self.timeout = timeout
+        if retries is None:
+            retries = int(os.environ.get("FOREMAST_FETCH_RETRIES", "") or 2)
+        self.retries = max(0, retries)
+        self.backoff_seconds = backoff_seconds
 
     @property
     def _session(self):
@@ -67,8 +111,30 @@ class PrometheusSource(MetricSource):
             sess = self._local.session = requests.Session()
         return sess
 
+    def _get_with_retries(self, url: str):
+        transient = _transient_exceptions()
+        for attempt in range(self.retries + 1):
+            last = attempt == self.retries
+            try:
+                resp = self._session.get(url, timeout=self.timeout)
+            except transient:
+                if last:
+                    raise
+            else:
+                if resp.status_code not in RETRY_STATUSES or last:
+                    return resp
+            # bounded jittered exponential backoff: 0.5-1x of
+            # base * 2^attempt, so a thundering herd of claim fetches
+            # doesn't re-synchronize on the throttling server
+            time.sleep(
+                self.backoff_seconds
+                * (2**attempt)
+                * (0.5 + 0.5 * random.random())
+            )
+        raise AssertionError("unreachable")  # pragma: no cover
+
     def fetch(self, url: str) -> Series:
-        resp = self._session.get(url, timeout=self.timeout)
+        resp = self._get_with_retries(url)
         resp.raise_for_status()
         body = resp.json()
         if body.get("status") != "success":
@@ -97,7 +163,18 @@ class PrometheusSource(MetricSource):
 
 def load_csv_trace(path: str, t0: int | None = None, step: int = 60) -> Series:
     """Load a `timestamp,value` or `value`-per-line CSV trace (the demo's
-    data1/data2 format: `YYYY-MM-DD HH:MM:SS,value`)."""
+    data1/data2 format: `YYYY-MM-DD HH:MM:SS,value`).
+
+    Tolerant of real-world exports: an empty file yields the empty
+    series (the brain then judges UNKNOWN, not a crash), and
+    timestamped rows are STABLY sorted — an unsorted export would
+    otherwise produce an out-of-order window that breaks every
+    step-inference and gap-anchoring consumer downstream. Duplicate
+    timestamps are kept (stable: file order within a timestamp run):
+    the demo's replay traces record several observations per coarse
+    5-min stamp, and collapsing them would starve the min-points gates.
+    Synthetic timelines (`t0` given, or value-only rows) are generated
+    in order and skip the sort."""
     ts: list[int] = []
     vs: list[float] = []
     with open(path) as f:
@@ -120,10 +197,15 @@ def load_csv_trace(path: str, t0: int | None = None, step: int = 60) -> Series:
                 ts.append(t)
                 vs.append(float(row[1]))
     times = np.asarray(ts, np.int64)
-    if t0 is not None or (times == 0).all():
+    values = np.asarray(vs, np.float32)
+    if t0 is not None or (len(times) and (times == 0).all()):
         base = 0 if t0 is None else t0
-        times = base + step * np.arange(len(vs), dtype=np.int64)
-    return times, np.asarray(vs, np.float32)
+        return base + step * np.arange(len(vs), dtype=np.int64), values
+    if len(times) > 1 and not (np.diff(times) >= 0).all():
+        order = np.argsort(times, kind="stable")
+        times = times[order]
+        values = values[order]
+    return times, values
 
 
 class ReplaySource(MetricSource):

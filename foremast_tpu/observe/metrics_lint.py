@@ -37,6 +37,13 @@ ALLOWED_LABELS: dict[str, frozenset[str]] = {
     "foremast_service_requests": frozenset({"route", "code"}),
     "foremast_controller_transitions": frozenset({"phase"}),
     "foremastbrain_gauge_families_dropped": frozenset(),
+    # ingest plane (foremast_tpu/ingest/receiver.py IngestCollector)
+    "foremast_ingest_fetches": frozenset({"result"}),
+    "foremast_ingest_samples": frozenset(),
+    "foremast_ingest_evictions": frozenset(),
+    "foremast_ingest_series_resident": frozenset(),
+    "foremast_ingest_bytes_resident": frozenset(),
+    "foremast_ingest_receiver_lag_seconds": frozenset(),
 }
 
 
@@ -106,6 +113,14 @@ def default_registry_families():
         ("phase",),
         registry,
     ).labels(phase="Healthy").inc()
+    # ingest plane: exercise every outcome so each label value appears
+    from foremast_tpu.ingest import IngestCollector, RingStore
+
+    ring = RingStore(budget_bytes=1 << 20, shards=1)
+    ring.push("lint_series", [60, 120], [1.0, 2.0], start=0.0, now=180.0)
+    ring.query("lint_series", 0.0, 120.0, now=180.0)  # hit
+    ring.query("lint_absent", 0.0, 120.0, now=180.0)  # miss
+    registry.register(IngestCollector(ring))
     return registry
 
 

@@ -150,6 +150,25 @@ def test_worker_bench_mixed_fleet_small():
     assert out["warm_windows_per_sec"] > 0
 
 
+def test_ingest_bench_small_smoke(capsys):
+    """`make bench-ingest --small` smoke (ISSUE 5): warm RingSource vs
+    PrometheusSource-over-localhost on the same fleet — judgments must
+    be byte-identical (asserted inside run()), the push worker's ticks
+    must issue ZERO Prometheus HTTP requests, and the fetch stage must
+    get faster (the >= 5x acceptance bar is checked at full benchmark
+    shapes, not CI smoke shapes)."""
+    import benchmarks.ingest_bench as ingest_bench
+
+    ingest_bench.main(["--small"])
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["config"] == "i-ingest-warm-fetch"
+    assert line["equivalent"] is True
+    assert line["zero_http_warm_tick"] is True
+    assert line["ring_hit_ratio"] == 1.0
+    assert line["series_resident"] == line["windows"]
+    assert line["value"] and line["value"] > 1.0
+
+
 def test_plane_bench_small_smoke():
     """Watch-plane scale benchmark (VERDICT r5 #7) at CI shapes: the
     informer resync and the controller poll tick must run and stay

@@ -1,0 +1,136 @@
+"""Metric-source robustness (ISSUE 5 satellites): transient-failure
+retries in PrometheusSource, and CSV-trace tolerance (empty files,
+unsorted/duplicated timestamps)."""
+
+import numpy as np
+import pytest
+
+from foremast_tpu.metrics.source import (
+    PrometheusSource,
+    ReplaySource,
+    load_csv_trace,
+)
+
+_OK_BODY = {
+    "status": "success",
+    "data": {"result": [{"values": [[100, "1.0"], [160, "2.0"]]}]},
+}
+
+
+class _FlakySession:
+    """Fails the first `failures` GETs (exception or status), then 200."""
+
+    def __init__(self, failures, mode="conn"):
+        self.failures = failures
+        self.mode = mode
+        self.calls = 0
+
+    def get(self, url, timeout=None):
+        self.calls += 1
+        if self.calls <= self.failures:
+            if self.mode == "conn":
+                raise ConnectionError("refused")
+            return _Resp(self.mode)
+        return _Resp(200)
+
+
+class _Resp:
+    def __init__(self, status):
+        self.status_code = status
+
+    def raise_for_status(self):
+        if self.status_code >= 400:
+            raise RuntimeError(f"http {self.status_code}")
+
+    def json(self):
+        return _OK_BODY
+
+
+@pytest.mark.parametrize("mode", ["conn", 503, 429])
+def test_prometheus_source_retries_transient_failures(mode):
+    sess = _FlakySession(2, mode=mode)
+    src = PrometheusSource(session=sess, retries=2, backoff_seconds=0.001)
+    ts, vs = src.fetch("http://p/q")
+    assert sess.calls == 3
+    assert ts.tolist() == [100, 160]
+
+
+def test_prometheus_source_exhausted_retries_raise():
+    sess = _FlakySession(10, mode="conn")
+    src = PrometheusSource(session=sess, retries=2, backoff_seconds=0.001)
+    with pytest.raises(ConnectionError):
+        src.fetch("http://p/q")
+    assert sess.calls == 3  # 1 try + 2 retries, bounded
+
+
+def test_prometheus_source_does_not_retry_config_errors():
+    """4xx (bad query) is not transient: fail on the first attempt."""
+    sess = _FlakySession(10, mode=404)
+    src = PrometheusSource(session=sess, retries=3, backoff_seconds=0.001)
+    with pytest.raises(RuntimeError):
+        src.fetch("http://p/q")
+    assert sess.calls == 1
+
+
+def test_prometheus_source_zero_retries_restores_fail_fast():
+    sess = _FlakySession(1, mode="conn")
+    src = PrometheusSource(session=sess, retries=0)
+    with pytest.raises(ConnectionError):
+        src.fetch("http://p/q")
+    assert sess.calls == 1
+
+
+def test_prometheus_source_reads_retry_knob(monkeypatch):
+    monkeypatch.setenv("FOREMAST_FETCH_RETRIES", "5")
+    assert PrometheusSource().retries == 5
+    monkeypatch.delenv("FOREMAST_FETCH_RETRIES")
+    assert PrometheusSource().retries == 2  # registry default
+
+
+# ---------------------------------------------------------------------------
+# CSV traces
+# ---------------------------------------------------------------------------
+
+
+def test_load_csv_trace_empty_file(tmp_path):
+    p = tmp_path / "empty.csv"
+    p.write_text("")
+    ts, vs = load_csv_trace(str(p))
+    assert len(ts) == 0 and len(vs) == 0
+    assert ts.dtype == np.int64 and vs.dtype == np.float32
+
+
+def test_load_csv_trace_sorts_stably_keeping_duplicates(tmp_path):
+    p = tmp_path / "unsorted.csv"
+    # out of order + duplicate timestamps: sorted, file order preserved
+    # within a timestamp run, NO samples dropped (the demo replay traces
+    # record several observations per coarse 5-min stamp — collapsing
+    # them would starve the min-points gates)
+    p.write_text("300,3.0\n100,1.0\n300,9.0\n200,2.0\n")
+    ts, vs = load_csv_trace(str(p))
+    assert ts.tolist() == [100, 200, 300, 300]
+    assert vs.tolist() == [1.0, 2.0, 3.0, 9.0]
+
+
+def test_load_csv_trace_sorted_input_unchanged(tmp_path):
+    p = tmp_path / "sorted.csv"
+    p.write_text("100,1.0\n200,2.0\n300,3.0\n")
+    ts, vs = load_csv_trace(str(p))
+    assert ts.tolist() == [100, 200, 300]
+    assert vs.tolist() == [1.0, 2.0, 3.0]
+
+
+def test_load_csv_trace_value_only_rows_keep_synthetic_timeline(tmp_path):
+    p = tmp_path / "values.csv"
+    p.write_text("1.0\n1.0\n2.0\n")  # repeated values must NOT be deduped
+    ts, vs = load_csv_trace(str(p), step=60)
+    assert ts.tolist() == [0, 60, 120]
+    assert vs.tolist() == [1.0, 1.0, 2.0]
+
+
+def test_replay_source_tolerates_empty_csv(tmp_path):
+    p = tmp_path / "empty.csv"
+    p.write_text("")
+    src = ReplaySource().register_csv("q=latency", str(p))
+    ts, vs = src.fetch("http://prom/api?q=latency")
+    assert len(ts) == 0 and len(vs) == 0
