@@ -29,6 +29,12 @@ bench-plane:
 bench-ingest:
 	$(PY) -m benchmarks.ingest_bench
 
+# worker-mesh scale-out (ISSUE 6): 1 vs 4 REAL worker processes
+# sharding a 64k-service fleet over one HTTP store, with in-run
+# exactly-once + kill/rebalance assertions
+bench-scaleout:
+	$(PY) -m benchmarks.scaleout_bench
+
 native:
 	$(MAKE) -C native
 
@@ -56,4 +62,4 @@ clean:
 	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 
-.PHONY: test bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest native deploy-render check metrics-lint env-docs docker-build clean
+.PHONY: test bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest bench-scaleout native deploy-render check metrics-lint env-docs docker-build clean

@@ -216,13 +216,18 @@ def _enable_compile_cache() -> None:
     )
 
 
-def _mount_ingest(inner, gauge_port: int):
+def _mount_ingest(inner, gauge_port: int, router=None):
     """FOREMAST_INGEST=1: wrap the pull source in the push-plane
     RingSource (docs/operations.md "Ingest plane") — warm fetches become
     resident ring gathers, cold misses fall back to `inner` and are
     backfilled so the next tick hits. Starts the remote-write receiver
-    (FOREMAST_INGEST_PORT; 0 = direct push/backfill only) and registers
-    the foremast_ingest_* families when a scrape port is live."""
+    (FOREMAST_INGEST_PORT; 0 = direct push/backfill only; port 0 taken
+    literally means ephemeral in mesh mode, where every co-hosted
+    worker needs its own receiver) and registers the foremast_ingest_*
+    families when a scrape port is live. `router` (mesh mode) makes the
+    receiver answer pushes for series another member owns with that
+    member's advertised address. Returns (source, ring, receiver or
+    None)."""
     from foremast_tpu.ingest import (
         IngestCollector,
         RingSource,
@@ -233,13 +238,16 @@ def _mount_ingest(inner, gauge_port: int):
     ring = RingStore.from_env()
     source = RingSource(ring, fallback=inner)
     port = _env_int("FOREMAST_INGEST_PORT", 9009)
-    if port:
-        start_ingest_server(port, ring, book=source.book)
+    srv = None
+    if port or router is not None:
+        srv, _ = start_ingest_server(
+            port, ring, book=source.book, router=router
+        )
     if gauge_port:
         from prometheus_client import REGISTRY
 
         REGISTRY.register(IngestCollector(ring, book=source.book))
-    return source
+    return source, ring, srv
 
 
 def cmd_worker(args: argparse.Namespace) -> int:
@@ -376,6 +384,22 @@ def cmd_worker(args: argparse.Namespace) -> int:
     # only process whose LeaderSource.inner is real; follower fetches
     # stay leader-broadcast collectives, semantics unchanged)
     ingest_on = os.environ.get("FOREMAST_INGEST", "0") == "1"
+    # worker mesh (opt-in): this worker takes a membership lease in the
+    # job store and claims only its consistent-hash partition of the
+    # fleet (docs/operations.md "Worker mesh"). Pod mode is already ONE
+    # logical worker spanning processes — mesh partitioning happens
+    # BETWEEN pods/workers, so a pod's followers never see it and a
+    # leader could in principle join; wiring that is future work.
+    mesh_on = os.environ.get("FOREMAST_MESH", "0") == "1"
+    mesh_node = None
+    ingest_srv = None
+    if mesh_on and pod_mode:
+        print(
+            "FOREMAST_MESH=1 ignored in pod mode (mesh shards fleets "
+            "across independent workers; a pod is one logical worker)",
+            file=sys.stderr,
+        )
+        mesh_on = False
     if pod_mode:
         # One logical worker spanning the jax.distributed cluster: the
         # leader claims/fetches/writes, everything is broadcast, the
@@ -386,7 +410,9 @@ def cmd_worker(args: argparse.Namespace) -> int:
 
         pod_inner = PrometheusSource() if store is not None else None
         if ingest_on and pod_inner is not None:
-            pod_inner = _mount_ingest(pod_inner, args.gauge_port)
+            pod_inner, _pod_ring, ingest_srv = _mount_ingest(
+                pod_inner, args.gauge_port
+            )
         worker = PodWorker(
             LeaderStore(store),
             LeaderSource(pod_inner),
@@ -398,25 +424,82 @@ def cmd_worker(args: argparse.Namespace) -> int:
             tracer=tracer,
         )
     else:
+        # mesh identity is minted HERE so the membership record and the
+        # claim's processing_content stamp agree on one worker id
+        import uuid as _uuid
+
+        worker_id = f"brain-{_uuid.uuid4().hex[:8]}"
+        membership = router = None
+        if mesh_on:
+            from foremast_tpu.mesh import Membership, MeshRouter
+
+            membership = Membership(
+                store,
+                worker_id,
+                lease_seconds=float(
+                    os.environ.get("FOREMAST_MESH_LEASE_SECONDS", "")
+                    or "15"
+                ),
+            )
+            router = MeshRouter(
+                membership,
+                replicas=_env_int("FOREMAST_MESH_REPLICAS", 64),
+                route_label=(
+                    os.environ.get("FOREMAST_MESH_ROUTE_LABEL", "") or "app"
+                ),
+            )
         single_source = PrometheusSource()
+        single_ring = None
         if ingest_on:
-            single_source = _mount_ingest(single_source, args.gauge_port)
+            single_source, single_ring, ingest_srv = _mount_ingest(
+                single_source, args.gauge_port, router=router
+            )
+        if mesh_on:
+            from foremast_tpu.mesh import MeshNode
+
+            if ingest_srv is not None:
+                # advertise where pushers can actually reach the
+                # receiver: FOREMAST_MESH_ADVERTISE (host or host:port)
+                # wins, the bound port fills any gap
+                import socket as _socket
+
+                adv = os.environ.get("FOREMAST_MESH_ADVERTISE") or ""
+                adv_host, _, adv_port = adv.partition(":")
+                membership.ingest_address = "{}:{}".format(
+                    adv_host or _socket.gethostname(),
+                    adv_port or ingest_srv.server_address[1],
+                )
+            mesh_node = MeshNode(membership, router, ring_store=single_ring)
+            mesh_node.start()
         worker = BrainWorker(
             store,
             single_source,
             config=config,
             judge=judge,
+            worker_id=worker_id,
             claim_limit=args.claim_limit,
             on_verdict=on_verdict,
             metrics=worker_metrics,
             tracer=tracer,
+            mesh=mesh_node,
         )
     if args.gauge_port and leader:
         # /metrics + /healthz + /debug/state on the scrape port (the
-        # reference exposed /metrics only)
-        start_observe_server(
-            args.gauge_port, state_fn=worker.debug_state
+        # reference exposed /metrics only). Auto-increment past a busy
+        # port: co-hosted mesh workers must not fight over :8000 — the
+        # actual port lands in the member record below.
+        obs_srv, _ = start_observe_server(
+            args.gauge_port,
+            state_fn=worker.debug_state,
+            max_port_tries=32,
         )
+        if mesh_node is not None:
+            from foremast_tpu.mesh import MeshCollector
+            from prometheus_client import REGISTRY as _REG
+
+            _REG.register(MeshCollector(mesh_node))
+            mesh_node.membership.observe_port = obs_srv.server_address[1]
+            mesh_node.membership.renew(force=True)
 
     after_tick = None
     if ckpt_path:
@@ -472,6 +555,26 @@ def cmd_worker(args: argparse.Namespace) -> int:
             logging.getLogger("foremast_tpu.cli").warning(
                 "worker pool shutdown failed: %s", e
             )
+        if mesh_node is not None:
+            # leave FIRST: peers drop this member (and start claiming
+            # its partition) without waiting out the lease
+            try:
+                mesh_node.close()
+            except Exception as e:  # noqa: BLE001 — cleanup must not mask
+                logging.getLogger("foremast_tpu.cli").warning(
+                    "mesh leave failed: %s", e
+                )
+        if ingest_srv is not None:
+            # bounded drain: in-flight pushes finish (or are abandoned
+            # as daemon threads), the listen port frees immediately
+            try:
+                from foremast_tpu.ingest import stop_ingest_server
+
+                stop_ingest_server(ingest_srv)
+            except Exception as e:  # noqa: BLE001 — cleanup must not mask
+                logging.getLogger("foremast_tpu.cli").warning(
+                    "ingest receiver shutdown failed: %s", e
+                )
         ckpt_error = None
         if ckpt_path and len(judge.cache):
             try:

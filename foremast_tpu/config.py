@@ -586,6 +586,65 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "samples are overwritten past it",
     ),
     EnvKnob(
+        "FOREMAST_INGEST_MAX_BODY_BYTES",
+        "8388608",
+        "int",
+        "ingest receiver request-body cap (default 8 MiB): pushes "
+        "whose Content-Length exceeds it answer 413 before any byte "
+        "is buffered or parsed, so one oversized pusher cannot wedge "
+        "a handler thread or balloon the heap",
+    ),
+    EnvKnob(
+        "FOREMAST_MESH",
+        "0",
+        "bool",
+        "`1` joins the worker mesh (docs/operations.md \"Worker "
+        "mesh\"): this worker registers a membership lease in the job "
+        "store, claims only the fleet partition a consistent-hash "
+        "ring assigns it, and (with `FOREMAST_INGEST=1`) answers "
+        "pushes for series another member owns with that member's "
+        "advertised receiver address",
+    ),
+    EnvKnob(
+        "FOREMAST_MESH_LEASE_SECONDS",
+        "15",
+        "float",
+        "membership lease: renewed every third of this, and a member "
+        "whose record is older than this (by the reader's clock) is "
+        "treated as dead — the ring heals around it and its in-flight "
+        "claims age out via MAX_STUCK_IN_SECONDS takeover. Keep it "
+        "comfortably above the tick poll interval and below the stuck "
+        "window",
+    ),
+    EnvKnob(
+        "FOREMAST_MESH_REPLICAS",
+        "64",
+        "int",
+        "consistent-hash virtual nodes per unit of member capacity — "
+        "higher evens out partition sizes at the cost of ring-build "
+        "time on rebalance (64 keeps the largest/smallest partition "
+        "spread under ~20% at 4 members)",
+    ),
+    EnvKnob(
+        "FOREMAST_MESH_ROUTE_LABEL",
+        "app",
+        "str",
+        "the series label whose value is the partition identity: a "
+        "pushed series carrying it hashes to the SAME member as the "
+        "documents of that application (doc route key = appName). "
+        "Series without the label hash by whole canonical key and may "
+        "land off-worker — their fetches degrade to the cold-miss "
+        "fallback, never to wrong answers",
+    ),
+    EnvKnob(
+        "FOREMAST_MESH_ADVERTISE",
+        None,
+        "str",
+        "host (or host:port) peers and pushers should use to reach "
+        "this worker's ingest receiver; default advertises the local "
+        "hostname with the receiver's actual bound port",
+    ),
+    EnvKnob(
         "FOREMAST_MAX_GAUGE_FAMILIES",
         "512",
         "int",

@@ -742,8 +742,15 @@ class HealthJudge:
             min_points=jnp.full((rows_b,), cfg.min_historical_points, jnp.int32),
         )
         batch = self._place(batch)
+        # Fast-path admission guarantees NO baselines, and an empty
+        # baseline gates every rank test off — (p=1, differs=False) is
+        # the hardwired outcome. PAIRWISE_NONE compiles the judgment
+        # without the tests at all (byte-identical verdicts): at fleet
+        # batch sizes their argsorts dominate the warm program's memory
+        # traffic — the cost that capped co-hosted mesh workers in
+        # benchmarks/scaleout_bench.py.
         pw = dict(
-            pairwise_algorithm=cfg.pairwise.algorithm,
+            pairwise_algorithm=scoring.PAIRWISE_NONE,
             p_threshold=cfg.pairwise.threshold,
             min_mw=cfg.pairwise.min_mann_white_points,
             min_wilcoxon=cfg.pairwise.min_wilcoxon_points,

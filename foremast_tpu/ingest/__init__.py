@@ -20,7 +20,11 @@ Opt-in via `FOREMAST_INGEST=1` (docs/operations.md "Ingest plane").
 """
 
 from foremast_tpu.ingest.backfill import SubscriptionBook, backfill
-from foremast_tpu.ingest.receiver import IngestCollector, start_ingest_server
+from foremast_tpu.ingest.receiver import (
+    IngestCollector,
+    start_ingest_server,
+    stop_ingest_server,
+)
 from foremast_tpu.ingest.ring import SeriesRing
 from foremast_tpu.ingest.shards import RingShard, RingStore
 from foremast_tpu.ingest.source import RingSource
@@ -44,4 +48,5 @@ __all__ = [
     "resolve_query_range",
     "series_key",
     "start_ingest_server",
+    "stop_ingest_server",
 ]

@@ -8,13 +8,25 @@ concurrency:
 
     POST /api/v1/write   remote-write-style JSON (wire.parse_push);
                          200 + {"accepted_samples", "series"} on
-                         success, 400 with the reason on a malformed
-                         payload — one bad entry rejects the batch so
-                         pushers notice instead of silently losing
-                         series
+                         success (plus a "redirects" {key: address}
+                         map when a mesh router marks series another
+                         member owns — samples are still accepted, so
+                         the convergence window loses nothing), 400
+                         with the reason on a malformed payload — one
+                         bad entry rejects the batch so pushers notice
+                         instead of silently losing series — and 413
+                         when the body exceeds the byte cap
+                         (`FOREMAST_INGEST_MAX_BODY_BYTES`)
     GET  /healthz        liveness + version
     GET  /debug/state    the store's stats (series resident, bytes,
                          evictions, hit ratio, receiver lag)
+
+Hardening: handler threads are daemons with a per-connection socket
+timeout, request bodies are size-capped BEFORE json.loads (an
+oversized push answers 413 without buffering the payload), and
+`stop_ingest_server` gives the worker's close path a bounded drain —
+stop accepting, wait for in-flight handlers up to a deadline, then
+abandon them to their daemon fate instead of wedging shutdown.
 
 `IngestCollector` exports the same stats as the `foremast_ingest_*`
 metric families (docs/observability.md) via a custom collector —
@@ -26,7 +38,9 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
+import time
 
 from foremast_tpu.ingest.shards import RingStore
 from foremast_tpu.ingest.wire import WireError, parse_push
@@ -34,6 +48,10 @@ from foremast_tpu.ingest.wire import WireError, parse_push
 log = logging.getLogger("foremast_tpu.ingest")
 
 WRITE_PATH = "/api/v1/write"
+DEFAULT_MAX_BODY_BYTES = 8 * 1024 * 1024
+# a handler stuck mid-read (pusher died with the body half-sent) frees
+# its thread after this instead of holding it forever
+HANDLER_TIMEOUT_SECONDS = 30.0
 
 
 class IngestCollector:
@@ -99,12 +117,36 @@ def start_ingest_server(
     store: RingStore,
     host: str = "0.0.0.0",
     book=None,
+    router=None,
+    max_body_bytes: int | None = None,
 ):
     """Serve the push plane; returns (server, thread). Port 0 binds an
-    ephemeral port (tests) — read it back from server.server_address."""
+    ephemeral port (tests) — read it back from server.server_address.
+
+    `router` (mesh.routing.MeshRouter, optional): pushes for series
+    another mesh member owns are accepted into the local ring (lossless
+    during convergence, LRU reclaims them) AND answered with the
+    owner's advertised address in the response's `redirects` map, so a
+    mesh-aware pusher lands on the right shard from its next cycle.
+
+    `max_body_bytes` caps request bodies (413 past it); None reads
+    `FOREMAST_INGEST_MAX_BODY_BYTES` (default 8 MiB)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+    if max_body_bytes is None:
+        max_body_bytes = int(
+            os.environ.get("FOREMAST_INGEST_MAX_BODY_BYTES", "")
+            or DEFAULT_MAX_BODY_BYTES
+        )
+    cap = int(max_body_bytes)
+    inflight = _Inflight()
+
     class Handler(BaseHTTPRequestHandler):
+        # a half-sent body must free its daemon thread, not hold it
+        # until process exit (StreamRequestHandler applies this to the
+        # connection socket)
+        timeout = HANDLER_TIMEOUT_SECONDS
+
         def log_message(self, *a):  # push traffic must not spam stderr
             pass
 
@@ -116,13 +158,34 @@ def start_ingest_server(
             self.wfile.write(body)
 
         def do_POST(self):
+            with inflight:
+                self._post()
+
+        def _post(self):
             path = self.path.split("?", 1)[0]
             if path != WRITE_PATH:
                 self._send(404, b'{"reason": "not found"}')
                 return
+            length = int(self.headers.get("Content-Length", "0") or 0)
+            if length > cap:
+                # reject BEFORE buffering: an oversized push must not
+                # make this thread read (or json-parse) the whole body
+                self._send(
+                    413,
+                    json.dumps(
+                        {
+                            "reason": f"body {length} bytes exceeds "
+                            f"cap {cap}"
+                        }
+                    ).encode(),
+                )
+                return
             try:
-                length = int(self.headers.get("Content-Length", "0") or 0)
-                payload = json.loads(self.rfile.read(length) or b"{}")
+                raw = self.rfile.read(length)
+            except OSError:
+                return  # pusher died mid-body; nothing to answer
+            try:
+                payload = json.loads(raw or b"{}")
                 entries = parse_push(payload)
             # TypeError/KeyError/AttributeError backstop: a payload
             # shape the codec's explicit checks missed must still be a
@@ -134,16 +197,23 @@ def start_ingest_server(
                 )
                 return
             accepted = 0
+            redirects: dict[str, str] = {}
             for key, ts, vs, start in entries:
+                if router is not None:
+                    hint = router.redirect_hint(key)
+                    if hint is not None:
+                        redirects[key] = hint
                 accepted += store.push(key, ts, vs, start=start)
-            self._send(
-                200,
-                json.dumps(
-                    {"accepted_samples": accepted, "series": len(entries)}
-                ).encode(),
-            )
+            body = {"accepted_samples": accepted, "series": len(entries)}
+            if redirects:
+                body["redirects"] = redirects
+            self._send(200, json.dumps(body).encode())
 
         def do_GET(self):
+            with inflight:
+                self._get()
+
+        def _get(self):
             path = self.path.split("?", 1)[0]
             if path == "/healthz":
                 from foremast_tpu import __version__
@@ -165,9 +235,59 @@ def start_ingest_server(
                 self._send(404, b'{"reason": "not found"}')
 
     srv = ThreadingHTTPServer((host, port), Handler)
+    # handler threads must never block interpreter exit (the wedge a
+    # mid-shutdown push used to cause), and server_close must not join
+    # them — stop_ingest_server does the bounded drain instead
+    srv.daemon_threads = True
+    srv.block_on_close = False
+    srv._foremast_inflight = inflight  # stop_ingest_server reads this
     thread = threading.Thread(
         target=srv.serve_forever, name="foremast-ingest", daemon=True
     )
     thread.start()
     log.info("ingest receiver listening on :%d%s", srv.server_address[1], WRITE_PATH)
     return srv, thread
+
+
+class _Inflight:
+    """Context-managed handler counter the drain path polls."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def __enter__(self):
+        with self._lock:
+            self._count += 1
+        return self
+
+    def __exit__(self, *exc):
+        with self._lock:
+            self._count -= 1
+        return False
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+
+def stop_ingest_server(srv, drain_seconds: float = 5.0) -> bool:
+    """Graceful receiver shutdown: stop accepting, drain in-flight
+    handlers up to `drain_seconds`, then abandon stragglers (they are
+    daemon threads with socket timeouts — they cannot wedge the
+    process). Returns True when the drain completed clean."""
+    srv.shutdown()  # stop serve_forever; no new connections accepted
+    srv.server_close()  # release the listen socket (port reusable now)
+    inflight = getattr(srv, "_foremast_inflight", None)
+    deadline = time.monotonic() + drain_seconds
+    while inflight is not None and inflight.count > 0:
+        if time.monotonic() >= deadline:
+            log.warning(
+                "ingest receiver drain timed out with %d handler(s) "
+                "in flight; abandoning them (daemon threads)",
+                inflight.count,
+            )
+            return False
+        time.sleep(0.02)
+    return True

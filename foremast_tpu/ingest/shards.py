@@ -123,6 +123,19 @@ class RingShard:
             self._counts["hits"] += 1
             return ("hit",) + ring.window(t0, t1)
 
+    def evict_unowned(self, owns) -> int:
+        """Drop every resident series the predicate disowns — the mesh
+        rebalance hook (a healed ring moved these keys to another
+        member; keeping their columns would spend this worker's budget
+        on series it will never be asked for again)."""
+        with self._lock:
+            doomed = [k for k in self._series if not owns(k)]
+            for k in doomed:
+                old = self._series.pop(k)
+                self._bytes -= old.nbytes
+                self._counts["evictions"] += 1
+            return len(doomed)
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -217,6 +230,11 @@ class RingStore:
         return self._shard(key).query(
             key, t0, t1, now, step, self.stale_seconds
         )
+
+    def evict_unowned(self, owns) -> int:
+        """Drop resident series `owns(key)` rejects (mesh rebalance);
+        returns how many were evicted across all shards."""
+        return sum(s.evict_unowned(owns) for s in self._shards)
 
     def stats(self) -> dict:
         out = {"series": 0, "bytes": 0}

@@ -34,6 +34,12 @@ log = logging.getLogger("foremast_tpu.ingest")
 
 
 class RingSource(MetricSource):
+    # url -> (key, t0, t1, step) memo bound: a fleet's URL set is
+    # stable (two per document per alias), so this is effectively
+    # "decode each URL once per process"; the crude clear-on-overflow
+    # matches the worker's admission-cache discipline
+    RESOLVE_CACHE_MAX = 1_048_576
+
     def __init__(
         self,
         ring: RingStore,
@@ -44,6 +50,13 @@ class RingSource(MetricSource):
         self.fallback = fallback
         self.book = SubscriptionBook()
         self._clock = clock
+        # Warm fetches are the per-tick hot loop (one per window per
+        # tick at fleet scale) and `resolve_query_range` — urlparse +
+        # parse_qs + selector canonicalization — costs ~25-35 µs, an
+        # order of magnitude more than the ring gather it guards.
+        # Document URLs are immutable per doc id, so the resolution is
+        # memoized: a warm fetch is a dict hit + ring slice.
+        self._resolved: dict[str, tuple] = {}
 
     @property
     def concurrent_fetch(self) -> bool:
@@ -54,7 +67,13 @@ class RingSource(MetricSource):
         )
 
     def fetch(self, url: str) -> Series:
-        key, t0, t1, step = resolve_query_range(url)
+        resolved = self._resolved.get(url)
+        if resolved is None:
+            if len(self._resolved) > self.RESOLVE_CACHE_MAX:
+                self._resolved.clear()  # crude bound; repopulates
+            resolved = resolve_query_range(url)
+            self._resolved[url] = resolved
+        key, t0, t1, step = resolved
         if key is None:
             # no recognizable series identity: never warmable, straight
             # through to the wrapped source

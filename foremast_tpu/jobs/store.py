@@ -78,11 +78,21 @@ class JobStore:
         raise NotImplementedError
 
     def claim(
-        self, worker_id: str, max_stuck_seconds: float, limit: int = 64
+        self,
+        worker_id: str,
+        max_stuck_seconds: float,
+        limit: int = 64,
+        claim_filter=None,
     ) -> list[Document]:
         """Atomically take up to `limit` claimable docs: status==initial or
         preprocess_completed (re-check loop), or in-progress but stuck
-        longer than max_stuck_seconds (work stealing)."""
+        longer than max_stuck_seconds (work stealing).
+
+        `claim_filter` (doc -> bool, optional) restricts WHICH claimable
+        docs this worker takes — the mesh partition predicate
+        (mesh/node.py). It must run BEFORE the status flip: a filtered
+        doc stays claimable for its owner, it is never parked
+        in-progress by a worker that won't judge it."""
         raise NotImplementedError
 
     def update(self, doc: Document) -> Document:
@@ -104,6 +114,14 @@ class JobStore:
         count (ES `_count`) override so liveness probes don't page full
         documents (and aren't capped by list_open's fetch size)."""
         return len(self.list_open())
+
+    def list_app(self, app_name: str) -> list[Document]:
+        """Every open document of one app — mesh membership discovery
+        (`mesh/membership.py` member records all share one app name).
+        Default filters list_open(); stores with server-side term
+        queries override, because at fleet scale the list_open page can
+        starve out a handful of member records."""
+        return [d for d in self.list_open() if d.app_name == app_name]
 
 
 def _is_claimable(doc: Document, now: float, max_stuck: float) -> bool:
@@ -135,7 +153,13 @@ class InMemoryStore(JobStore):
         with self._lock:
             return self._docs.get(doc_id)
 
-    def claim(self, worker_id: str, max_stuck_seconds: float, limit: int = 64):
+    def claim(
+        self,
+        worker_id: str,
+        max_stuck_seconds: float,
+        limit: int = 64,
+        claim_filter=None,
+    ):
         now = time.time()
         stamp = now_rfc3339()  # one strftime per claim, not per doc
         out = []
@@ -143,7 +167,13 @@ class InMemoryStore(JobStore):
             for doc in self._docs.values():
                 if len(out) >= limit:
                     break
-                if _is_claimable(doc, now, max_stuck_seconds):
+                # claimability FIRST (cheap), partition filter second —
+                # matching the ES path's search-then-filter order, so
+                # the mesh claim counters mean the same thing on both
+                # stores and terminal docs never pay the ring hash
+                if not _is_claimable(doc, now, max_stuck_seconds):
+                    continue
+                if claim_filter is None or claim_filter(doc):
                     # flip to in-progress inside the lock so a concurrent
                     # claimer sees the doc as taken (not claimable again
                     # until the stuck timeout)
@@ -169,6 +199,18 @@ class InMemoryStore(JobStore):
     def list_open(self):
         with self._lock:
             return [d for d in self._docs.values() if d.status not in TERMINAL_STATUSES]
+
+    def list_app(self, app_name: str) -> list[Document]:
+        # one filtered pass — the base class materializes list_open()
+        # first, which at fleet scale builds a 64k-entry list to find a
+        # handful of mesh member records, on every router refresh
+        with self._lock:
+            return [
+                d
+                for d in self._docs.values()
+                if d.app_name == app_name
+                and d.status not in TERMINAL_STATUSES
+            ]
 
 
 # Explicit mapping for the `documents` index. The claim query depends on
@@ -400,7 +442,13 @@ class ElasticsearchStore(JobStore):
             return None
         return Document.from_json(body["_source"])
 
-    def claim(self, worker_id: str, max_stuck_seconds: float, limit: int = 64):
+    def claim(
+        self,
+        worker_id: str,
+        max_stuck_seconds: float,
+        limit: int = 64,
+        claim_filter=None,
+    ):
         """Claim up to `limit` docs in exactly TWO round trips.
 
         (1) a server-side claimability search — fresh work (`initial` /
@@ -412,6 +460,12 @@ class ElasticsearchStore(JobStore):
         back 409 and are skipped. (The previous shape — match any
         claimable status, then one CAS PUT per hit — was O(limit) round
         trips and page-starvation-prone.)
+
+        `claim_filter` (mesh partitioning) applies CLIENT-SIDE between
+        the search and the bulk CAS — a hash-ring ownership test cannot
+        be expressed as an ES query. Filtered hits are simply not CASed,
+        so they stay claimable for their owner; mesh workers size
+        `limit` to the fleet, so one page still reaches every partition.
         """
         now = time.time()
         cutoff = datetime.fromtimestamp(
@@ -465,6 +519,10 @@ class ElasticsearchStore(JobStore):
         docs: list[Document] = []
         for h in hits:
             doc = Document.from_json(h["_source"])
+            # partition filter BEFORE the CAS: a foreign doc must stay
+            # claimable for its owner, not get parked in-progress here
+            if claim_filter is not None and not claim_filter(doc):
+                continue
             # defense in depth: the server answered claimability, but a
             # mapping/clock divergence must never double-claim
             if not _is_claimable(doc, now, max_stuck_seconds):
@@ -553,6 +611,32 @@ class ElasticsearchStore(JobStore):
 
     def list_open(self):
         query = {"size": 1000, "query": self._OPEN_QUERY}
+        r = self._s.post(self._url("_search"), json=query, timeout=self.timeout)
+        r.raise_for_status()
+        return [
+            Document.from_json(h["_source"])
+            for h in r.json().get("hits", {}).get("hits", [])
+        ]
+
+    def list_app(self, app_name: str) -> list[Document]:
+        # server-side term query: mesh member records must be findable
+        # regardless of how many fleet documents share the index (the
+        # base-class list_open page would starve them out at scale).
+        # Matches the base contract — OPEN documents only (the InMemory
+        # override filters terminal statuses too); the page bounds an
+        # app with pathologically many open docs, which membership (a
+        # handful of records under one app) never approaches.
+        query = {
+            "size": 1000,
+            "query": {
+                "bool": {
+                    "must": [{"terms": {"appName": [app_name]}}],
+                    "must_not": {
+                        "terms": {"status": list(TERMINAL_STATUSES)}
+                    },
+                }
+            },
+        }
         r = self._s.post(self._url("_search"), json=query, timeout=self.timeout)
         r.raise_for_status()
         return [

@@ -117,6 +117,7 @@ class BrainWorker:
         metrics=None,  # observe.gauges.WorkerMetrics (optional)
         band_mode: str = "last",
         tracer=None,  # observe.spans.Tracer (optional)
+        mesh=None,  # mesh.node.MeshNode (optional fleet partitioning)
     ):
         """`band_mode` controls how much of the model band each verdict
         carries back from the device: "last" (default — only the final
@@ -250,6 +251,11 @@ class BrainWorker:
         # parents to it via the ambient-context helper, so the engine
         # and store need no tracer plumbing. None = zero overhead.
         self.tracer = tracer
+        # Worker mesh (mesh/node.py): when set, every tick renews this
+        # worker's membership lease + refreshes its ownership ring, and
+        # the claim only takes documents in this worker's partition
+        # (claim-CAS stays the safety net against stale ring views).
+        self.mesh = mesh
         self._last_tick = {"at": 0.0, "docs": 0, "fast": 0, "seconds": 0.0}
         # last status logged per open job (pruned on terminal): open docs
         # are re-judged every poll, and re-asserting an unchanged status
@@ -1293,9 +1299,19 @@ class BrainWorker:
     def _tick(self, now: float | None = None) -> int:
         t0 = time.perf_counter()
         now = time.time() if now is None else now
+        claim_kw = {}
+        if self.mesh is not None:
+            # idle ticks renew too — the lease must outlive quiet
+            # fleets (lease/refresh timing runs on the mesh's own
+            # injectable clocks, not this tick's possibly-simulated now)
+            self.mesh.on_tick()
+            claim_kw["claim_filter"] = self.mesh.claim_filter
         with span("worker.claim", stage="claim", limit=self.claim_limit):
             docs = self.store.claim(
-                self.worker_id, self.config.max_stuck_seconds, self.claim_limit
+                self.worker_id,
+                self.config.max_stuck_seconds,
+                self.claim_limit,
+                **claim_kw,
             )
         if not docs:
             # idle cycles still did the claim round-trip (real store I/O)
@@ -1586,6 +1602,12 @@ class BrainWorker:
             # resident, bytes, evictions, hit ratio, receiver lag,
             # subscriptions; None when the worker runs pure-pull
             "ingest": ingest,
+            # worker mesh (FOREMAST_MESH=1): live members with their
+            # advertised addresses/ports, rebalance + redirect counters,
+            # claim partition traffic; None when unsharded
+            "mesh": (
+                self.mesh.debug_state() if self.mesh is not None else None
+            ),
             # cumulative columnar-path docs per model kind — joint kinds
             # > 0 is the observable proof multi-alias docs ride the fast
             # path (ISSUE 4 acceptance)

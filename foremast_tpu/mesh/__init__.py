@@ -1,0 +1,55 @@
+"""Worker mesh: consistent-hash fleet partitioning across N workers.
+
+Four planes (docs/operations.md "Worker mesh"):
+
+  * membership — heartbeat documents in the job store
+    (`mesh/membership.py`): join/renew/leave, dead peers detected by
+    lease expiry;
+  * partitioning — a consistent-hash ring over live members
+    (`mesh/partition.py`) assigns every document's route key one
+    owner; the worker's claim loop claims only its partition
+    (claim-CAS stays the double-judgment safety net);
+  * routed ingest — each worker runs its own receiver + ring shard;
+    pushes for series a worker does not own are accepted AND answered
+    with the owner's advertised address (`mesh/routing.py`), so
+    pushers converge within one push cycle;
+  * rebalance — a dead member's lease expires, the ring heals with
+    minimal movement, orphaned claims age out through the existing
+    stuck-claim CAS takeover, and newly-owned cold series backfill
+    through the fallback path.
+"""
+
+from foremast_tpu.mesh.membership import (
+    MESH_APP,
+    STATUS_MESH_LEFT,
+    STATUS_MESH_MEMBER,
+    MemberRecord,
+    Membership,
+    live_members,
+    member_doc_id,
+)
+from foremast_tpu.mesh.node import MeshCollector, MeshNode
+from foremast_tpu.mesh.partition import HashRing
+from foremast_tpu.mesh.routing import (
+    MeshRouter,
+    RoutingPusher,
+    doc_route_key,
+    series_route_key,
+)
+
+__all__ = [
+    "MESH_APP",
+    "STATUS_MESH_LEFT",
+    "STATUS_MESH_MEMBER",
+    "HashRing",
+    "MemberRecord",
+    "Membership",
+    "MeshCollector",
+    "MeshNode",
+    "MeshRouter",
+    "RoutingPusher",
+    "doc_route_key",
+    "live_members",
+    "member_doc_id",
+    "series_route_key",
+]

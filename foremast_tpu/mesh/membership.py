@@ -1,0 +1,203 @@
+"""Mesh membership: heartbeat documents in the job store.
+
+Workers register themselves as documents in the SAME store the fleet's
+jobs live in (id ``mesh::<worker_id>``, app ``__foremast_mesh__``) —
+the store is the one piece of shared infrastructure every worker
+already reaches, so membership needs no extra system (no etcd, no
+gossip). The record's status, ``mesh_member``, is outside every
+claimable/terminal set in jobs/models.py, so member docs are invisible
+to the claim query; discovery is a `list_app` on the mesh app name.
+
+Liveness is lease-based: a member stamps ``renewed_at`` (its own
+clock) into the record payload every ``lease_seconds / 3`` and peers
+treat a record whose stamp is older than ``lease_seconds`` (by the
+READER's clock) as dead. Clocks therefore need only coarse agreement —
+a skew much smaller than the lease, the same assumption the store's
+MAX_STUCK_IN_SECONDS takeover already makes about ``modified_at``.
+
+Dead-peer handling is deliberately lazy: an expired record simply
+stops counting toward `live_members`, the hash ring heals around it
+(mesh/partition.py minimal movement), and the dead worker's in-flight
+claims age out through the existing stuck-claim CAS takeover — the
+mesh adds no second fencing mechanism, claim-CAS remains the one
+safety net against double judgment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+
+from foremast_tpu.jobs.models import Document
+from foremast_tpu.jobs.store import JobStore
+
+log = logging.getLogger("foremast_tpu.mesh")
+
+# app_name shared by every member record — the `list_app` discovery key
+MESH_APP = "__foremast_mesh__"
+# outside CLAIMABLE/TERMINAL/INPROGRESS: never claimed, never counted
+# as a finished judgment
+STATUS_MESH_MEMBER = "mesh_member"
+# a clean leave: the record stays (stores here have no delete) but is
+# filtered out of membership regardless of lease freshness
+STATUS_MESH_LEFT = "mesh_left"
+
+DEFAULT_LEASE_SECONDS = 15.0
+
+
+def member_doc_id(worker_id: str) -> str:
+    return f"mesh::{worker_id}"
+
+
+@dataclasses.dataclass(frozen=True)
+class MemberRecord:
+    """One worker's advertisement: identity, addresses, share weight."""
+
+    worker_id: str
+    ingest_address: str = ""  # "host:port" of the push receiver ("" = none)
+    observe_port: int = 0  # the worker's actual /debug/state port
+    capacity: int = 1  # hash-ring share weight
+    lease_seconds: float = DEFAULT_LEASE_SECONDS
+    renewed_at: float = 0.0  # member's clock, unix seconds
+
+    def expired(self, now: float) -> bool:
+        return now - self.renewed_at > self.lease_seconds
+
+    def to_payload(self) -> str:
+        return json.dumps(
+            {
+                "workerId": self.worker_id,
+                "ingestAddress": self.ingest_address,
+                "observePort": self.observe_port,
+                "capacity": self.capacity,
+                "leaseSeconds": self.lease_seconds,
+                "renewedAt": self.renewed_at,
+            }
+        )
+
+    @staticmethod
+    def from_payload(raw: str) -> "MemberRecord | None":
+        try:
+            d = json.loads(raw)
+            return MemberRecord(
+                worker_id=str(d["workerId"]),
+                ingest_address=str(d.get("ingestAddress", "")),
+                observe_port=int(d.get("observePort", 0)),
+                capacity=max(1, int(d.get("capacity", 1))),
+                lease_seconds=float(
+                    d.get("leaseSeconds", DEFAULT_LEASE_SECONDS)
+                ),
+                renewed_at=float(d.get("renewedAt", 0.0)),
+            )
+        except (ValueError, TypeError, KeyError):
+            return None  # a corrupt record is a dead record, not a crash
+
+
+def live_members(
+    store: JobStore, now: float | None = None
+) -> list[MemberRecord]:
+    """Every member whose lease is fresh at `now` (reader's clock when
+    None), sorted by worker id. Standalone so store-side claim filters
+    (benchmarks) and the router share one definition of 'alive'."""
+    now = time.time() if now is None else now
+    out = []
+    for doc in store.list_app(MESH_APP):
+        if doc.status != STATUS_MESH_MEMBER:
+            continue
+        rec = MemberRecord.from_payload(doc.current_config)
+        if rec is not None and not rec.expired(now):
+            out.append(rec)
+    out.sort(key=lambda r: r.worker_id)
+    return out
+
+
+class Membership:
+    """This worker's own seat at the table: join / renew / leave.
+
+    `clock` is injectable for tests; renewals are rate-limited to
+    lease/3 so the per-tick `renew()` call is almost always a no-op
+    integer compare, not a store write."""
+
+    def __init__(
+        self,
+        store: JobStore,
+        worker_id: str,
+        lease_seconds: float = DEFAULT_LEASE_SECONDS,
+        ingest_address: str = "",
+        observe_port: int = 0,
+        capacity: int = 1,
+        clock=time.time,
+    ):
+        self.store = store
+        self.worker_id = worker_id
+        self.lease_seconds = float(lease_seconds)
+        self.ingest_address = ingest_address
+        self.observe_port = int(observe_port)
+        self.capacity = max(1, int(capacity))
+        self._clock = clock
+        self._doc: Document | None = None
+        self._last_renew = 0.0
+
+    def _record(self, now: float) -> MemberRecord:
+        return MemberRecord(
+            worker_id=self.worker_id,
+            ingest_address=self.ingest_address,
+            observe_port=self.observe_port,
+            capacity=self.capacity,
+            lease_seconds=self.lease_seconds,
+            renewed_at=now,
+        )
+
+    def join(self) -> MemberRecord:
+        now = self._clock()
+        rec = self._record(now)
+        doc = Document(
+            id=member_doc_id(self.worker_id),
+            app_name=MESH_APP,
+            status=STATUS_MESH_MEMBER,
+            processing_content=self.worker_id,
+            current_config=rec.to_payload(),
+        )
+        # idempotent create then unconditional update: a restart reusing
+        # a worker id simply re-takes its old seat with a fresh lease
+        self._doc, _ = self.store.create(doc)
+        self._doc.status = STATUS_MESH_MEMBER
+        self._doc.current_config = rec.to_payload()
+        self._doc = self.store.update(self._doc)
+        self._last_renew = now
+        log.info("mesh join: %s (lease %.1fs)", self.worker_id, self.lease_seconds)
+        return rec
+
+    def renew(self, force: bool = False) -> bool:
+        """Refresh the lease when a third of it has elapsed (or
+        `force`); returns whether a store write happened."""
+        if self._doc is None:
+            self.join()
+            return True
+        now = self._clock()
+        if not force and now - self._last_renew < self.lease_seconds / 3.0:
+            return False
+        self._doc.current_config = self._record(now).to_payload()
+        self._doc = self.store.update(self._doc)
+        self._last_renew = now
+        return True
+
+    def leave(self) -> None:
+        """Clean departure: the record flips to `mesh_left` so peers
+        drop this member immediately instead of waiting out the lease."""
+        if self._doc is None:
+            return
+        self._doc.status = STATUS_MESH_LEFT
+        try:
+            self.store.update(self._doc)
+        except Exception as e:  # noqa: BLE001 — leaving must never crash shutdown
+            log.warning("mesh leave failed for %s: %s", self.worker_id, e)
+        self._doc = None
+        log.info("mesh leave: %s", self.worker_id)
+
+    def live_members(self, now: float | None = None) -> list[MemberRecord]:
+        return live_members(
+            self.store, self._clock() if now is None else now
+        )

@@ -1,0 +1,162 @@
+"""`MeshNode` — one worker's seat in the mesh, wired into the tick.
+
+Composes the three mesh planes (membership heartbeat, ownership
+router, optional local ring shard) behind the tiny surface the worker
+loop consumes:
+
+  * ``claim_filter(doc)`` — the predicate `JobStore.claim` applies
+    BEFORE flipping a doc in-progress, so a worker only ever claims
+    its partition (claim-CAS stays the double-judgment safety net for
+    stale views);
+  * ``on_tick(now)`` — lease renew (rate-limited) + ring refresh; on a
+    membership change, series this worker no longer owns are evicted
+    from its ring shard so the freed budget serves the partition it
+    actually holds (newly-owned cold series backfill through the
+    existing fallback path — rebalance needs no data transfer);
+  * ``debug_state()`` — the worker `/debug/state` ``mesh`` section;
+  * ``close()`` — leave the mesh (peers drop this worker immediately
+    instead of waiting out the lease).
+
+`MeshCollector` exports the same counters as `foremast_mesh_*`
+families (docs/observability.md), materialized at scrape time like the
+ingest plane's collector — nothing on the tick path touches
+prometheus_client.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from foremast_tpu.mesh.membership import Membership
+from foremast_tpu.mesh.routing import MeshRouter
+
+log = logging.getLogger("foremast_tpu.mesh")
+
+
+class MeshNode:
+    def __init__(
+        self,
+        membership: Membership,
+        router: MeshRouter,
+        ring_store=None,  # ingest.shards.RingStore (optional)
+        clock=time.time,
+    ):
+        self.membership = membership
+        self.router = router
+        self.ring_store = ring_store
+        self._clock = clock
+        # claim-filter traffic: owned vs skipped docs seen by claims
+        self.claim_counts = {"owned": 0, "skipped": 0}
+        self._started = False
+
+    @property
+    def worker_id(self) -> str:
+        return self.membership.worker_id
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        self.membership.join()
+        self.router.refresh(force=True)
+        self._started = True
+
+    def close(self) -> None:
+        if self._started:
+            self.membership.leave()
+            self._started = False
+
+    # -- tick hooks -----------------------------------------------------
+
+    def on_tick(self) -> None:
+        """Called at the top of every worker tick (idle ones too — the
+        lease must outlive quiet fleets). Takes no simulated `now`:
+        lease and refresh timing run on the membership's/router's OWN
+        injectable clocks, so a test driving worker.tick(now=t) injects
+        clocks there instead of threading t through here (a parameter
+        that was accepted but ignored would make simulated-time tests
+        lie)."""
+        if not self._started:
+            self.start()
+            return
+        self.membership.renew()
+        if self.router.refresh() and self.ring_store is not None:
+            dropped = self.ring_store.evict_unowned(self.router.owns_series)
+            if dropped:
+                log.info(
+                    "mesh rebalance: evicted %d series no longer owned "
+                    "by %s", dropped, self.worker_id,
+                )
+
+    def claim_filter(self, doc) -> bool:
+        owned = self.router.owns_doc(doc)
+        self.claim_counts["owned" if owned else "skipped"] += 1
+        return owned
+
+    # -- observability --------------------------------------------------
+
+    def debug_state(self) -> dict:
+        members = self.router.members()
+        return {
+            "worker_id": self.worker_id,
+            "live_members": len(members),
+            "members": [
+                {
+                    "worker_id": m.worker_id,
+                    "ingest_address": m.ingest_address,
+                    "observe_port": m.observe_port,
+                    "capacity": m.capacity,
+                    "lease_seconds": m.lease_seconds,
+                    "lease_age_seconds": round(
+                        max(0.0, self._clock() - m.renewed_at), 2
+                    ),
+                }
+                for m in members
+            ],
+            "route_label": self.router.route_label,
+            "replicas": self.router.replicas,
+            "rebalances": self.router.counters["rebalances"],
+            "redirect_hints": self.router.counters["redirect_hints"],
+            "foreign_series": self.router.counters["foreign_series"],
+            "claim_docs": dict(self.claim_counts),
+        }
+
+
+class MeshCollector:
+    """prometheus_client custom collector over a `MeshNode`."""
+
+    def __init__(self, node: MeshNode):
+        self._node = node
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        node = self._node
+        yield GaugeMetricFamily(
+            "foremast_mesh_members",
+            "live mesh members (fresh leases, including this worker)",
+            value=len(node.router.members()),
+        )
+        yield CounterMetricFamily(
+            "foremast_mesh_rebalances",
+            "hash-ring swaps after membership changes",
+            value=node.router.counters["rebalances"],
+        )
+        yield CounterMetricFamily(
+            "foremast_mesh_redirect_hints",
+            "receiver responses carrying an owning-member address for a "
+            "series this worker does not own",
+            value=node.router.counters["redirect_hints"],
+        )
+        claims = CounterMetricFamily(
+            "foremast_mesh_claim_docs",
+            "documents seen by the partition claim filter, by outcome "
+            "(owned=claimed here, skipped=another member's partition)",
+            labels=["result"],
+        )
+        for result, n in node.claim_counts.items():
+            claims.add_metric([result], n)
+        yield claims

@@ -1,0 +1,74 @@
+"""Consistent-hash partitioning: which member owns which route key.
+
+The mesh shards the fleet by hashing *route keys* (mesh/routing.py —
+app names for documents, the routing label for series) onto a ring of
+virtual nodes, `replicas` per member (the `DataParallelPartitioner` /
+named-sharding shape from SNIPPETS.md [2]/[3], applied to documents
+instead of array rows). Properties the rest of the mesh stands on:
+
+  * deterministic across processes — the hash is blake2b, never
+    Python's randomized `hash()`, so every worker (and the store-side
+    claim filter in the scale-out bench) computes the SAME owner for
+    the same (members, key) pair;
+  * minimal movement — when a member dies, only the keys it owned move
+    (to their next clockwise survivor); everyone else's partition is
+    untouched, so a rebalance re-fits only the orphaned documents;
+  * weightable — a member's `capacity` multiplies its replica count,
+    so a half-sized worker owns roughly half a share.
+
+No locking here: a `HashRing` is immutable after construction; the
+router swaps whole rings on membership change.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+
+
+def _point(data: str) -> int:
+    """64-bit ring coordinate; blake2b so placement is identical in
+    every process regardless of PYTHONHASHSEED."""
+    return int.from_bytes(
+        hashlib.blake2b(data.encode("utf-8"), digest_size=8).digest(), "big"
+    )
+
+
+class HashRing:
+    """Immutable consistent-hash ring over member ids."""
+
+    def __init__(
+        self,
+        members: dict[str, int] | list[str] | tuple[str, ...],
+        replicas: int = 64,
+    ):
+        """`members` is either a list of ids (capacity 1 each) or an
+        id -> capacity map; `replicas` virtual nodes per unit capacity."""
+        if not isinstance(members, dict):
+            members = {m: 1 for m in members}
+        self.replicas = max(1, int(replicas))
+        self.members = tuple(sorted(members))
+        points: list[tuple[int, str]] = []
+        for member, capacity in members.items():
+            n = self.replicas * max(1, int(capacity))
+            for i in range(n):
+                points.append((_point(f"{member}#{i}"), member))
+        points.sort()
+        self._points = [p for p, _ in points]
+        self._owners = [m for _, m in points]
+
+    def __len__(self) -> int:
+        return len(self.members)
+
+    def owner(self, key: str) -> str | None:
+        """The member owning `key` (first virtual node clockwise), or
+        None on an empty ring."""
+        if not self._points:
+            return None
+        i = bisect.bisect_right(self._points, _point(key))
+        if i == len(self._points):
+            i = 0
+        return self._owners[i]
+
+    def owns(self, key: str, member: str) -> bool:
+        return self.owner(key) == member

@@ -1,0 +1,277 @@
+"""Route keys + the router: documents and pushed series → one owner.
+
+The partition unit is the APPLICATION, not the document or the series:
+``doc_route_key`` is the document's app name (every doc of a service
+lands on one worker — its fit cache, arena rows and ring series stay
+together), and ``series_route_key`` extracts the same identity from a
+pushed series' canonical selector via the routing label (default
+``app``, `FOREMAST_MESH_ROUTE_LABEL`). A series that carries the label
+therefore hashes to the SAME member as the documents that query it —
+that is what makes the receiver's redirect hint converge pushers onto
+the worker whose ring actually feeds those documents' fetches.
+
+Series without the routing label (opaque expressions, alias-form
+pushes) fall back to hashing the whole canonical key: still a single
+well-defined home every worker agrees on, just not guaranteed to be
+co-resident with a document — such fetches degrade to the existing
+cold-miss fallback path, never to wrong answers.
+
+`MeshRouter` owns the member→ring cache: `refresh()` re-lists
+membership at most every `refresh_seconds` (or on demand) and swaps in
+a new `HashRing` only when the live-member set actually changed, so
+the per-claim `owns_doc` filter is a dict peek + one blake2b hash.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+import time
+
+from foremast_tpu.ingest.wire import canonical_series
+from foremast_tpu.mesh.membership import MemberRecord, Membership
+from foremast_tpu.mesh.partition import HashRing
+
+log = logging.getLogger("foremast_tpu.mesh")
+
+DEFAULT_ROUTE_LABEL = "app"
+DEFAULT_REPLICAS = 64
+DEFAULT_REFRESH_SECONDS = 2.0
+
+# label extraction from a CANONICAL selector (label values are escaped
+# and sorted by wire.canonical_series / series_key, so a plain scan for
+# `label="value"` is exact, not heuristic)
+_LABEL_RE_CACHE: dict[str, re.Pattern] = {}
+
+
+def _label_re(label: str) -> re.Pattern:
+    pat = _LABEL_RE_CACHE.get(label)
+    if pat is None:
+        pat = re.compile(
+            r'[{,]\s*%s="((?:[^"\\]|\\.)*)"' % re.escape(label)
+        )
+        _LABEL_RE_CACHE[label] = pat
+    return pat
+
+
+def doc_route_key(doc) -> str:
+    """A document's partition identity: its app (all of a service's
+    docs co-locate), falling back to the id for app-less docs."""
+    return doc.app_name or doc.id
+
+
+def series_route_key(key: str, route_label: str = DEFAULT_ROUTE_LABEL) -> str:
+    """A series' partition identity: the routing label's value when the
+    canonical selector carries it, else the whole key."""
+    canon = canonical_series(key)
+    m = _label_re(route_label).search(canon)
+    if m:
+        return m.group(1)
+    return canon
+
+
+class MeshRouter:
+    """Membership-backed ownership oracle. Thread-safe: the receiver's
+    handler threads and the worker's tick thread both consult it."""
+
+    def __init__(
+        self,
+        membership: Membership,
+        replicas: int = DEFAULT_REPLICAS,
+        route_label: str = DEFAULT_ROUTE_LABEL,
+        refresh_seconds: float = DEFAULT_REFRESH_SECONDS,
+        clock=time.time,
+    ):
+        self.membership = membership
+        self.replicas = int(replicas)
+        self.route_label = route_label
+        self.refresh_seconds = float(refresh_seconds)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._ring = HashRing((), replicas=self.replicas)
+        self._members: dict[str, MemberRecord] = {}
+        self._last_refresh = 0.0
+        # rebalances = ring swaps after the first build; redirect_hints /
+        # foreign_series are receiver traffic (mesh/node.py exports them)
+        self.counters = {
+            "rebalances": 0,
+            "redirect_hints": 0,
+            "foreign_series": 0,
+        }
+
+    @property
+    def self_id(self) -> str:
+        return self.membership.worker_id
+
+    def refresh(self, force: bool = False) -> bool:
+        """Re-list membership (rate-limited) and swap the ring when the
+        live set changed. Returns True on a membership change."""
+        now = self._clock()
+        with self._lock:
+            if not force and now - self._last_refresh < self.refresh_seconds:
+                return False
+            self._last_refresh = now
+            first = not self._members
+        members = {m.worker_id: m for m in self.membership.live_members(now)}
+        with self._lock:
+            if set(members) == set(self._members) and all(
+                members[k].capacity == self._members[k].capacity
+                for k in members
+            ):
+                self._members = members  # refreshed addresses/leases
+                return False
+            old = set(self._members)
+            self._members = members
+            self._ring = HashRing(
+                {m.worker_id: m.capacity for m in members.values()},
+                replicas=self.replicas,
+            )
+        if not first:
+            # counters are monotonic telemetry, deliberately unguarded
+            # (single-writer per counter key in practice; drift under a
+            # race is bounded and harmless)
+            self.counters["rebalances"] += 1
+            log.info(
+                "mesh rebalance: members %s -> %s",
+                sorted(old), sorted(members),
+            )
+        return True
+
+    def members(self) -> list[MemberRecord]:
+        with self._lock:
+            return sorted(
+                self._members.values(), key=lambda m: m.worker_id
+            )
+
+    def member(self, worker_id: str) -> MemberRecord | None:
+        with self._lock:
+            return self._members.get(worker_id)
+
+    # -- ownership ------------------------------------------------------
+
+    def owner_of_doc(self, doc) -> str | None:
+        with self._lock:
+            return self._ring.owner(doc_route_key(doc))
+
+    def owns_doc(self, doc) -> bool:
+        # a worker alone on the ring (or with membership unreadable)
+        # owns everything — a degraded mesh must degrade to the
+        # single-worker behavior, never to an unclaimable fleet
+        with self._lock:
+            ring = self._ring
+        if len(ring) == 0:
+            return True
+        return ring.owns(doc_route_key(doc), self.self_id)
+
+    def owner_of_series(self, key: str) -> str | None:
+        with self._lock:
+            return self._ring.owner(
+                series_route_key(key, self.route_label)
+            )
+
+    def owns_series(self, key: str) -> bool:
+        with self._lock:
+            ring = self._ring
+        if len(ring) == 0:
+            return True
+        return ring.owns(
+            series_route_key(key, self.route_label), self.self_id
+        )
+
+    def redirect_hint(self, key: str) -> str | None:
+        """The owning member's advertised ingest address for a series
+        this worker does NOT own (None when owned, owner unknown, or
+        the owner advertises no receiver). Counts receiver traffic."""
+        with self._lock:
+            ring = self._ring
+        if len(ring) == 0:
+            return None
+        owner = ring.owner(series_route_key(key, self.route_label))
+        if owner is None or owner == self.self_id:
+            return None
+        self.counters["foreign_series"] += 1
+        rec = self.member(owner)
+        if rec is None or not rec.ingest_address:
+            return None
+        self.counters["redirect_hints"] += 1
+        return rec.ingest_address
+
+
+class RoutingPusher:
+    """A mesh-aware push client (tests, benchmarks, sidecar pushers).
+
+    Pushes every series to its cached route (any seed address until a
+    hint arrives) and learns from the `redirects` map in each receiver
+    response — by the next cycle every series lands directly on its
+    owner, the 'converge within one push cycle' contract the receiver's
+    accept-and-hint behavior is designed for.
+    """
+
+    def __init__(self, addresses: list[str], timeout: float = 10.0):
+        if not addresses:
+            raise ValueError("RoutingPusher needs at least one address")
+        self.addresses = list(addresses)
+        self.timeout = timeout
+        self._route: dict[str, str] = {}  # series key -> "host:port"
+
+    def _post(self, address: str, entries: list[dict]) -> dict:
+        import json as _json
+        import urllib.request
+
+        from foremast_tpu.ingest.receiver import WRITE_PATH
+
+        req = urllib.request.Request(
+            f"http://{address}{WRITE_PATH}",
+            data=_json.dumps({"timeseries": entries}).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return _json.loads(resp.read())
+
+    def push_cycle(
+        self, series: list[tuple[str, list, list, float | None]]
+    ) -> dict:
+        """One cycle: group by learned route, POST, learn hints.
+        `series` entries are (key, times, values, start|None); returns
+        {"accepted", "redirects", "errors", "by_address"}.
+
+        A failed POST (the learned owner died — the mesh's own
+        rebalance scenario) FORGETS the batch's learned routes instead
+        of raising: the next cycle falls back to a seed address, whose
+        receiver answers with the HEALED ring's owner, and the pusher
+        re-converges the same way it converged initially. Without the
+        forget, a dead address would poison every later cycle."""
+        by_addr: dict[str, list[tuple[str, dict]]] = {}
+        for key, ts, vs, start in series:
+            entry = {
+                "alias": key,
+                "times": list(ts),
+                "values": [float(v) for v in vs],
+            }
+            if start is not None:
+                entry["start"] = float(start)
+            addr = self._route.get(key, self.addresses[0])
+            by_addr.setdefault(addr, []).append((key, entry))
+        accepted = 0
+        redirected = 0
+        errors = 0
+        for addr, keyed in by_addr.items():
+            try:
+                body = self._post(addr, [e for _, e in keyed])
+            except OSError:
+                errors += 1
+                for key, _ in keyed:
+                    self._route.pop(key, None)
+                continue
+            accepted += int(body.get("accepted_samples", 0))
+            for key, owner_addr in (body.get("redirects") or {}).items():
+                self._route[key] = owner_addr
+                redirected += 1
+        return {
+            "accepted": accepted,
+            "redirects": redirected,
+            "errors": errors,
+            "by_address": {a: len(e) for a, e in by_addr.items()},
+        }

@@ -44,6 +44,11 @@ ALLOWED_LABELS: dict[str, frozenset[str]] = {
     "foremast_ingest_series_resident": frozenset(),
     "foremast_ingest_bytes_resident": frozenset(),
     "foremast_ingest_receiver_lag_seconds": frozenset(),
+    # worker mesh (foremast_tpu/mesh/node.py MeshCollector)
+    "foremast_mesh_members": frozenset(),
+    "foremast_mesh_rebalances": frozenset(),
+    "foremast_mesh_redirect_hints": frozenset(),
+    "foremast_mesh_claim_docs": frozenset({"result"}),
 }
 
 
@@ -121,6 +126,17 @@ def default_registry_families():
     ring.query("lint_series", 0.0, 120.0, now=180.0)  # hit
     ring.query("lint_absent", 0.0, 120.0, now=180.0)  # miss
     registry.register(IngestCollector(ring))
+    # worker mesh: a one-member node with both claim outcomes exercised
+    from foremast_tpu.jobs.models import Document
+    from foremast_tpu.jobs.store import InMemoryStore
+    from foremast_tpu.mesh import MeshCollector, MeshNode, Membership, MeshRouter
+
+    membership = Membership(InMemoryStore(), "lint-worker", lease_seconds=60)
+    node = MeshNode(membership, MeshRouter(membership))
+    node.start()
+    node.claim_filter(Document(id="lint-doc", app_name="lint-app"))
+    node.claim_counts["skipped"] += 1  # both label values must appear
+    registry.register(MeshCollector(node))
     return registry
 
 

@@ -484,12 +484,19 @@ def start_observe_server(
     registry=None,
     state_fn=None,
     host: str = "0.0.0.0",
+    max_port_tries: int = 1,
 ):
     """Serve /metrics (Prometheus exposition), /healthz, and
     /debug/state (JSON varz from `state_fn`) on one port — the worker's
     :8000 scrape endpoint, extended. Returns (server, thread); the
     thread is a daemon, same lifecycle as prometheus_client's
-    start_http_server."""
+    start_http_server.
+
+    `max_port_tries` > 1 auto-increments past ports already bound (up
+    to port+tries-1): two workers on one host — the mesh's normal
+    topology — must not kill each other over :8000. Read the ACTUAL
+    port back from server.server_address (the mesh publishes it in the
+    member record)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from prometheus_client import CONTENT_TYPE_LATEST, REGISTRY, generate_latest
@@ -527,7 +534,30 @@ def start_observe_server(
             else:
                 self._send(404, b'{"reason": "not found"}', "application/json")
 
-    srv = ThreadingHTTPServer((host, port), Handler)
+    import errno
+
+    srv = None
+    last_err: OSError | None = None
+    # port 0 is the OS's ephemeral pick — auto-increment is meaningless
+    tries = 1 if port == 0 else max(1, int(max_port_tries))
+    for i in range(tries):
+        try:
+            srv = ThreadingHTTPServer((host, port + i), Handler)
+            break
+        except OSError as e:
+            # only a BUSY port is worth walking past: privilege or
+            # address errors repeat identically on port+1 and the
+            # configured port must stay in the error the operator sees
+            if e.errno != errno.EADDRINUSE:
+                raise
+            last_err = e
+    if srv is None:
+        raise last_err
+    if port and srv.server_address[1] != port:
+        logging.getLogger("foremast_tpu.observe").info(
+            "observe port %d busy; serving /metrics + /debug/state on "
+            ":%d instead", port, srv.server_address[1],
+        )
     thread = threading.Thread(target=srv.serve_forever, daemon=True)
     thread.start()
     return srv, thread

@@ -169,6 +169,28 @@ def test_ingest_bench_small_smoke(capsys):
     assert line["value"] and line["value"] > 1.0
 
 
+def test_scaleout_bench_small_smoke(capsys):
+    """`make bench-scaleout --small` smoke (ISSUE 6): 1 then 2 REAL
+    worker processes over the HTTP store — exactly-once judgment and
+    the kill/rebalance ≤2-tick bar are asserted inside run(); routed
+    pushes must converge by the second cycle (the ≥3x throughput bar is
+    checked at full benchmark shapes, not CI smoke shapes)."""
+    import benchmarks.scaleout_bench as scaleout_bench
+
+    scaleout_bench.main(["--small"])
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["config"] == "s-mesh-scaleout"
+    assert line["worker_counts"] == [1, 2]
+    assert line["no_double_judgment"] is True
+    assert line["routed_push_converged"] is True
+    assert line["rebalance"] is not None
+    assert line["rebalance"]["worst_ticks_after_heal"] <= 2
+    assert line["rebalance"]["orphan_docs"] > 0
+    assert all(
+        v > 0 for v in line["fleet_warm_windows_per_sec"].values()
+    )
+
+
 def test_plane_bench_small_smoke():
     """Watch-plane scale benchmark (VERDICT r5 #7) at CI shapes: the
     informer resync and the controller poll tick must run and stay

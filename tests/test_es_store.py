@@ -203,13 +203,20 @@ class FakeES:
                 ok = ok and value > cond["gt"]
             return ok
         if "bool" in query:
+            # real ES conjoins the clause kinds — a bool carrying both
+            # `must` and `must_not` (the store's list_app query) must
+            # apply BOTH, not whichever is checked first
             b = query["bool"]
-            if "must_not" in b:
-                return not FakeES._matches(b["must_not"], source)
+            ok = True
             if "must" in b:
-                return all(FakeES._matches(q, source) for q in b["must"])
+                ok = ok and all(FakeES._matches(q, source) for q in b["must"])
             if "should" in b:
-                return any(FakeES._matches(q, source) for q in b["should"])
+                ok = ok and any(
+                    FakeES._matches(q, source) for q in b["should"]
+                )
+            if "must_not" in b:
+                ok = ok and not FakeES._matches(b["must_not"], source)
+            return ok
         return True
 
 

@@ -414,6 +414,89 @@ def test_receiver_push_roundtrip_and_rejection():
         srv.shutdown()
 
 
+def test_receiver_rejects_oversized_body_with_413():
+    """ISSUE 6 hardening: a push whose Content-Length exceeds
+    FOREMAST_INGEST_MAX_BODY_BYTES answers 413 WITHOUT buffering or
+    parsing the payload; nothing lands in the ring and the receiver
+    keeps serving normal pushes afterwards."""
+    store = RingStore(shards=1)
+    srv, _ = start_ingest_server(
+        0, store, host="127.0.0.1", max_body_bytes=256
+    )
+    try:
+        port = srv.server_address[1]
+        big = json.dumps(
+            {
+                "timeseries": [
+                    {
+                        "alias": "big_series",
+                        "times": list(range(60, 60 * 200, 60)),
+                        "values": [1.0] * 199,
+                    }
+                ]
+            }
+        ).encode()
+        assert len(big) > 256
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v1/write", data=big, method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req)
+        assert exc_info.value.code == 413
+        assert b"cap" in exc_info.value.read()
+        assert store.stats()["series"] == 0
+        # the cap is per request, not a latch: a small push still lands
+        ok = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v1/write",
+            data=json.dumps(
+                {"timeseries": [{"alias": "s", "times": [60], "values": [1.0]}]}
+            ).encode(),
+            method="POST",
+        )
+        assert json.loads(urllib.request.urlopen(ok).read())[
+            "accepted_samples"
+        ] == 1
+    finally:
+        srv.shutdown()
+
+
+def test_receiver_graceful_drain_on_close():
+    """ISSUE 6 hardening: stop_ingest_server stops accepting, drains
+    in-flight handlers, and frees the port — a mid-shutdown push gets a
+    connection error, never a wedged thread holding worker close."""
+    import socket
+
+    from foremast_tpu.ingest import stop_ingest_server
+
+    store = RingStore(shards=1)
+    srv, thread = start_ingest_server(0, store, host="127.0.0.1")
+    port = srv.server_address[1]
+    # handler threads must be daemons (the pre-ISSUE-6 wedge: a
+    # non-daemon handler blocked on a half-sent body held process exit)
+    assert srv.daemon_threads is True
+    assert stop_ingest_server(srv, drain_seconds=5.0) is True
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
+    # the listen socket is closed: new pushes fail fast instead of
+    # queueing against a dead receiver
+    with pytest.raises((ConnectionError, urllib.error.URLError, OSError)):
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/write",
+                data=b"{}",
+                method="POST",
+            ),
+            timeout=2.0,
+        )
+    # ... and the port is immediately rebindable (SO_REUSEADDR + closed)
+    s = socket.socket()
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", port))
+    finally:
+        s.close()
+
+
 # ---------------------------------------------------------------------------
 # end-to-end: worker ticks from the ring
 # ---------------------------------------------------------------------------

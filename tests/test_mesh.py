@@ -1,0 +1,455 @@
+"""Worker mesh (ISSUE 6): partitioning, membership, routing, claims.
+
+The mesh's correctness story has four independently testable legs:
+
+  1. the consistent-hash ring is deterministic, reasonably balanced,
+     and moves ONLY the dead member's keys on a membership change;
+  2. membership leases: join/renew/expiry/leave against the real store
+     API, with injectable clocks (no sleeps);
+  3. route keys co-locate an application's documents with its pushed
+     series, and the receiver answers foreign-series pushes with the
+     owner's advertised address (accepting the samples regardless);
+  4. the claim filter partitions a shared store: N workers claim
+     disjoint subsets whose union is the fleet — on the in-memory
+     store AND through the ES store's search+CAS path.
+
+The worker-level kill/rebalance scenario lives in test_pod_failure.py;
+the multi-process version runs in benchmarks/scaleout_bench.py.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import numpy as np
+
+from foremast_tpu.jobs.models import Document
+from foremast_tpu.jobs.store import InMemoryStore
+from foremast_tpu.mesh import (
+    MESH_APP,
+    HashRing,
+    Membership,
+    MeshNode,
+    MeshRouter,
+    RoutingPusher,
+    doc_route_key,
+    live_members,
+    series_route_key,
+)
+
+# ---------------------------------------------------------------------------
+# partition: the hash ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_deterministic_and_total():
+    r1 = HashRing(["w0", "w1", "w2"])
+    r2 = HashRing(["w2", "w0", "w1"])  # construction order must not matter
+    for i in range(500):
+        key = f"app{i}"
+        assert r1.owner(key) == r2.owner(key)
+        assert r1.owner(key) in ("w0", "w1", "w2")
+    assert HashRing([]).owner("x") is None
+    assert HashRing(["solo"]).owner("anything") == "solo"
+
+
+def test_ring_balance_and_minimal_movement():
+    members = [f"w{i}" for i in range(4)]
+    ring = HashRing(members, replicas=64)
+    keys = [f"app{i}" for i in range(8000)]
+    owners = {k: ring.owner(k) for k in keys}
+    counts = {m: sum(1 for o in owners.values() if o == m) for m in members}
+    # 64 virtual nodes keep the spread reasonable at 4 members
+    assert min(counts.values()) > 0.5 * (8000 / 4), counts
+    assert max(counts.values()) < 1.6 * (8000 / 4), counts
+    # kill w3: ONLY its keys move, and they land on survivors
+    healed = HashRing(members[:3], replicas=64)
+    for k in keys:
+        if owners[k] != "w3":
+            assert healed.owner(k) == owners[k], k
+        else:
+            assert healed.owner(k) in ("w0", "w1", "w2")
+
+
+def test_ring_capacity_weighting():
+    ring = HashRing({"big": 4, "small": 1}, replicas=64)
+    keys = [f"app{i}" for i in range(4000)]
+    big = sum(1 for k in keys if ring.owner(k) == "big")
+    assert big > 2400, big  # ~4/5 of the keyspace, with slack
+
+
+# ---------------------------------------------------------------------------
+# membership: leases in the store
+# ---------------------------------------------------------------------------
+
+
+def _clock(box):
+    return lambda: box[0]
+
+
+def test_membership_join_renew_expire_leave():
+    store = InMemoryStore()
+    t = [1000.0]
+    a = Membership(store, "w-a", lease_seconds=10.0, clock=_clock(t))
+    b = Membership(store, "w-b", lease_seconds=10.0, clock=_clock(t))
+    a.join()
+    b.join()
+    assert [m.worker_id for m in live_members(store, now=t[0])] == [
+        "w-a", "w-b",
+    ]
+    # member docs are invisible to the claim path
+    assert store.claim("claimer", 90.0, limit=10) == []
+
+    # a renews, b goes silent: at t+11 only a is live
+    t[0] = 1006.0
+    assert a.renew() is True  # past lease/3
+    assert a.renew() is False  # rate-limited
+    t[0] = 1011.0
+    assert [m.worker_id for m in live_members(store, now=t[0])] == ["w-a"]
+
+    # b's next renew resurrects it (a restart re-taking its seat)
+    b.renew()
+    assert len(live_members(store, now=t[0])) == 2
+
+    # a clean leave disappears immediately, fresh lease or not
+    a.leave()
+    assert [m.worker_id for m in live_members(store, now=t[0])] == ["w-b"]
+
+
+def test_membership_record_carries_addresses():
+    store = InMemoryStore()
+    m = Membership(
+        store, "w-x", lease_seconds=5.0,
+        ingest_address="10.0.0.7:9009", observe_port=8001, capacity=2,
+    )
+    m.join()
+    (rec,) = live_members(store)
+    assert rec.ingest_address == "10.0.0.7:9009"
+    assert rec.observe_port == 8001
+    assert rec.capacity == 2
+
+
+def test_membership_corrupt_record_is_dead_not_fatal():
+    store = InMemoryStore()
+    Membership(store, "w-ok", lease_seconds=60.0).join()
+    store.create(
+        Document(
+            id="mesh::garbage",
+            app_name=MESH_APP,
+            status="mesh_member",
+            current_config="{not json",
+        )
+    )
+    assert [m.worker_id for m in live_members(store)] == ["w-ok"]
+
+
+# ---------------------------------------------------------------------------
+# routing: docs and series share an owner
+# ---------------------------------------------------------------------------
+
+
+def test_route_keys_colocate_doc_and_series():
+    doc = Document(id="j1", app_name="checkout")
+    assert doc_route_key(doc) == "checkout"
+    # any label order, any matcher spacing — one canonical route key
+    assert series_route_key('errors{app="checkout",ns="prod"}') == "checkout"
+    assert series_route_key('errors{ns="prod", app="checkout"}') == "checkout"
+    # no routing label: the whole canonical key is the identity
+    assert (
+        series_route_key('errors{ns="prod"}')
+        == series_route_key('errors{ ns="prod" }')
+    )
+    # label named *app* only — a suffix like myapp must not match
+    assert series_route_key('m{myapp="x"}') == 'm{myapp="x"}'
+
+
+def _mesh_pair(store):
+    t = [0.0]
+    nodes = []
+    for wid in ("w-a", "w-b"):
+        mem = Membership(store, wid, lease_seconds=30.0, clock=_clock(t))
+        router = MeshRouter(mem, refresh_seconds=0.0, clock=_clock(t))
+        node = MeshNode(mem, router, clock=_clock(t))
+        node.start()
+        nodes.append(node)
+    for node in nodes:
+        node.router.refresh(force=True)  # both see both
+    return nodes, t
+
+
+def test_router_ownership_is_a_partition():
+    store = InMemoryStore()
+    (a, b), _ = _mesh_pair(store)
+    docs = [Document(id=f"j{i}", app_name=f"app{i}") for i in range(300)]
+    owned_a = {d.id for d in docs if a.claim_filter(d)}
+    owned_b = {d.id for d in docs if b.claim_filter(d)}
+    assert owned_a.isdisjoint(owned_b)
+    assert len(owned_a) + len(owned_b) == 300
+    assert owned_a and owned_b
+    # series follow their app's documents
+    for d in docs[:50]:
+        key = f'latency{{app="{d.app_name}"}}'
+        assert (a.router.owns_series(key)) == (d.id in owned_a)
+    assert a.claim_counts["owned"] == len(owned_a)
+    assert a.claim_counts["skipped"] == 300 - len(owned_a)
+
+
+def test_router_sole_member_owns_everything():
+    store = InMemoryStore()
+    mem = Membership(store, "only", lease_seconds=30.0)
+    router = MeshRouter(mem, refresh_seconds=0.0)
+    node = MeshNode(mem, router)
+    node.start()
+    assert node.claim_filter(Document(id="x", app_name="anything"))
+    assert router.redirect_hint('m{app="anything"}') is None
+
+
+def test_rebalance_on_member_death_moves_only_orphans():
+    store = InMemoryStore()
+    (a, b), t = _mesh_pair(store)
+    docs = [Document(id=f"j{i}", app_name=f"app{i}") for i in range(300)]
+    before_a = {d.id for d in docs if a.router.owns_doc(d)}
+    base = a.router.counters["rebalances"]
+    # b dies: lease expires, a's next refresh heals the ring
+    t[0] = 31.0
+    a.membership.renew(force=True)
+    assert a.router.refresh(force=True) is True
+    assert a.router.counters["rebalances"] == base + 1
+    after_a = {d.id for d in docs if a.router.owns_doc(d)}
+    assert after_a == {d.id for d in docs}  # sole survivor owns all
+    assert before_a <= after_a
+
+
+# ---------------------------------------------------------------------------
+# claims against shared stores
+# ---------------------------------------------------------------------------
+
+
+def _fleet(store, n):
+    for i in range(n):
+        store.create(
+            Document(
+                id=f"j{i}", app_name=f"app{i}",
+                current_config="m== http://x", strategy="continuous",
+            )
+        )
+
+
+def test_inmemory_claims_partition_the_fleet():
+    store = InMemoryStore()
+    (a, b), _ = _mesh_pair(store)
+    _fleet(store, 60)
+    got_a = store.claim("w-a", 90.0, limit=100, claim_filter=a.claim_filter)
+    got_b = store.claim("w-b", 90.0, limit=100, claim_filter=b.claim_filter)
+    ids_a = {d.id for d in got_a}
+    ids_b = {d.id for d in got_b}
+    assert ids_a.isdisjoint(ids_b)
+    assert len(ids_a) + len(ids_b) == 60
+    # filtered docs were NOT parked in-progress: a second owner claim
+    # of the other partition still finds them claimable
+    assert store.claim("w-a", 90.0, limit=100, claim_filter=a.claim_filter) == []
+
+
+def test_es_store_claim_filter_between_search_and_cas():
+    """The ES path applies the partition filter client-side between the
+    claimability search and the bulk CAS: only owned docs are CASed,
+    foreign hits stay untouched (status unchanged, seq_no unchanged)."""
+    from test_es_store import FakeES
+
+    from foremast_tpu.jobs.store import ElasticsearchStore
+
+    fake = FakeES()
+    store = ElasticsearchStore("http://fake:9200", session=fake)
+    store.ensure_index()
+    _fleet(store, 20)
+    (a, b), _ = _mesh_pair(store)
+    got_a = store.claim("w-a", 90.0, limit=50, claim_filter=a.claim_filter)
+    ids_a = {d.id for d in got_a}
+    assert ids_a and len(ids_a) < 20
+    for doc_id, rec in fake.docs.items():
+        if not doc_id.startswith("j"):
+            continue
+        status = rec["_source"]["status"]
+        if doc_id in ids_a:
+            assert status == "preprocess_inprogress"
+        else:
+            assert status == "initial"
+    got_b = store.claim("w-b", 90.0, limit=50, claim_filter=b.claim_filter)
+    assert {d.id for d in got_b} == {
+        f"j{i}" for i in range(20)
+    } - ids_a
+
+
+def test_es_store_list_app_finds_members_past_the_open_page():
+    from test_es_store import FakeES
+
+    from foremast_tpu.jobs.store import ElasticsearchStore
+
+    fake = FakeES()
+    store = ElasticsearchStore("http://fake:9200", session=fake)
+    store.ensure_index()
+    _fleet(store, 5)
+    Membership(store, "w-es", lease_seconds=30.0).join()
+    docs = store.list_app(MESH_APP)
+    assert [d.id for d in docs] == ["mesh::w-es"]
+    assert live_members(store)[0].worker_id == "w-es"
+
+
+# ---------------------------------------------------------------------------
+# routed ingest: receiver hints + pusher convergence
+# ---------------------------------------------------------------------------
+
+
+def test_receiver_redirect_hint_accepts_and_points_at_owner():
+    from foremast_tpu.ingest import RingStore, start_ingest_server
+
+    store = InMemoryStore()
+    (a, b), _ = _mesh_pair(store)
+    # advertise addresses so hints can carry them
+    a.membership.ingest_address = "127.0.0.1:7001"
+    b.membership.ingest_address = "127.0.0.1:7002"
+    a.membership.renew(force=True)
+    b.membership.renew(force=True)
+    a.router.refresh(force=True)
+    b.router.refresh(force=True)
+
+    # find one app owned by b
+    foreign_app = next(
+        f"app{i}"
+        for i in range(100)
+        if not a.router.owns_doc(Document(id="x", app_name=f"app{i}"))
+    )
+    ring = RingStore(shards=1)
+    srv, _ = start_ingest_server(
+        0, ring, host="127.0.0.1", router=a.router
+    )
+    try:
+        port = srv.server_address[1]
+        body = json.dumps(
+            {
+                "timeseries": [
+                    {
+                        "alias": f'm{{app="{foreign_app}"}}',
+                        "times": [60, 120],
+                        "values": [1.0, 2.0],
+                    }
+                ]
+            }
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v1/write", data=body, method="POST"
+        )
+        out = json.loads(urllib.request.urlopen(req).read())
+        # accepted (lossless during convergence) AND hinted at the owner
+        assert out["accepted_samples"] == 2
+        assert out["redirects"] == {
+            f'm{{app="{foreign_app}"}}': "127.0.0.1:7002"
+        }
+        assert ring.stats()["series"] == 1
+        assert a.router.counters["redirect_hints"] == 1
+    finally:
+        srv.shutdown()
+
+
+def test_routing_pusher_converges_in_one_cycle():
+    from foremast_tpu.ingest import RingStore, start_ingest_server
+
+    store = InMemoryStore()
+    (a, b), _ = _mesh_pair(store)
+    rings = {}
+    servers = []
+    try:
+        for node in (a, b):
+            ring = RingStore(shards=1)
+            srv, _ = start_ingest_server(
+                0, ring, host="127.0.0.1", router=node.router
+            )
+            addr = f"127.0.0.1:{srv.server_address[1]}"
+            node.membership.ingest_address = addr
+            node.membership.renew(force=True)
+            rings[node.worker_id] = ring
+            servers.append(srv)
+        a.router.refresh(force=True)
+        b.router.refresh(force=True)
+
+        series = [
+            (
+                f'm{{app="app{i}"}}',
+                [60, 120],
+                np.asarray([1.0, 2.0], np.float32),
+                None,
+            )
+            for i in range(40)
+        ]
+        pusher = RoutingPusher([a.membership.ingest_address])
+        first = pusher.push_cycle(series)
+        assert first["redirects"] > 0  # b's share got hints
+        second = pusher.push_cycle(series)
+        assert second["redirects"] == 0  # converged
+        # every series now resides on its OWNER's ring
+        for key, *_ in series:
+            owner = a.router.owner_of_series(key)
+            assert rings[owner].query(key, 0, 120, now=150.0)[0] == "hit"
+    finally:
+        for srv in servers:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# worker integration: debug state + observe port auto-increment
+# ---------------------------------------------------------------------------
+
+
+def test_worker_debug_state_has_mesh_section():
+    from foremast_tpu.config import BrainConfig
+    from foremast_tpu.jobs.worker import BrainWorker
+    from foremast_tpu.metrics.source import StaticSource
+
+    store = InMemoryStore()
+    (a, _b), _ = _mesh_pair(store)
+    worker = BrainWorker(
+        store,
+        StaticSource({}),
+        config=BrainConfig(),
+        worker_id="w-a",
+        mesh=a,
+    )
+    worker.tick(now=1000.0)
+    state = worker.debug_state()
+    assert state["mesh"]["live_members"] == 2
+    assert {m["worker_id"] for m in state["mesh"]["members"]} == {
+        "w-a", "w-b",
+    }
+    assert state["mesh"]["claim_docs"]["owned"] >= 0
+    worker.close()
+
+
+def test_observe_server_auto_increments_busy_port():
+    import socket
+    import urllib.request as _rq
+
+    from foremast_tpu.observe.spans import start_observe_server
+
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    try:
+        srv, _ = start_observe_server(
+            port, state_fn=lambda: {"ok": 1}, host="127.0.0.1",
+            max_port_tries=8,
+        )
+        try:
+            actual = srv.server_address[1]
+            assert port < actual <= port + 7
+            state = json.loads(
+                _rq.urlopen(
+                    f"http://127.0.0.1:{actual}/debug/state"
+                ).read()
+            )
+            assert state == {"ok": 1}
+        finally:
+            srv.shutdown()
+    finally:
+        blocker.close()
