@@ -35,6 +35,13 @@ bench-ingest:
 bench-scaleout:
 	$(PY) -m benchmarks.scaleout_bench
 
+# durable-restart crash harness (ISSUE 7): SIGKILL a worker mid-tick,
+# restart it against the same FOREMAST_SNAPSHOT_DIR state, and assert
+# in-run: next tick >= 90% fast-path, ZERO fallback fetches, no lost
+# or duplicated verdicts (single-worker and 3-worker-mesh variants)
+bench-restart:
+	$(PY) -m benchmarks.restart_bench
+
 native:
 	$(MAKE) -C native
 
@@ -62,4 +69,4 @@ clean:
 	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 
-.PHONY: test bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest bench-scaleout native deploy-render check metrics-lint env-docs docker-build clean
+.PHONY: test bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest bench-scaleout bench-restart native deploy-render check metrics-lint env-docs docker-build clean

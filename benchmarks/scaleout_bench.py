@@ -429,6 +429,14 @@ class HttpFleetStore:
         for d in body["new"]:
             doc = Document.from_json(d)
             self._docs[doc.id] = doc
+        for i in body["ids"]:
+            if i not in self._docs:
+                # the server's `seen` set says this worker ID already
+                # received the doc in full, but THIS process has not —
+                # a restarted worker reusing its id (restart_bench).
+                # Re-fetch once; the real ES store reships _source.
+                got = self._rpc(op="get", id=i)["doc"]
+                self._docs[i] = Document.from_json(got)
         return [self._docs[i] for i in body["ids"]]
 
     def update(self, doc):

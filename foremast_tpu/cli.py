@@ -216,7 +216,7 @@ def _enable_compile_cache() -> None:
     )
 
 
-def _mount_ingest(inner, gauge_port: int, router=None):
+def _mount_ingest(inner, gauge_port: int, router=None, snapshot_dir=None):
     """FOREMAST_INGEST=1: wrap the pull source in the push-plane
     RingSource (docs/operations.md "Ingest plane") — warm fetches become
     resident ring gathers, cold misses fall back to `inner` and are
@@ -226,8 +226,10 @@ def _mount_ingest(inner, gauge_port: int, router=None):
     worker needs its own receiver) and registers the foremast_ingest_*
     families when a scrape port is live. `router` (mesh mode) makes the
     receiver answer pushes for series another member owns with that
-    member's advertised address. Returns (source, ring, receiver or
-    None)."""
+    member's advertised address. `snapshot_dir` mounts the durable ring
+    (docs/operations.md "Restarts and upgrades"): restore runs BEFORE
+    the receiver accepts its first push, then live pushes journal.
+    Returns (source, ring, receiver or None, snapshotter or None)."""
     from foremast_tpu.ingest import (
         IngestCollector,
         RingSource,
@@ -236,6 +238,14 @@ def _mount_ingest(inner, gauge_port: int, router=None):
     )
 
     ring = RingStore.from_env()
+    snapshotter = None
+    if snapshot_dir:
+        from foremast_tpu.ingest import RingSnapshotter
+
+        snapshotter = RingSnapshotter.from_env(ring, snapshot_dir)
+        # restore() logs series/samples + the discard breakdown itself
+        snapshotter.restore()
+        snapshotter.attach()
     source = RingSource(ring, fallback=inner)
     port = _env_int("FOREMAST_INGEST_PORT", 9009)
     srv = None
@@ -247,7 +257,26 @@ def _mount_ingest(inner, gauge_port: int, router=None):
         from prometheus_client import REGISTRY
 
         REGISTRY.register(IngestCollector(ring, book=source.book))
-    return source, ring, srv
+    return source, ring, srv, snapshotter
+
+
+def _persistent_worker_id(snap_dir: str, minted: str) -> str:
+    """Stable worker identity across restarts (``<snap_dir>/worker.id``):
+    a restarted worker re-joins the mesh as the SAME member, so the hash
+    ring does not move and the restored ring/fit state matches exactly
+    the partition it reclaims. First boot persists the minted id."""
+    from foremast_tpu.ingest.snapshot import atomic_write
+
+    path = os.path.join(snap_dir, "worker.id")
+    try:
+        with open(path) as fh:
+            wid = fh.read().strip()
+        if wid:
+            return wid
+    except OSError:
+        pass
+    atomic_write(path, minted.encode())
+    return minted
 
 
 def cmd_worker(args: argparse.Namespace) -> int:
@@ -393,6 +422,11 @@ def cmd_worker(args: argparse.Namespace) -> int:
     mesh_on = os.environ.get("FOREMAST_MESH", "0") == "1"
     mesh_node = None
     ingest_srv = None
+    # durable data plane (opt-in): ring snapshots + append logs, fit
+    # journals, and the persistent mesh identity all under one directory
+    # (docs/operations.md "Restarts and upgrades")
+    snap_dir = os.environ.get("FOREMAST_SNAPSHOT_DIR") or None
+    snapshotter = None
     if mesh_on and pod_mode:
         print(
             "FOREMAST_MESH=1 ignored in pod mode (mesh shards fleets "
@@ -400,6 +434,17 @@ def cmd_worker(args: argparse.Namespace) -> int:
             file=sys.stderr,
         )
         mesh_on = False
+    if snap_dir and pod_mode:
+        # pod mode's determinism contract (identical caches on every
+        # process, leader-only I/O) already has its own durability path
+        # (--model-cache-dir leader checkpoint + broadcast); wiring the
+        # journals through the broadcast is future work
+        print(
+            "FOREMAST_SNAPSHOT_DIR ignored in pod mode (use "
+            "--model-cache-dir: leader checkpoint + broadcast)",
+            file=sys.stderr,
+        )
+        snap_dir = None
     if pod_mode:
         # One logical worker spanning the jax.distributed cluster: the
         # leader claims/fetches/writes, everything is broadcast, the
@@ -410,7 +455,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
 
         pod_inner = PrometheusSource() if store is not None else None
         if ingest_on and pod_inner is not None:
-            pod_inner, _pod_ring, ingest_srv = _mount_ingest(
+            pod_inner, _pod_ring, ingest_srv, _ = _mount_ingest(
                 pod_inner, args.gauge_port
             )
         worker = PodWorker(
@@ -425,10 +470,34 @@ def cmd_worker(args: argparse.Namespace) -> int:
         )
     else:
         # mesh identity is minted HERE so the membership record and the
-        # claim's processing_content stamp agree on one worker id
+        # claim's processing_content stamp agree on one worker id; with
+        # a snapshot dir the id PERSISTS, so a restart re-takes the same
+        # mesh seat (no rebalance) and reclaims exactly the partition
+        # its restored ring/fit state belongs to
         import uuid as _uuid
 
         worker_id = f"brain-{_uuid.uuid4().hex[:8]}"
+        snap_lock = None
+        if snap_dir:
+            # exclusivity: two live workers sharing one snapshot dir
+            # would interleave torn frames into the same shard logs and
+            # join the mesh as ONE member. flock dies with the process
+            # (SIGKILL included), so restarts acquire immediately; only
+            # a genuinely concurrent second worker is refused — it runs
+            # ephemeral rather than corrupting the first one's state.
+            from foremast_tpu.ingest import lock_snapshot_dir
+
+            snap_lock = lock_snapshot_dir(snap_dir)
+            if snap_lock is None:
+                print(
+                    f"FOREMAST_SNAPSHOT_DIR {snap_dir} is held by "
+                    "another live worker; running ephemeral (give "
+                    "each co-hosted worker its own directory)",
+                    file=sys.stderr,
+                )
+                snap_dir = None
+        if snap_dir:
+            worker_id = _persistent_worker_id(snap_dir, worker_id)
         membership = router = None
         if mesh_on:
             from foremast_tpu.mesh import Membership, MeshRouter
@@ -451,8 +520,11 @@ def cmd_worker(args: argparse.Namespace) -> int:
         single_source = PrometheusSource()
         single_ring = None
         if ingest_on:
-            single_source, single_ring, ingest_srv = _mount_ingest(
-                single_source, args.gauge_port, router=router
+            single_source, single_ring, ingest_srv, snapshotter = (
+                _mount_ingest(
+                    single_source, args.gauge_port, router=router,
+                    snapshot_dir=snap_dir,
+                )
             )
         if mesh_on:
             from foremast_tpu.mesh import MeshNode
@@ -483,6 +555,19 @@ def cmd_worker(args: argparse.Namespace) -> int:
             tracer=tracer,
             mesh=mesh_node,
         )
+        if snap_dir:
+            # fit journals restore lazily (the first claim of each doc
+            # rehydrates its fits, so admission passes with no history
+            # re-fetch) and write through on fit completion; snapshot
+            # cadence + compaction run inside the tick loop
+            fit_restored = worker.enable_fit_persistence(snap_dir)
+            if any(fit_restored.values()):
+                print(
+                    f"restored fit state {fit_restored} from {snap_dir}",
+                    file=sys.stderr,
+                )
+            if snapshotter is not None:
+                worker.attach_ring_snapshotter(snapshotter)
     if args.gauge_port and leader:
         # /metrics + /healthz + /debug/state on the scrape port (the
         # reference exposed /metrics only). Auto-increment past a busy
@@ -500,6 +585,15 @@ def cmd_worker(args: argparse.Namespace) -> int:
             _REG.register(MeshCollector(mesh_node))
             mesh_node.membership.observe_port = obs_srv.server_address[1]
             mesh_node.membership.renew(force=True)
+        if snap_dir:
+            from foremast_tpu.ingest import SnapshotCollector
+            from prometheus_client import REGISTRY as _REG2
+
+            _REG2.register(
+                SnapshotCollector(
+                    snapshotter, journals=worker._fit_journals.values()
+                )
+            )
 
     after_tick = None
     if ckpt_path:
@@ -574,6 +668,17 @@ def cmd_worker(args: argparse.Namespace) -> int:
             except Exception as e:  # noqa: BLE001 — cleanup must not mask
                 logging.getLogger("foremast_tpu.cli").warning(
                     "ingest receiver shutdown failed: %s", e
+                )
+        if snapshotter is not None:
+            # one final pass AFTER the receiver drained (the last
+            # pushes are in) so the restart replays a snapshot, not a
+            # long log; then release the log handles
+            try:
+                snapshotter.snapshot()
+                snapshotter.close()
+            except Exception as e:  # noqa: BLE001 — cleanup must not mask
+                logging.getLogger("foremast_tpu.cli").warning(
+                    "final ring snapshot failed: %s", e
                 )
         ckpt_error = None
         if ckpt_path and len(judge.cache):

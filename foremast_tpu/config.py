@@ -595,6 +595,44 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "a handler thread or balloon the heap",
     ),
     EnvKnob(
+        "FOREMAST_SNAPSHOT_DIR",
+        None,
+        "path",
+        "durable data-plane directory (docs/operations.md \"Restarts "
+        "and upgrades\"): ring shard snapshots + append logs, "
+        "write-through fit journals, and the worker's persistent mesh "
+        "identity all live here, so a SIGKILLed or upgraded worker "
+        "restarts WARM — next tick ≥ 90% fast-path with zero fallback "
+        "HTTP fetches — instead of re-fetching 7-day histories for the "
+        "whole fleet. Unset = ephemeral (the pre-ISSUE-7 behavior)",
+    ),
+    EnvKnob(
+        "FOREMAST_SNAPSHOT_INTERVAL_SECONDS",
+        "60",
+        "float",
+        "ring snapshot cadence: a full shard snapshot pass at most this "
+        "often (append logs cover the gap between passes, so crash "
+        "recency is bounded by log flush — every push — not by this)",
+    ),
+    EnvKnob(
+        "FOREMAST_SNAPSHOT_MAX_AGE_SECONDS",
+        "86400",
+        "float",
+        "restore age cutoff: a restored series whose coverage head is "
+        "older than this is discarded (counted on "
+        "`foremast_snapshot_discards{reason=\"stale\"}`) and cold-fits "
+        "through the fallback instead — yesterday's ring must not "
+        "shadow a fleet that moved on",
+    ),
+    EnvKnob(
+        "FOREMAST_SNAPSHOT_LOG_MAX_BYTES",
+        "67108864",
+        "int",
+        "per-shard append-log budget (default 64 MiB): a log past it "
+        "forces a snapshot pass (fit journals compact at 8 MiB), "
+        "bounding restart replay time",
+    ),
+    EnvKnob(
         "FOREMAST_MESH",
         "0",
         "bool",

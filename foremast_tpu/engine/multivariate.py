@@ -635,9 +635,15 @@ class MultivariateJudge:
         for j, p in zip(all_joints, all_pw):
             f = j.hist_v.shape[0]
             tc = bucket_length(max(len(j.cur_t), 1))
-            # the history must fill at least one training window of this
-            # job's own bucket, and clear the configured minimum
-            if len(j.cur_t) == 0 or len(j.hist_t) < max(min_pts, tc):
+            # Explicit min-history gate: the history must fill at least
+            # TWO training windows of this job's own bucket (and clear
+            # the configured minimum). One window is not a model: the
+            # AE's mu/sd cutoff calibration comes from the training
+            # reconstruction errors, and a single-window "distribution"
+            # degenerates — measured, it flags clean in-band noise as
+            # UNHEALTHY (the short-history regression test). Too-short
+            # jobs degrade to UNKNOWN, never to a fragile fit.
+            if len(j.cur_t) == 0 or len(j.hist_t) < max(min_pts, 2 * tc):
                 out.extend(self._unknown(j.tasks, p))
             else:
                 groups.setdefault((f, tc), []).append((j, p))
@@ -1043,7 +1049,10 @@ class MultivariateJudge:
             if entry is None:
                 return None
         else:
-            if n_hist < max(min_pts, tc):
+            # same 2-window floor as _judge_lstm's explicit min-history
+            # gate — warm admission must never accept a job the slow
+            # path would refuse to fit
+            if n_hist < max(min_pts, 2 * tc):
                 return None
             key = (
                 "lstm",

@@ -15,8 +15,11 @@ Modules:
     backfill  cold-miss subscriptions + fallback-result backfill
     source    RingSource(MetricSource) — what the worker mounts
     receiver  HTTP push endpoint + foremast_ingest_* exposition
+    snapshot  durable shard snapshots + append logs (warm restarts)
 
-Opt-in via `FOREMAST_INGEST=1` (docs/operations.md "Ingest plane").
+Opt-in via `FOREMAST_INGEST=1` (docs/operations.md "Ingest plane");
+durability via `FOREMAST_SNAPSHOT_DIR` (docs/operations.md "Restarts
+and upgrades").
 """
 
 from foremast_tpu.ingest.backfill import SubscriptionBook, backfill
@@ -27,6 +30,11 @@ from foremast_tpu.ingest.receiver import (
 )
 from foremast_tpu.ingest.ring import SeriesRing
 from foremast_tpu.ingest.shards import RingShard, RingStore
+from foremast_tpu.ingest.snapshot import (
+    RingSnapshotter,
+    SnapshotCollector,
+    lock_snapshot_dir,
+)
 from foremast_tpu.ingest.source import RingSource
 from foremast_tpu.ingest.wire import (
     canonical_series,
@@ -38,12 +46,15 @@ from foremast_tpu.ingest.wire import (
 __all__ = [
     "IngestCollector",
     "RingShard",
+    "RingSnapshotter",
     "RingSource",
     "RingStore",
     "SeriesRing",
+    "SnapshotCollector",
     "SubscriptionBook",
     "backfill",
     "canonical_series",
+    "lock_snapshot_dir",
     "parse_push",
     "resolve_query_range",
     "series_key",

@@ -66,7 +66,15 @@ class RingShard:
         start: float | None = None,
         end: float | None = None,
         slack: float = 0.0,
+        journal=None,
     ) -> int:
+        """`journal` (optional, the snapshot plane's append-log hook) is
+        invoked INSIDE the lock, after a successful apply: replayed log
+        order must equal apply order, or a restore could resurrect the
+        stale side of two same-timestamp revisions that raced on the
+        receiver's handler threads. The cost is a page-cache write +
+        flush inside the lock hold — microseconds, and only when
+        durability is mounted."""
         with self._lock:
             ring = self._series.get(key)
             prev = 0
@@ -83,6 +91,12 @@ class RingShard:
                 _, old = self._series.popitem(last=False)
                 self._bytes -= old.nbytes
                 self._counts["evictions"] += 1
+            if journal is not None and (
+                n or start is not None or end is not None
+            ):
+                # empty backfills still carry an authority claim worth
+                # persisting; pure no-op pushes do not
+                journal(key, times, values, start, end)
             return n
 
     def query(
@@ -136,6 +150,20 @@ class RingShard:
                 self._counts["evictions"] += 1
             return len(doomed)
 
+    def snapshot_state(self) -> list[tuple]:
+        """Consistent copy of every resident series for the snapshot
+        writer: (key, times, values, covered_from, covered_to), columns
+        copied under the shard lock so a concurrent push can never
+        interleave half a mutation into the on-disk state."""
+        with self._lock:
+            out = []
+            for key, ring in self._series.items():
+                t, v = ring.window(None, None)  # ordered copies
+                out.append(
+                    (key, t, v, ring.covered_from, ring.covered_to)
+                )
+            return out
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -167,6 +195,12 @@ class RingStore:
         )
         self._lock = threading.Lock()
         self._lag = {"receiver_lag_seconds": None, "last_push_at": None}
+        # optional push write-through hook (ingest.snapshot attaches the
+        # append-log writer here): called AFTER a successful apply with
+        # (shard_index, canonical key, times, values, start, end),
+        # UNDER the owning shard's lock so replay order equals apply
+        # order (see RingShard.push).
+        self.journal = None
 
     @staticmethod
     def from_env(env=None) -> "RingStore":
@@ -186,8 +220,23 @@ class RingStore:
             ),
         )
 
+    def _shard_index(self, key: str) -> int:
+        """THE key→shard mapping — the journal hook pairs snapshot
+        files with logs by this index, so there must be exactly one
+        definition of it."""
+        return zlib.crc32(key.encode()) % len(self._shards)
+
     def _shard(self, key: str) -> RingShard:
-        return self._shards[zlib.crc32(key.encode()) % len(self._shards)]
+        return self._shards[self._shard_index(key)]
+
+    @property
+    def shard_count(self) -> int:
+        return len(self._shards)
+
+    def shard_state(self, index: int) -> list[tuple]:
+        """One shard's resident series as consistent column copies —
+        the snapshot writer's read path (ingest/snapshot.py)."""
+        return self._shards[index].snapshot_state()
 
     def push(
         self,
@@ -207,9 +256,16 @@ class RingStore:
         cutoff treated as contiguous. `record_lag=False` keeps a
         backfill of old history from reporting as receiver lag."""
         key = canonical_series(alias)
-        n = self._shard(key).push(
+        idx = self._shard_index(key)
+        journal = self.journal
+        n = self._shards[idx].push(
             key, times, values, start=start, end=end,
             slack=self.stale_seconds,
+            journal=(
+                None
+                if journal is None
+                else lambda k, t, v, s, e: journal(idx, k, t, v, s, e)
+            ),
         )
         if n and record_lag:
             now = time.time() if now is None else now

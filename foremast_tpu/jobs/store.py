@@ -22,6 +22,7 @@ speaks the ES REST API directly (no client lib in the image).
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 from datetime import datetime, timezone
@@ -466,6 +467,18 @@ class ElasticsearchStore(JobStore):
         be expressed as an ES query. Filtered hits are simply not CASed,
         so they stay claimable for their owner; mesh workers size
         `limit` to the fleet, so one page still reaches every partition.
+
+        Contention decorrelation: the page is OVERSAMPLED (2x limit)
+        and the fresh hits are shuffled before up-to-`limit` CAS
+        attempts. Concurrent shared-nothing claimers all receive the
+        same oldest-first page; if every worker CASed its head, one
+        bulk would win the whole page and the losers' ticks would claim
+        NOTHING (a measured winner-takes-all race —
+        tests/test_multihost_worker.py). Shuffled subsets of a 2x page
+        overlap only partially, so contending workers each win a share
+        per tick. Stuck-takeover hits keep strict oldest-first priority
+        ahead of the shuffle (the starvation guarantee), and the
+        ES-side sort still bounds which docs enter the page at all.
         """
         now = time.time()
         cutoff = datetime.fromtimestamp(
@@ -475,7 +488,7 @@ class ElasticsearchStore(JobStore):
         # trips (search, bulk CAS) separate on the trace timeline, so a
         # slow claim attributes to the store, not to scoring
         query = {
-            "size": limit,
+            "size": min(2 * limit, limit + 512),
             "seq_no_primary_term": True,  # required for the CAS below
             "sort": [{"modifiedAt": {"order": "asc", "unmapped_type": "date"}}],
             "query": {
@@ -515,9 +528,27 @@ class ElasticsearchStore(JobStore):
 
         import json as _json
 
+        if len(hits) > limit:
+            # decorrelate concurrent claimers (see docstring): stuck
+            # takeovers stay strictly oldest-first, fresh hits shuffle
+            stuck = [
+                h
+                for h in hits
+                if h["_source"].get("status") in INPROGRESS_STATUSES
+            ]
+            fresh = [
+                h
+                for h in hits
+                if h["_source"].get("status") not in INPROGRESS_STATUSES
+            ]
+            random.shuffle(fresh)
+            hits = stuck + fresh
+
         lines: list[str] = []
         docs: list[Document] = []
         for h in hits:
+            if len(docs) >= limit:
+                break
             doc = Document.from_json(h["_source"])
             # partition filter BEFORE the CAS: a foreign doc must stay
             # claimable for its owner, not get parked in-progress here

@@ -257,6 +257,11 @@ class BrainWorker:
         # (claim-CAS stays the safety net against stale ring views).
         self.mesh = mesh
         self._last_tick = {"at": 0.0, "docs": 0, "fast": 0, "seconds": 0.0}
+        # Durable data plane (ISSUE 7): write-through fit journals
+        # (enable_fit_persistence) + the ring snapshotter the CLI
+        # attaches so /debug/state can report both from one place.
+        self._fit_journals: dict = {}
+        self._snapshotter = None
         # last status logged per open job (pruned on terminal): open docs
         # are re-judged every poll, and re-asserting an unchanged status
         # at INFO would flood logs at fleet scale
@@ -648,6 +653,71 @@ class BrainWorker:
             if pool is not None:
                 pool.shutdown(wait=True, cancel_futures=True)
                 setattr(self, attr, None)
+        for journal in self._fit_journals.values():
+            journal.close()
+        self._fit_journals = {}
+
+    # -- durable fit state (ISSUE 7) -------------------------------------
+
+    def enable_fit_persistence(self, directory: str) -> dict:
+        """Mount write-through fit journals under `directory`: restore
+        each cache's persisted terminal states (staged for LAZY
+        rehydration — the first claim of a document pulls its fits back
+        in, so admission passes without an HTTP history re-fetch), then
+        attach write-through so every completed fit persists the moment
+        the judge caches it. Returns per-journal restore counts.
+
+        Journaled caches: the univariate fit cache and (for seasonal
+        algorithms) its gap anchors, plus — when the judge dispatches
+        joint models — the joint entry cache and its warm metadata.
+        NOT journaled: the history cache (re-fetchable), the per-doc
+        meta cache (derived from immutable configs), and the device
+        arena (it rehydrates row-by-row from the restored fit cache,
+        which keeps persisted state bounded to fits, not device
+        buffers)."""
+        import os as _os
+
+        from foremast_tpu.models.cache import FitJournal
+
+        _os.makedirs(directory, exist_ok=True)
+        pairs = [("fits", self._fit_cache), ("gaps", self._gap_meta)]
+        if self._mvj is not None:
+            pairs += [
+                ("joint", self._mvj.cache),
+                ("jmeta", self._mvj.joint_meta),
+            ]
+        restored = {}
+        for name, cache in pairs:
+            journal = FitJournal(_os.path.join(directory, f"fit-{name}"))
+            items = journal.restore()
+            restored[name] = cache.restore_lazy(items)
+            journal.attach(cache)
+            self._fit_journals[name] = journal
+        if any(restored.values()):
+            log.info(
+                "fit persistence: restored %s from %s (lazy rehydration)",
+                restored, directory,
+            )
+        return restored
+
+    def attach_ring_snapshotter(self, snapshotter) -> None:
+        """Expose an ingest.snapshot.RingSnapshotter on /debug/state
+        and fold its cadence into the tick loop (maybe_snapshot runs in
+        `_tick_done` next to fit-journal compaction)."""
+        self._snapshotter = snapshotter
+
+    def _maybe_persist(self) -> None:
+        """Per-tick durability housekeeping: compact any fit journal
+        whose log outgrew its budget, and let the ring snapshotter
+        decide whether a snapshot pass is due. Failures are logged,
+        never allowed to fail a tick that already judged its docs."""
+        try:
+            for journal in self._fit_journals.values():
+                journal.maybe_compact()
+            if self._snapshotter is not None:
+                self._snapshotter.maybe_snapshot()
+        except Exception:  # noqa: BLE001 — durability must not kill ticks
+            log.exception("durability housekeeping failed")
 
     # -- columnar fast path ---------------------------------------------
 
@@ -1315,7 +1385,10 @@ class BrainWorker:
             )
         if not docs:
             # idle cycles still did the claim round-trip (real store I/O)
-            # and must be visible on the tick histogram
+            # and must be visible on the tick histogram; an idle WORKER
+            # is not an idle RING (receiver threads keep pushing), so
+            # snapshot cadence runs here too
+            self._maybe_persist()
             if self.metrics:
                 self.metrics.tick_seconds.observe(time.perf_counter() - t0)
             return 0
@@ -1529,6 +1602,7 @@ class BrainWorker:
         """Record the finished busy tick for /debug/state and emit one
         correlatable completion log (the tick's trace ID rides on the
         JSON record when a tracer is wired)."""
+        self._maybe_persist()
         seconds = time.perf_counter() - t0
         self._last_tick = {
             "at": time.time(),
@@ -1619,6 +1693,24 @@ class BrainWorker:
             # overlap). None until a tick exercises the slow path.
             "pipeline": (
                 dict(self._last_pipeline) if self._last_pipeline else None
+            ),
+            # durable data plane (FOREMAST_SNAPSHOT_DIR): per-journal
+            # fit persistence counters + ring snapshot cadence/restore
+            # stats; None when the worker runs ephemeral
+            "durability": (
+                {
+                    "fit_journals": {
+                        name: j.debug_state()
+                        for name, j in self._fit_journals.items()
+                    },
+                    "ring": (
+                        self._snapshotter.debug_state()
+                        if self._snapshotter is not None
+                        else None
+                    ),
+                }
+                if self._fit_journals or self._snapshotter is not None
+                else None
             ),
         }
         # registered knobs explicitly set in this process's env — with

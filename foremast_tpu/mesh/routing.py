@@ -198,6 +198,21 @@ class MeshRouter:
         return rec.ingest_address
 
 
+DEFAULT_PUSH_RETRIES = 2
+DEFAULT_PUSH_BACKOFF_SECONDS = 0.2
+DEFAULT_PUSH_BUFFER_BYTES = 4 * 1024 * 1024
+
+
+class _PushRejected(Exception):
+    """The receiver ANSWERED with an error status (400 malformed, 413
+    over the body cap): a permanent verdict on this batch, never a
+    retry/buffer candidate (see RoutingPusher._post_with_retry)."""
+
+    def __init__(self, code: int):
+        super().__init__(f"push rejected with HTTP {code}")
+        self.code = code
+
+
 class RoutingPusher:
     """A mesh-aware push client (tests, benchmarks, sidecar pushers).
 
@@ -206,14 +221,54 @@ class RoutingPusher:
     response — by the next cycle every series lands directly on its
     owner, the 'converge within one push cycle' contract the receiver's
     accept-and-hint behavior is designed for.
+
+    Receiver-restart degradation (ISSUE 7 satellite, the client half of
+    the receiver contract in docs/operations.md "Ingest plane"): a
+    failed POST retries with jittered exponential backoff (`retries`
+    attempts past the first — a worker's restart window is seconds, a
+    blind drop would cost exactly the samples the snapshot plane exists
+    to keep); past the retry budget the batch is BUFFERED and re-sent
+    at the front of the next cycle, up to `buffer_bytes` — beyond it
+    the OLDEST buffered series drop, counted on
+    ``counters["dropped_series"]``, because an unbounded buffer against
+    a receiver that never comes back is just a slower OOM. Learned
+    routes for the failed batch are forgotten either way, so the next
+    cycle falls back to a seed address and re-converges on the healed
+    ring.
     """
 
-    def __init__(self, addresses: list[str], timeout: float = 10.0):
+    def __init__(
+        self,
+        addresses: list[str],
+        timeout: float = 10.0,
+        retries: int = DEFAULT_PUSH_RETRIES,
+        backoff_seconds: float = DEFAULT_PUSH_BACKOFF_SECONDS,
+        buffer_bytes: int = DEFAULT_PUSH_BUFFER_BYTES,
+        sleep=time.sleep,
+        rng=None,
+    ):
         if not addresses:
             raise ValueError("RoutingPusher needs at least one address")
         self.addresses = list(addresses)
         self.timeout = timeout
+        self.retries = max(0, int(retries))
+        self.backoff_seconds = float(backoff_seconds)
+        self.buffer_bytes = int(buffer_bytes)
+        self._sleep = sleep
+        import random
+
+        self._rng = rng or random.Random()
         self._route: dict[str, str] = {}  # series key -> "host:port"
+        # (approx bytes, key, entry) pending re-send, oldest first
+        self._buffer: list[tuple[int, str, dict]] = []
+        self._buffer_nbytes = 0
+        self.counters = {
+            "retries": 0,
+            "buffered_series": 0,
+            "resent_series": 0,
+            "dropped_series": 0,
+            "rejected_series": 0,
+        }
 
     def _post(self, address: str, entries: list[dict]) -> dict:
         import json as _json
@@ -230,20 +285,77 @@ class RoutingPusher:
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             return _json.loads(resp.read())
 
+    def _post_with_retry(self, address: str, entries: list[dict]) -> dict | None:
+        """POST with jittered exponential backoff; None past the retry
+        budget (the caller buffers). Jitter keeps a fleet of pushers
+        retrying a restarted receiver from re-arriving in lockstep.
+
+        TRANSPORT failures (connection refused, reset, timeout — the
+        restart window) and TRANSIENT statuses (429, 5xx — a proxy
+        answering for a pod that is down, an overloaded receiver; the
+        same classification PrometheusSource retries) retry and then
+        buffer. A hard 4xx is the receiver's permanent VERDICT on this
+        batch (400 malformed, 413 over the cap — HTTPError is an
+        OSError subclass, so it must be separated explicitly):
+        retrying it would burn the backoff budget, and buffering it
+        would merge the poisoned batch into every later cycle's POST
+        until the byte cap silently dropped healthy series along with
+        it. Rejected batches are dropped and counted on
+        ``counters["rejected_series"]``."""
+        import urllib.error
+
+        for attempt in range(self.retries + 1):
+            try:
+                return self._post(address, entries)
+            except urllib.error.HTTPError as e:
+                code = e.code
+                e.close()
+                if code < 500 and code != 429:
+                    self.counters["rejected_series"] += len(entries)
+                    raise _PushRejected(code) from None
+            except OSError:
+                pass
+            if attempt == self.retries:
+                return None
+            self.counters["retries"] += 1
+            delay = self.backoff_seconds * (2.0**attempt)
+            self._sleep(delay * (0.5 + self._rng.random()))
+        return None
+
+    def _buffer_failed(self, keyed: list[tuple[str, dict]]) -> None:
+        """Keep a failed batch for the next cycle, newest-wins under
+        the byte cap: drop-OLDEST past it (the staleness cutoff would
+        reject ancient samples anyway; recent ones are the warm-fetch
+        window the restart recovery needs)."""
+        import json as _json
+
+        for key, entry in keyed:
+            nbytes = len(_json.dumps(entry))
+            self._buffer.append((nbytes, key, entry))
+            self._buffer_nbytes += nbytes
+            self.counters["buffered_series"] += 1
+        while self._buffer and self._buffer_nbytes > self.buffer_bytes:
+            old_bytes, _, _ = self._buffer.pop(0)
+            self._buffer_nbytes -= old_bytes
+            self.counters["dropped_series"] += 1
+
+    @property
+    def buffered(self) -> int:
+        return len(self._buffer)
+
     def push_cycle(
         self, series: list[tuple[str, list, list, float | None]]
     ) -> dict:
-        """One cycle: group by learned route, POST, learn hints.
-        `series` entries are (key, times, values, start|None); returns
-        {"accepted", "redirects", "errors", "by_address"}.
-
-        A failed POST (the learned owner died — the mesh's own
-        rebalance scenario) FORGETS the batch's learned routes instead
-        of raising: the next cycle falls back to a seed address, whose
-        receiver answers with the HEALED ring's owner, and the pusher
-        re-converges the same way it converged initially. Without the
-        forget, a dead address would poison every later cycle."""
+        """One cycle: re-send any buffered backlog first, group by
+        learned route, POST (with retry), learn hints. `series` entries
+        are (key, times, values, start|None); returns {"accepted",
+        "redirects", "errors", "buffered", "dropped", "by_address"}."""
         by_addr: dict[str, list[tuple[str, dict]]] = {}
+        backlog, self._buffer, self._buffer_nbytes = self._buffer, [], 0
+        self.counters["resent_series"] += len(backlog)
+        for _, key, entry in backlog:
+            addr = self._route.get(key, self.addresses[0])
+            by_addr.setdefault(addr, []).append((key, entry))
         for key, ts, vs, start in series:
             entry = {
                 "alias": key,
@@ -257,13 +369,23 @@ class RoutingPusher:
         accepted = 0
         redirected = 0
         errors = 0
+        rejected = 0
         for addr, keyed in by_addr.items():
             try:
-                body = self._post(addr, [e for _, e in keyed])
-            except OSError:
+                body = self._post_with_retry(addr, [e for _, e in keyed])
+            except _PushRejected:
+                # the receiver answered and said no (malformed batch,
+                # body over the cap): dropping is the only non-poisoning
+                # option — buffering would re-merge the rejected batch
+                # into every later cycle
+                errors += 1
+                rejected += len(keyed)
+                continue
+            if body is None:
                 errors += 1
                 for key, _ in keyed:
                     self._route.pop(key, None)
+                self._buffer_failed(keyed)
                 continue
             accepted += int(body.get("accepted_samples", 0))
             for key, owner_addr in (body.get("redirects") or {}).items():
@@ -273,5 +395,8 @@ class RoutingPusher:
             "accepted": accepted,
             "redirects": redirected,
             "errors": errors,
+            "buffered": len(self._buffer),
+            "rejected": rejected,
+            "dropped": self.counters["dropped_series"],
             "by_address": {a: len(e) for a, e in by_addr.items()},
         }

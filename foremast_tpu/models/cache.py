@@ -11,6 +11,7 @@ retraining (SURVEY.md section 5 "new build" note).
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
 from typing import Any, Hashable
@@ -18,43 +19,123 @@ from typing import Any, Hashable
 import jax
 
 
+_MISS = object()  # sentinel: "not in the restored overlay"
+
+
 class ModelCache:
-    """Thread-safe LRU of fitted model state."""
+    """Thread-safe LRU of fitted model state.
+
+    Two optional durability hooks (ISSUE 7, both wired by
+    `FitJournal`):
+
+      * ``journal`` — a write-through callback invoked AFTER every
+        mutation with the changed items (puts as ``(key, value)``,
+        deletions as ``(key, None)`` with ``deleted=True``), outside
+        the lock so journal I/O never extends lock holds on the tick
+        path;
+      * ``restore_lazy(items)`` — stages a restored dict BESIDE the
+        LRU: entries rehydrate one by one on their first lookup miss
+        (the restarted worker's first claim of each document), so a
+        restore larger than ``max_size`` never blows the LRU — only
+        the working set actually claimed re-enters the cache.
+    """
 
     def __init__(self, max_size: int = 1000):
         self.max_size = max_size
         self._d: OrderedDict[Hashable, Any] = OrderedDict()
-        self._lock = threading.Lock()
-        # bumped on every mutation (put/pop/clear/eviction, including
-        # batch forms) — lets callers cache derived views of entries and
-        # revalidate with ONE integer compare per tick instead of
-        # re-reading every key (worker admission caching)
+        # reentrant: the lazy-rehydration helper takes the lock itself
+        # so it is safe from both locked callers (get/get_many) and the
+        # lock-free peek fast path
+        self._lock = threading.RLock()
+        # bumped on every mutation (put/pop/clear/eviction/rehydrate,
+        # including batch forms) — lets callers cache derived views of
+        # entries and revalidate with ONE integer compare per tick
+        # instead of re-reading every key (worker admission caching)
         self.version = 0
+        self.journal = None  # optional write-through hook (FitJournal)
+        # restored-but-not-yet-claimed overlay; None = nothing staged,
+        # so the hot paths pay a single attribute read when durability
+        # is off
+        self._restored: dict | None = None
+
+    def restore_lazy(self, items) -> int:
+        """Stage restored entries for lazy rehydration; returns how
+        many were staged. Entries already resident (or later put) win
+        over their restored versions."""
+        with self._lock:
+            staged = {
+                k: v for k, v in dict(items).items() if k not in self._d
+            }
+            self._restored = staged if staged else None
+            self.version += 1
+            return len(staged)
+
+    def restored_pending(self) -> int:
+        with self._lock:
+            return len(self._restored) if self._restored else 0
+
+    def _rehydrate(self, key):
+        """Move one staged entry into the LRU; returns the value or
+        _MISS. Takes the (reentrant) lock itself so locked callers and
+        the peek fast path share one implementation. Deliberately NOT
+        journaled — restored entries came FROM the journal, and
+        re-appending them would double the log on every restart."""
+        with self._lock:
+            r = self._restored
+            if r is None:
+                return _MISS
+            v = r.pop(key, _MISS)
+            if not r:
+                self._restored = None
+            if v is _MISS:
+                return _MISS
+            self.version += 1
+            self._d[key] = v
+            self._d.move_to_end(key)
+            while len(self._d) > self.max_size:
+                self._d.popitem(last=False)
+            return v
 
     def get(self, key: Hashable):
         with self._lock:
-            if key not in self._d:
-                return None
-            self._d.move_to_end(key)
-            return self._d[key]
+            if key in self._d:
+                self._d.move_to_end(key)
+                return self._d[key]
+            v = self._rehydrate(key)
+            return None if v is _MISS else v
 
     def peek(self, key: Hashable):
         """Lock-free read that does NOT refresh LRU order. Safe under
         the GIL (a plain dict read); callers that rely on entries
         staying resident must pair peeks with a periodic batched
         get_many to keep the LRU honest, or size the cache for the
-        working set."""
+        working set. The restored overlay is also probed lock-free:
+        only a key ACTUALLY staged there pays the one locked
+        rehydration, so an overlay of never-again-claimed entries (a
+        restore outliving its fleet) cannot degrade the miss path of
+        every later lookup."""
         # deliberate lock-free fast path (per-tick hot lookup); the GIL
-        # makes the single dict read atomic
-        return self._d.get(key)  # foremast: ignore[lock-discipline]
+        # makes each single dict read atomic, and a racing pop from the
+        # overlay just falls through to the locked get()
+        v = self._d.get(key)  # foremast: ignore[lock-discipline]
+        if v is None:
+            r = self._restored  # foremast: ignore[lock-discipline]
+            if r is not None and key in r:
+                return self.get(key)
+        return v
 
     def put(self, key: Hashable, value) -> None:
         with self._lock:
             self.version += 1
             self._d[key] = value
             self._d.move_to_end(key)
+            if self._restored is not None:
+                # a fresh fit shadows (and must outlive) the restored one
+                self._restored.pop(key, None)
             while len(self._d) > self.max_size:
                 self._d.popitem(last=False)
+        if self.journal is not None:
+            self.journal([(key, value)])
 
     def get_many(self, keys) -> list:
         """Batched get: ONE lock acquisition for a whole tick's key list
@@ -68,20 +149,28 @@ class ModelCache:
                 if k is not None and k in d:
                     d.move_to_end(k)
                     out.append(d[k])
+                elif k is not None and self._restored is not None:
+                    v = self._rehydrate(k)
+                    out.append(None if v is _MISS else v)
                 else:
                     out.append(None)
             return out
 
     def put_many(self, items) -> None:
         """Batched put of (key, value) pairs under one lock."""
+        items = list(items)
         with self._lock:
             self.version += 1
             d = self._d
             for k, v in items:
                 d[k] = v
                 d.move_to_end(k)
+                if self._restored is not None:
+                    self._restored.pop(k, None)
             while len(d) > self.max_size:
                 d.popitem(last=False)
+        if self.journal is not None and items:
+            self.journal(items)
 
     def pop(self, key: Hashable) -> None:
         """Drop an entry if present (e.g. warmup fits that must not
@@ -89,11 +178,18 @@ class ModelCache:
         with self._lock:
             self.version += 1
             self._d.pop(key, None)
+            if self._restored is not None:
+                self._restored.pop(key, None)
+        if self.journal is not None:
+            self.journal([(key, None)], deleted=True)
 
     def clear(self) -> None:
         with self._lock:
             self.version += 1
             self._d.clear()
+            self._restored = None
+        if self.journal is not None:
+            self.journal((), cleared=True)
 
     def __len__(self) -> int:
         with self._lock:
@@ -104,6 +200,15 @@ class ModelCache:
         pod-mode leader broadcasting its restored cache."""
         with self._lock:
             return dict(self._d)
+
+    def persistable_snapshot(self) -> dict:
+        """Resident entries PLUS the not-yet-rehydrated restored
+        overlay — what a journal compaction must keep (an entry the
+        restarted worker has not claimed yet is still warm state)."""
+        with self._lock:
+            out = dict(self._restored) if self._restored else {}
+            out.update(self._d)
+            return out
 
     # -- optional durability (orbax) ------------------------------------
 
@@ -169,3 +274,189 @@ class ModelCache:
             items = pickle.load(f)
         self.put_many(items.items())
         return len(items)
+
+
+# ---------------------------------------------------------------------------
+# write-through fit persistence (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+class FitJournal:
+    """Crash-durable write-through log for one ModelCache.
+
+    Two files under the snapshot directory per journaled cache:
+    ``<base>.snap`` (a compacted pickle dict, atomic-renamed) and
+    ``<base>.log`` (crc-framed records, one per mutation batch, flushed
+    at write time — page cache survives SIGKILL). Terminal fit states
+    are appended the moment the judge `put_many`s them (write-through
+    on fit completion), so the history scan that produced them is never
+    re-paid after a restart: `restore()` loads snap + healthy log
+    prefix and the cache rehydrates entries lazily on first claim
+    (`ModelCache.restore_lazy`).
+
+    Damage tolerance mirrors the ring snapshotter: an unreadable snap
+    or a torn log tail degrades the affected entries to cold fits and
+    a `foremast_snapshot_discards` count (reasons ``fit_unreadable`` /
+    ``fit_torn``), never a crash.
+    """
+
+    def __init__(self, base_path: str, log_max_bytes: int = 8 * 1024 * 1024):
+        self.base_path = base_path
+        self.log_max_bytes = int(log_max_bytes)
+        self._lock = threading.Lock()
+        self._fh = None
+        self._log_bytes = 0
+        self._cache: "ModelCache | None" = None
+        self.counters = {
+            "appended_entries": 0,
+            "restored_entries": 0,
+            "compactions": 0,
+            "discards": {"fit_unreadable": 0, "fit_torn": 0},
+        }
+
+    @property
+    def snap_path(self) -> str:
+        return self.base_path + ".snap"
+
+    @property
+    def log_path(self) -> str:
+        return self.base_path + ".log"
+
+    # -- write side ------------------------------------------------------
+
+    def attach(self, cache: "ModelCache") -> None:
+        """Start journaling the cache's mutations. Call after
+        `restore()` — the overlay staged there must not re-journal."""
+        self._cache = cache
+        cache.journal = self.append
+
+    def append(self, items, deleted: bool = False, cleared: bool = False) -> None:
+        """The ModelCache write-through hook. Records are
+        ("put", key, value) / ("del", key) / ("clear",) tuples."""
+        import pickle
+
+        from foremast_tpu.ingest.snapshot import append_record
+
+        if cleared:
+            records = [("clear",)]
+        elif deleted:
+            records = [("del", k) for k, _ in items]
+        else:
+            records = [("put", k, v) for k, v in items]
+        with self._lock:
+            if self._fh is None:
+                d = os.path.dirname(os.path.abspath(self.log_path))
+                os.makedirs(d, exist_ok=True)
+                self._fh = open(self.log_path, "ab")
+                self._log_bytes = self._fh.tell()
+            for rec in records:
+                self._log_bytes += append_record(
+                    self._fh,
+                    pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL),
+                )
+            self.counters["appended_entries"] += len(records)
+
+    # -- restore ---------------------------------------------------------
+
+    def restore(self) -> dict:
+        """Load snap + replay the log's healthy prefix; returns the
+        merged dict (pass to `ModelCache.restore_lazy`)."""
+        import pickle
+
+        from foremast_tpu.ingest.snapshot import read_records
+
+        out: dict = {}
+        discards = {"fit_unreadable": 0, "fit_torn": 0}
+        if os.path.exists(self.snap_path):
+            try:
+                with open(self.snap_path, "rb") as fh:
+                    out.update(pickle.load(fh))
+            except Exception:  # noqa: BLE001 — torn/corrupt snap
+                discards["fit_unreadable"] += 1
+                out = {}
+        for payload, reason in read_records(self.log_path):
+            if reason is not None:
+                discards["fit_torn"] += 1
+                break
+            try:
+                rec = pickle.loads(payload)
+                if rec[0] == "put":
+                    out[rec[1]] = rec[2]
+                elif rec[0] == "del":
+                    out.pop(rec[1], None)
+                elif rec[0] == "clear":
+                    out.clear()
+            except Exception:  # noqa: BLE001 — undecodable record
+                discards["fit_torn"] += 1
+                break
+        with self._lock:
+            for k, v in discards.items():
+                self.counters["discards"][k] += v
+            self.counters["restored_entries"] = len(out)
+        return out
+
+    # -- compaction ------------------------------------------------------
+
+    def compact(self) -> int:
+        """Rewrite the snap from the cache's persistable state and
+        truncate the log; returns entries written. Crash between the
+        rename and the truncate only re-replays already-compacted
+        records (idempotent puts)."""
+        import pickle
+
+        from foremast_tpu.ingest.snapshot import atomic_write
+
+        if self._cache is None:
+            return 0
+        items = self._cache.persistable_snapshot()
+        atomic_write(
+            self.snap_path,
+            pickle.dumps(items, protocol=pickle.HIGHEST_PROTOCOL),
+        )
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+            self._fh = open(self.log_path, "wb")
+            self._log_bytes = 0
+            self.counters["compactions"] += 1
+        return len(items)
+
+    def maybe_compact(self) -> bool:
+        """Tick-cadence trigger: compact when the log outgrew its
+        budget (bounds restart replay time)."""
+        with self._lock:
+            due = self._log_bytes > self.log_max_bytes
+        if due:
+            self.compact()
+        return due
+
+    def close(self) -> None:
+        if self._cache is not None and self._cache.journal is self.append:
+            self._cache.journal = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def stats(self) -> dict:
+        """Locked copy of the counters (scrape-thread safe)."""
+        with self._lock:
+            out = dict(self.counters)
+            out["discards"] = dict(self.counters["discards"])
+            return out
+
+    def debug_state(self) -> dict:
+        with self._lock:
+            log_bytes = self._log_bytes
+            counters = dict(self.counters)
+            counters["discards"] = dict(self.counters["discards"])
+        return {
+            "appended_entries": counters["appended_entries"],
+            "restored_entries": counters["restored_entries"],
+            "restored_pending": (
+                self._cache.restored_pending() if self._cache else 0
+            ),
+            "compactions": counters["compactions"],
+            "log_bytes": log_bytes,
+            "discards": counters["discards"],
+        }

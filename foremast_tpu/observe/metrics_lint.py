@@ -49,6 +49,13 @@ ALLOWED_LABELS: dict[str, frozenset[str]] = {
     "foremast_mesh_rebalances": frozenset(),
     "foremast_mesh_redirect_hints": frozenset(),
     "foremast_mesh_claim_docs": frozenset({"result"}),
+    # durable data plane (foremast_tpu/ingest/snapshot.py SnapshotCollector)
+    "foremast_snapshot_discards": frozenset({"reason"}),
+    "foremast_snapshot_restored_series": frozenset(),
+    "foremast_snapshot_restored_samples": frozenset(),
+    "foremast_snapshot_restored_fits": frozenset(),
+    "foremast_snapshot_writes": frozenset(),
+    "foremast_snapshot_age_seconds": frozenset(),
 }
 
 

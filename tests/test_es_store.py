@@ -305,6 +305,28 @@ def test_claim_not_starved_by_inprogress_crowd_and_two_round_trips():
     assert fake.requests == 2  # one _search + one _bulk
 
 
+def test_claim_oversampled_page_still_caps_at_limit():
+    """Contention decorrelation (ISSUE 7): the claim searches a 2x page
+    and shuffles fresh hits so concurrent workers CAS mostly-disjoint
+    subsets — but it must never claim MORE than `limit` docs, and a
+    stuck takeover must still outrank every shuffled fresh hit."""
+    fake = FakeES()
+    store, _ = _store(fake)
+    for i in range(8):
+        store.create(Document(id=f"f{i}", app_name="x"))
+    store.create(Document(id="stuck", app_name="x"))
+    fake.docs["stuck"]["_source"]["status"] = STATUS_PREPROCESS_INPROGRESS
+    fake.docs["stuck"]["_source"]["modifiedAt"] = "2000-01-01T00:00:00Z"
+    got = store.claim("worker-a", max_stuck_seconds=90, limit=3)
+    assert len(got) == 3
+    assert got[0].id == "stuck"  # strict takeover priority survives
+    # the rest stay claimable for a peer
+    got2 = store.claim("worker-b", max_stuck_seconds=90, limit=64)
+    assert {d.id for d in got} | {d.id for d in got2} == (
+        {f"f{i}" for i in range(8)} | {"stuck"}
+    )
+
+
 def test_claim_prefers_oldest_docs():
     """Oldest-modified first: a stuck doc aged far in the past outranks
     fresher claimables when the page is smaller than the backlog."""

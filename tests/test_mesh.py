@@ -396,6 +396,184 @@ def test_routing_pusher_converges_in_one_cycle():
             srv.shutdown()
 
 
+def test_routing_pusher_retries_through_receiver_restart():
+    """ISSUE 7 satellite: a receiver down for restart costs the pusher
+    RETRIES (jittered backoff), not samples — the POST succeeds on a
+    later attempt within the same cycle, and nothing is buffered or
+    dropped."""
+    from foremast_tpu.ingest import RingStore, start_ingest_server
+
+    ring = RingStore(shards=1)
+    srv, _ = start_ingest_server(0, ring, host="127.0.0.1")
+    try:
+        addr = f"127.0.0.1:{srv.server_address[1]}"
+        slept = []
+        pusher = RoutingPusher(
+            [addr], retries=3, backoff_seconds=0.1,
+            sleep=slept.append,  # injected: no real waiting in tests
+        )
+        orig_post = pusher._post
+        fails = [2]  # receiver "restarting" for the first 2 attempts
+
+        def flaky(address, entries):
+            if fails[0] > 0:
+                fails[0] -= 1
+                raise OSError("connection refused (restarting)")
+            return orig_post(address, entries)
+
+        pusher._post = flaky
+        out = pusher.push_cycle(
+            [('m{app="a"}', [60, 120], [1.0, 2.0], None)]
+        )
+        assert out["accepted"] == 2 and out["errors"] == 0
+        assert out["buffered"] == 0 and out["dropped"] == 0
+        assert pusher.counters["retries"] == 2
+        # backoff grew and was jittered within [0.5, 1.5] of the base
+        assert len(slept) == 2
+        assert 0.05 <= slept[0] <= 0.15 and 0.1 <= slept[1] <= 0.3
+        assert ring.stats()["series"] == 1
+    finally:
+        srv.shutdown()
+
+
+def test_routing_pusher_buffers_and_flushes_across_outage():
+    """A receiver down PAST the retry budget buffers the cycle's series
+    (no samples lost that the cap allows keeping) and re-sends them at
+    the front of the next cycle once the receiver is back."""
+    from foremast_tpu.ingest import RingStore, start_ingest_server
+
+    ring = RingStore(shards=1)
+    srv, _ = start_ingest_server(0, ring, host="127.0.0.1")
+    try:
+        addr = f"127.0.0.1:{srv.server_address[1]}"
+        pusher = RoutingPusher(
+            [addr], retries=1, backoff_seconds=0.0, sleep=lambda s: None
+        )
+        down = [True]
+        orig_post = pusher._post
+
+        def gated(address, entries):
+            if down[0]:
+                raise OSError("connection refused")
+            return orig_post(address, entries)
+
+        pusher._post = gated
+        out = pusher.push_cycle(
+            [('m{app="a"}', [60], [1.0], None),
+             ('m{app="b"}', [60], [2.0], None)]
+        )
+        assert out["errors"] == 1 and out["buffered"] == 2
+        assert ring.stats()["series"] == 0  # receiver never saw them
+        down[0] = False  # receiver restarted
+        out2 = pusher.push_cycle([('m{app="c"}', [60], [3.0], None)])
+        assert out2["errors"] == 0 and out2["buffered"] == 0
+        assert out2["accepted"] == 3  # backlog + the new series
+        assert pusher.counters["resent_series"] == 2
+        assert ring.stats()["series"] == 3
+    finally:
+        srv.shutdown()
+
+
+def test_routing_pusher_rejected_batch_is_dropped_not_buffered():
+    """An HTTP error status is the receiver ANSWERING (400 malformed /
+    413 over cap) — a permanent verdict on the batch. It must not burn
+    retries and must NOT be buffered: re-merging a poisoned batch into
+    later cycles would get every subsequent healthy series rejected
+    alongside it."""
+    from foremast_tpu.ingest import RingStore, start_ingest_server
+
+    ring = RingStore(shards=1)
+    srv, _ = start_ingest_server(0, ring, host="127.0.0.1")
+    try:
+        addr = f"127.0.0.1:{srv.server_address[1]}"
+        slept = []
+        pusher = RoutingPusher(
+            [addr], retries=3, backoff_seconds=0.1, sleep=slept.append
+        )
+        # values that json-encode fine but fail the receiver's codec
+        # (times/values length mismatch) => a real 400 over the wire
+        bad = [('m{app="bad"}', [60, 120], [1.0], None)]
+        out = pusher.push_cycle(bad)
+        assert out["errors"] == 1 and out["rejected"] == 1
+        assert out["buffered"] == 0 and pusher.buffered == 0
+        assert slept == []  # no retry backoff burned on a verdict
+        assert pusher.counters["rejected_series"] == 1
+        # the next cycle is clean: nothing poisoned it
+        out2 = pusher.push_cycle([('m{app="ok"}', [60], [1.0], None)])
+        assert out2["accepted"] == 1 and out2["errors"] == 0
+        assert ring.stats()["series"] == 1
+    finally:
+        srv.shutdown()
+
+
+def test_routing_pusher_transient_status_retries_like_transport():
+    """429/5xx are a proxy answering for a pod that is down (or an
+    overloaded receiver) — the same transient class PrometheusSource
+    retries. They must retry with backoff and eventually land, never
+    count as a permanent rejection."""
+    import io
+    import urllib.error
+
+    from foremast_tpu.ingest import RingStore, start_ingest_server
+
+    ring = RingStore(shards=1)
+    srv, _ = start_ingest_server(0, ring, host="127.0.0.1")
+    try:
+        addr = f"127.0.0.1:{srv.server_address[1]}"
+        slept = []
+        pusher = RoutingPusher(
+            [addr], retries=3, backoff_seconds=0.1, sleep=slept.append
+        )
+        orig_post = pusher._post
+        fails = [2]
+
+        def proxied(address, entries):
+            if fails[0] > 0:
+                fails[0] -= 1
+                raise urllib.error.HTTPError(
+                    f"http://{address}", 503, "pod restarting", None,
+                    io.BytesIO(b""),
+                )
+            return orig_post(address, entries)
+
+        pusher._post = proxied
+        out = pusher.push_cycle([('m{app="a"}', [60], [1.0], None)])
+        assert out["accepted"] == 1 and out["errors"] == 0
+        assert out["rejected"] == 0 and out["buffered"] == 0
+        assert pusher.counters["retries"] == 2 and len(slept) == 2
+        assert pusher.counters["rejected_series"] == 0
+    finally:
+        srv.shutdown()
+
+
+def test_routing_pusher_buffer_cap_drops_oldest_with_counter():
+    """The outage buffer is byte-capped: past it the OLDEST series drop
+    (newest samples are what restart recovery needs) and the drop is
+    counted, never silent."""
+    pusher = RoutingPusher(
+        ["127.0.0.1:1"], retries=0, backoff_seconds=0.0,
+        sleep=lambda s: None, buffer_bytes=300,
+    )
+
+    def dead(address, entries):
+        raise OSError("connection refused")
+
+    pusher._post = dead
+    for i in range(6):
+        pusher.push_cycle([(f'm{{app="a{i}"}}', [60, 120], [1.0, 2.0], None)])
+    assert pusher.counters["dropped_series"] > 0
+    assert pusher.buffered < 6
+    kept = {key for _, key, _ in pusher._buffer}
+    assert f'm{{app="a5"}}' in kept  # newest kept
+    assert f'm{{app="a0"}}' not in kept  # oldest dropped
+    assert (
+        pusher.counters["buffered_series"]
+        == pusher.counters["dropped_series"]
+        + pusher.counters["resent_series"]
+        + pusher.buffered
+    )
+
+
 # ---------------------------------------------------------------------------
 # worker integration: debug state + observe port auto-increment
 # ---------------------------------------------------------------------------

@@ -190,6 +190,39 @@ def test_lstm_short_history_job_not_poisoned_by_long_group_peer():
     assert short_vs and all(v.verdict != scoring.UNHEALTHY for v in short_vs)
 
 
+def test_lstm_short_history_gates_to_unknown():
+    """Explicit min-history gate (ISSUE 7 satellite): a history shorter
+    than TWO training windows of the job's own bucket cannot calibrate
+    the AE's mu/sd cutoff — clean in-band noise was measured flagging
+    UNHEALTHY off the degenerate single-window fit. Such jobs must
+    degrade to UNKNOWN ("insufficient data"), while a job just past the
+    2-window floor still gets a real verdict."""
+    rng = np.random.default_rng(11)
+    cur = rng.normal(0.5, 0.05, size=(3, 12)).astype(np.float32)  # tc=16
+    # the joint detectors' calibrated threshold (benchmarks/quality.py
+    # runs them at 4 sigma; the deployed 2.0 default is the univariate
+    # tuning) — this test pins the GATE boundary, not 2-sigma noise odds
+    cfg = BrainConfig(algorithm=ALGO_LSTM)
+    cfg = dataclasses.replace(
+        cfg, anomaly=dataclasses.replace(cfg.anomaly, threshold=4.0)
+    )
+    judge = MultivariateJudge(cfg)
+    judge.lstm_steps = 30
+
+    # 30 pts < 2 * 16: gated, every alias UNKNOWN, nothing cached
+    short_h = rng.normal(0.5, 0.05, size=(3, 30)).astype(np.float32)
+    vs = judge.judge([_task("s", f"m{i}", short_h[i], cur[i]) for i in range(3)])
+    assert len(vs) == 3
+    assert all(v.verdict == scoring.UNKNOWN for v in vs)
+    assert len(judge.cache) == 0
+
+    # 64 pts >= 2 * 16: fits and judges (clean noise stays non-unhealthy)
+    ok_h = rng.normal(0.5, 0.05, size=(3, 64)).astype(np.float32)
+    vs2 = judge.judge([_task("k", f"m{i}", ok_h[i], cur[i]) for i in range(3)])
+    assert all(v.verdict != scoring.UNKNOWN for v in vs2)
+    assert all(v.verdict != scoring.UNHEALTHY for v in vs2)
+
+
 def test_lstm_mid_batch_cache_eviction_does_not_crash():
     """More distinct alias sets than max_cache_size in ONE batch must not
     lose entries before scoring."""

@@ -8,7 +8,12 @@ ties, constant runs, tiny samples — against scipy's asymptotic paths.
 from __future__ import annotations
 
 import numpy as np
+import pytest
 import scipy.stats as ss
+
+# property tests are optional-extra coverage: environments without
+# hypothesis (the baked CI image) skip instead of erroring collection
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 from foremast_tpu.ops.ranks import (

@@ -1,0 +1,562 @@
+"""Durable data plane (ISSUE 7): ring snapshot/restore, fit journals,
+torn-state degradation, and the metric families that count the damage.
+
+The contract under test: a SIGKILL can land between any two bytes of
+the on-disk state, and restore must (a) never crash, (b) serve every
+HEALTHY series/fit resident, and (c) count everything it discarded on
+`foremast_snapshot_discards{reason}` so the operator can tell a clean
+warm restart from a lossy one.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+from foremast_tpu.ingest import RingSnapshotter, RingStore, SnapshotCollector
+from foremast_tpu.ingest.snapshot import (
+    _LOG_HEADER,
+    _LOG_MAGIC,
+    append_record,
+    read_records,
+)
+from foremast_tpu.models.cache import FitJournal, ModelCache
+
+NOW = 1_760_000_000.0
+
+
+def _store(shards=4, stale=300.0):
+    return RingStore(shards=shards, stale_seconds=stale)
+
+
+def _fill(store, snap, n=10, now=NOW):
+    t = np.arange(int(now) - 600, int(now), 60, np.int64)
+    for i in range(n):
+        store.push(
+            f'm{{app="a{i}"}}',
+            t,
+            np.full(len(t), float(i), np.float32),
+            start=float(t[0]),
+            now=now,
+        )
+    return t
+
+
+# ---------------------------------------------------------------------------
+# round trips
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_restore_round_trip_serves_identical_windows(tmp_path):
+    s1 = _store()
+    snap1 = RingSnapshotter(s1, str(tmp_path), clock=lambda: NOW)
+    snap1.restore()
+    snap1.attach()
+    t = _fill(s1, snap1)
+    snap1.snapshot()
+    # pushes AFTER the snapshot land in the fresh log and must replay
+    t2 = np.arange(int(NOW), int(NOW) + 180, 60, np.int64)
+    s1.push('m{app="a0"}', t2, np.full(len(t2), 42.0, np.float32), now=NOW)
+    snap1.close()
+
+    s2 = _store()
+    snap2 = RingSnapshotter(s2, str(tmp_path), clock=lambda: NOW + 60)
+    stats = snap2.restore()
+    assert stats["restored_series"] == 10
+    assert not any(stats["discards"].values())
+    for i in range(10):
+        key = f'm{{app="a{i}"}}'
+        want = s1.query(key, float(t[0]), NOW + 180, NOW + 60)
+        got = s2.query(key, float(t[0]), NOW + 180, NOW + 60)
+        assert got[0] == want[0] == "hit"
+        np.testing.assert_array_equal(got[1], want[1])
+        np.testing.assert_array_equal(got[2], want[2])
+
+
+def test_log_only_restore_without_any_snapshot(tmp_path):
+    """A worker killed before its first snapshot pass restores from the
+    append log alone — journaling starts at attach, not at snapshot."""
+    s1 = _store()
+    snap1 = RingSnapshotter(s1, str(tmp_path), clock=lambda: NOW)
+    snap1.restore()
+    snap1.attach()
+    t = _fill(s1, snap1, n=4)
+    snap1.close()  # no snapshot() ever ran
+
+    s2 = _store()
+    snap2 = RingSnapshotter(s2, str(tmp_path), clock=lambda: NOW + 30)
+    stats = snap2.restore()
+    assert stats["restored_samples"] == 40
+    assert s2.query('m{app="a1"}', float(t[0]), float(t[-1]), NOW + 30)[0] == "hit"
+
+
+def test_restore_replays_rotated_log_after_crash_mid_snapshot(tmp_path):
+    """A crash between log rotation and snapshot rename leaves a
+    ``.log.old.<N>`` generation behind; restore must replay it (before
+    the live log) or the samples pushed since the previous snapshot are
+    lost."""
+    s1 = _store(shards=1)
+    snap1 = RingSnapshotter(s1, str(tmp_path), clock=lambda: NOW)
+    snap1.restore()
+    snap1.attach()
+    t = _fill(s1, snap1, n=3)
+    # simulate the crash window: rotate the log the way snapshot() does,
+    # then DIE before writing the snapshot file
+    rotated = snap1._logs[0].rotate()
+    assert rotated and os.path.exists(rotated)
+    snap1.close()
+
+    s2 = _store(shards=1)
+    snap2 = RingSnapshotter(s2, str(tmp_path), clock=lambda: NOW + 30)
+    stats = snap2.restore()
+    assert stats["restored_samples"] == 30
+    assert s2.query('m{app="a2"}', float(t[0]), float(t[-1]), NOW + 30)[0] == "hit"
+
+
+def test_repeated_crash_mid_snapshot_never_clobbers_earlier_rotation(tmp_path):
+    """Rotations RATCHET: a second crash-mid-snapshot (after a restart
+    that replayed but deliberately did not re-journal) must not
+    overwrite the first crash's rotated generation — both replay, in
+    order, and only a COMPLETED snapshot pass deletes them."""
+    from foremast_tpu.ingest.snapshot import rotated_logs
+
+    t = np.arange(int(NOW) - 600, int(NOW), 60, np.int64)
+    # run 1: journal one series, crash mid-snapshot (rotate, no snap)
+    s1 = _store(shards=1)
+    snap1 = RingSnapshotter(s1, str(tmp_path), clock=lambda: NOW)
+    snap1.restore()
+    snap1.attach()
+    s1.push('m{app="first"}', t, np.ones(len(t), np.float32), now=NOW)
+    snap1._logs[0].rotate()
+    snap1.close()
+
+    # run 2: restores run 1's samples (from .old.0), journals a NEW
+    # series, then crashes mid-snapshot again
+    s2 = _store(shards=1)
+    snap2 = RingSnapshotter(s2, str(tmp_path), clock=lambda: NOW + 30)
+    assert snap2.restore()["restored_series"] == 1
+    snap2.attach()
+    s2.push('m{app="second"}', t, np.ones(len(t), np.float32), now=NOW + 30)
+    snap2._logs[0].rotate()
+    snap2.close()
+    base = os.path.join(str(tmp_path), "ring-0.log")
+    assert len(rotated_logs(base)) == 2  # both generations on disk
+
+    # run 3: BOTH series must restore — run 1's samples exist in no
+    # snapshot, only in the oldest rotated generation
+    s3 = _store(shards=1)
+    snap3 = RingSnapshotter(s3, str(tmp_path), clock=lambda: NOW + 60)
+    stats = snap3.restore()
+    assert stats["restored_series"] == 2
+    for app in ("first", "second"):
+        q = s3.query(f'm{{app="{app}"}}', float(t[0]), float(t[-1]), NOW + 60)
+        assert q[0] == "hit", app
+    # a COMPLETED pass finally clears the backlog
+    snap3.snapshot()
+    assert rotated_logs(base) == []
+    snap3.close()
+
+
+# ---------------------------------------------------------------------------
+# torn-state degradation (the ISSUE 7 satellite matrix)
+# ---------------------------------------------------------------------------
+
+
+def _snap_files(tmp_path):
+    return sorted(
+        str(p) for p in tmp_path.iterdir() if p.name.endswith(".snap.npz")
+    )
+
+
+def test_truncated_snapshot_file_degrades_that_shard_only(tmp_path):
+    s1 = _store(shards=2)
+    snap1 = RingSnapshotter(s1, str(tmp_path), clock=lambda: NOW)
+    snap1.restore()
+    snap1.attach()
+    _fill(s1, snap1, n=12)
+    snap1.snapshot()
+    snap1.close()
+    # truncate ONE shard's snapshot mid-file (the logs were rotated
+    # away by snapshot(), so nothing can paper over the damage)
+    files = _snap_files(tmp_path)
+    raw = open(files[0], "rb").read()
+    with open(files[0], "wb") as fh:
+        fh.write(raw[: len(raw) // 2])
+
+    s2 = _store(shards=2)
+    snap2 = RingSnapshotter(s2, str(tmp_path), clock=lambda: NOW + 30)
+    stats = snap2.restore()
+    assert stats["discards"]["unreadable"] == 1
+    # the OTHER shard's series all restored; no crash anywhere
+    assert 0 < stats["restored_series"] < 12
+
+
+def test_version_mismatched_snapshot_header_is_discarded(tmp_path):
+    s1 = _store(shards=1)
+    snap1 = RingSnapshotter(s1, str(tmp_path), clock=lambda: NOW)
+    snap1.restore()
+    snap1.attach()
+    _fill(s1, snap1, n=3)
+    snap1.snapshot()
+    snap1.close()
+    path = _snap_files(tmp_path)[0]
+    with np.load(path, allow_pickle=False) as z:
+        arrays = {name: z[name] for name in z.files}
+    arrays["version"] = np.asarray([999], np.int64)
+    np.savez(path.replace(".npz", ""), **arrays)
+
+    s2 = _store(shards=1)
+    snap2 = RingSnapshotter(s2, str(tmp_path), clock=lambda: NOW + 30)
+    stats = snap2.restore()
+    assert stats["discards"]["version"] == 1
+    assert stats["restored_series"] == 0  # format unknown: trust nothing
+
+
+def test_torn_append_log_tail_replays_healthy_prefix(tmp_path):
+    s1 = _store(shards=1)
+    snap1 = RingSnapshotter(s1, str(tmp_path), clock=lambda: NOW)
+    snap1.restore()
+    snap1.attach()
+    t = _fill(s1, snap1, n=5)
+    snap1.close()
+    log_path = os.path.join(str(tmp_path), "ring-0.log")
+    raw = open(log_path, "rb").read()
+    with open(log_path, "wb") as fh:
+        fh.write(raw[:-7])  # cut mid-record: the SIGKILL tail
+
+    s2 = _store(shards=1)
+    snap2 = RingSnapshotter(s2, str(tmp_path), clock=lambda: NOW + 30)
+    stats = snap2.restore()
+    assert stats["discards"]["torn_log"] == 1
+    assert stats["restored_series"] == 4  # prefix intact, tail cold
+    assert s2.query('m{app="a0"}', float(t[0]), float(t[-1]), NOW + 30)[0] == "hit"
+    assert s2.query('m{app="a4"}', float(t[0]), float(t[-1]), NOW + 30)[0] == "miss"
+
+
+def test_mid_record_garbage_does_not_resync_later_frames(tmp_path):
+    """A corrupted length field would desync every later frame — the
+    reader must stop at the first bad frame, not invent records."""
+    path = os.path.join(str(tmp_path), "x.log")
+    with open(path, "wb") as fh:
+        append_record(fh, b"good-1")
+        fh.write(_LOG_HEADER.pack(_LOG_MAGIC, 10_000_000, 0))
+        fh.write(b"\x00" * 64)
+        append_record(fh, b"good-2-unreachable")
+    got = list(read_records(path))
+    assert got[0] == (b"good-1", None)
+    assert got[1] == (None, "torn_log")
+    assert len(got) == 2
+
+
+def test_snapshot_mid_eviction_broken_series_degrades_per_series(tmp_path):
+    """A snapshot carrying one inconsistent series (the mid-eviction /
+    external-corruption shape: arrays missing or length-mismatched)
+    restores the healthy rest and counts exactly the broken one."""
+    s1 = _store(shards=1)
+    snap1 = RingSnapshotter(s1, str(tmp_path), clock=lambda: NOW)
+    snap1.restore()
+    snap1.attach()
+    t = _fill(s1, snap1, n=4)
+    snap1.snapshot()
+    snap1.close()
+    path = _snap_files(tmp_path)[0]
+    with np.load(path, allow_pickle=False) as z:
+        arrays = {name: z[name] for name in z.files}
+    # series 1 loses its value column; series 2's columns disagree
+    del arrays["v1"]
+    arrays["t2"] = arrays["t2"][:-3]
+    np.savez(path.replace(".npz", ""), **arrays)
+
+    s2 = _store(shards=1)
+    snap2 = RingSnapshotter(s2, str(tmp_path), clock=lambda: NOW + 30)
+    stats = snap2.restore()
+    assert stats["discards"]["series"] == 2
+    assert stats["restored_series"] == 2
+    assert s2.query('m{app="a0"}', float(t[0]), float(t[-1]), NOW + 30)[0] == "hit"
+    assert s2.query('m{app="a1"}', float(t[0]), float(t[-1]), NOW + 30)[0] == "miss"
+
+
+def test_log_replay_applies_the_age_cutoff_too(tmp_path):
+    """A worker killed before its first snapshot pass restores from the
+    log alone — the age cutoff must apply THERE as well, or week-old
+    series resurrect through the log and LRU-evict fresh state (the
+    exact shadowing the knob's contract forbids)."""
+    s1 = _store(shards=1)
+    snap1 = RingSnapshotter(s1, str(tmp_path), clock=lambda: NOW)
+    snap1.restore()
+    snap1.attach()
+    old_t = np.arange(int(NOW) - 9 * 86_400, int(NOW) - 9 * 86_400 + 300,
+                      60, np.int64)
+    s1.push('m{app="ancient"}', old_t, np.ones(len(old_t), np.float32),
+            now=NOW, record_lag=False)
+    fresh_t = _fill(s1, snap1, n=1)
+    snap1.close()  # no snapshot: log-only restore
+
+    s2 = _store(shards=1)
+    snap2 = RingSnapshotter(
+        s2, str(tmp_path), max_age_seconds=86_400.0, clock=lambda: NOW + 60
+    )
+    stats = snap2.restore()
+    assert stats["restored_series"] == 1
+    assert stats["discards"]["stale"] == 1
+    assert (
+        s2.query('m{app="a0"}', float(fresh_t[0]), float(fresh_t[-1]),
+                 NOW + 60)[0]
+        == "hit"
+    )
+    assert s2.query('m{app="ancient"}', None, None, NOW + 60)[0] == "miss"
+
+
+def test_snapshot_dir_exclusivity_flock(tmp_path):
+    """Two LIVE processes must not share one snapshot directory (torn
+    interleaved frames, one mesh identity). The advisory flock refuses
+    the second holder and releases on close — the restart-after-SIGKILL
+    case, where the kernel drops the dead process's lock."""
+    from foremast_tpu.ingest import lock_snapshot_dir
+
+    first = lock_snapshot_dir(str(tmp_path))
+    assert first is not None
+    # flock is per open-file-description: a second open conflicts even
+    # in-process, standing in for the concurrent-worker case
+    assert lock_snapshot_dir(str(tmp_path)) is None
+    first.close()  # the holder died/exited: next worker acquires
+    again = lock_snapshot_dir(str(tmp_path))
+    assert again is not None
+    again.close()
+
+
+def test_restore_age_cutoff_discards_ancient_series(tmp_path):
+    s1 = _store(shards=1)
+    snap1 = RingSnapshotter(s1, str(tmp_path), clock=lambda: NOW)
+    snap1.restore()
+    snap1.attach()
+    _fill(s1, snap1, n=2)
+    snap1.snapshot()
+    snap1.close()
+
+    s2 = _store(shards=1)
+    week_later = NOW + 7 * 86_400
+    snap2 = RingSnapshotter(
+        s2, str(tmp_path), max_age_seconds=86_400.0,
+        clock=lambda: week_later,
+    )
+    stats = snap2.restore()
+    assert stats["restored_series"] == 0
+    assert stats["discards"]["stale"] == 2
+
+
+def test_restore_across_a_shard_count_change(tmp_path):
+    """Files written under FOREMAST_INGEST_SHARDS=4 must fully restore
+    into a 2-shard store (and vice versa): replay re-hashes keys
+    through the production push path, so restore walks every shard
+    index present ON DISK, not just the current count — retuning
+    shards across a restart must never silently drop durable state."""
+    s1 = _store(shards=4)
+    snap1 = RingSnapshotter(s1, str(tmp_path), clock=lambda: NOW)
+    snap1.restore()
+    snap1.attach()
+    t = _fill(s1, snap1, n=12)
+    snap1.snapshot()
+    # post-snapshot pushes land in the 4 per-shard logs too
+    t2 = np.arange(int(NOW), int(NOW) + 120, 60, np.int64)
+    s1.push('m{app="a7"}', t2, np.full(len(t2), 9.0, np.float32), now=NOW)
+    snap1.close()
+
+    s2 = _store(shards=2)
+    snap2 = RingSnapshotter(s2, str(tmp_path), clock=lambda: NOW + 30)
+    stats = snap2.restore()
+    assert stats["restored_series"] == 12
+    assert not any(stats["discards"].values())
+    st, tt, vv = s2.query('m{app="a7"}', float(t[0]), NOW + 120, NOW + 30)
+    assert st == "hit" and vv[-1] == 9.0
+    snap2.close()
+
+
+def test_maybe_snapshot_cadence_interval_and_log_budget(tmp_path):
+    s1 = _store(shards=1)
+    clock = [NOW]
+    snap1 = RingSnapshotter(
+        s1, str(tmp_path), interval_seconds=60.0, log_max_bytes=200,
+        clock=lambda: clock[0],
+    )
+    snap1.restore()
+    snap1.attach()
+    assert snap1.maybe_snapshot()  # first call: interval since epoch 0
+    assert not snap1.maybe_snapshot()  # fresh, small log: not due
+    clock[0] = NOW + 61
+    assert snap1.maybe_snapshot()  # interval elapsed
+    clock[0] = NOW + 62
+    _fill(s1, snap1, n=4)  # blows the 200-byte log budget
+    assert snap1.maybe_snapshot()
+    assert snap1.counters["snapshots"] == 3
+    snap1.close()
+
+
+# ---------------------------------------------------------------------------
+# fit journal + lazy rehydration
+# ---------------------------------------------------------------------------
+
+
+def test_fit_journal_write_through_restore_and_lazy_rehydrate(tmp_path):
+    cache = ModelCache(max_size=8)
+    j = FitJournal(str(tmp_path / "fit-uni"))
+    cache.restore_lazy(j.restore())
+    j.attach(cache)
+    season = np.arange(5, dtype=np.float32)
+    cache.put(("ma", 24, "k1"), (1.0, 0.0, season, 0, 0.1, 100))
+    cache.put_many([(("ma", 24, f"k{i}"), (float(i), 0.0, season, 0, 0.1, 100))
+                    for i in range(2, 5)])
+    cache.pop(("ma", 24, "k2"))  # tombstone must survive restart
+    j.close()
+
+    cache2 = ModelCache(max_size=8)
+    j2 = FitJournal(str(tmp_path / "fit-uni"))
+    items = j2.restore()
+    assert set(k[2] for k in items) == {"k1", "k3", "k4"}
+    staged = cache2.restore_lazy(items)
+    j2.attach(cache2)
+    assert staged == 3
+    assert len(cache2) == 0  # nothing resident until first lookup
+    v0 = cache2.version
+    # peek (the worker's admission path) rehydrates lazily + bumps the
+    # version so admission tokens revalidate
+    entry = cache2.peek(("ma", 24, "k1"))
+    assert entry is not None and entry[0] == 1.0
+    np.testing.assert_array_equal(entry[2], season)
+    assert cache2.version > v0
+    assert len(cache2) == 1 and cache2.restored_pending() == 2
+    # identity stability: the rehydrated object IS the cached object
+    assert cache2.peek(("ma", 24, "k1")) is entry
+    assert cache2.get(("ma", 24, "k2")) is None  # tombstoned
+    j2.close()
+
+
+def test_fit_journal_torn_tail_and_unreadable_snap_degrade(tmp_path):
+    cache = ModelCache(max_size=8)
+    j = FitJournal(str(tmp_path / "fit-x"))
+    j.attach(cache)
+    cache.put("a", 1)
+    cache.put("b", 2)
+    j.compact()  # snap holds {a, b}; log fresh
+    cache.put("c", 3)
+    j.close()
+    # tear the log tail: c is lost, a/b survive via the snap
+    raw = open(j.log_path, "rb").read()
+    with open(j.log_path, "wb") as fh:
+        fh.write(raw[:-3])
+    j2 = FitJournal(str(tmp_path / "fit-x"))
+    items = j2.restore()
+    assert items == {"a": 1, "b": 2}
+    assert j2.counters["discards"]["fit_torn"] == 1
+    # now corrupt the snap too: everything degrades to cold, no crash
+    with open(j2.snap_path, "wb") as fh:
+        fh.write(b"\x80\x04notpickle")
+    j3 = FitJournal(str(tmp_path / "fit-x"))
+    items3 = j3.restore()
+    assert items3 == {}
+    assert j3.counters["discards"]["fit_unreadable"] == 1
+
+
+def test_fit_journal_compaction_preserves_unclaimed_restored_entries(tmp_path):
+    """Compaction must persist the LAZY overlay too — an entry the
+    restarted worker has not claimed yet is still warm state the NEXT
+    restart deserves."""
+    cache = ModelCache(max_size=8)
+    j = FitJournal(str(tmp_path / "fit-y"))
+    j.attach(cache)
+    cache.put_many([("a", 1), ("b", 2)])
+    j.close()
+
+    cache2 = ModelCache(max_size=8)
+    j2 = FitJournal(str(tmp_path / "fit-y"))
+    cache2.restore_lazy(j2.restore())
+    j2.attach(cache2)
+    assert cache2.get("a") == 1  # claim a; b stays staged
+    n = j2.compact()
+    assert n == 2  # resident a AND staged b
+    j2.close()
+    j3 = FitJournal(str(tmp_path / "fit-y"))
+    assert j3.restore() == {"a": 1, "b": 2}
+
+
+def test_model_cache_lazy_overlay_respects_puts_and_capacity(tmp_path):
+    cache = ModelCache(max_size=2)
+    assert cache.restore_lazy({"a": 1, "b": 2, "c": 3, "d": 4}) == 4
+    # a fresh fit shadows its restored version permanently
+    cache.put("a", 99)
+    assert cache.get("a") == 99
+    # rehydration respects LRU capacity (never balloons past max_size)
+    assert cache.get("b") == 2 and cache.get("c") == 3 and cache.get("d") == 4
+    assert len(cache) == 2
+    # get_many pulls from the overlay too
+    cache2 = ModelCache(max_size=8)
+    cache2.restore_lazy({"x": 7})
+    assert cache2.get_many(["x", "y", None]) == [7, None, None]
+
+
+# ---------------------------------------------------------------------------
+# exposition
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_collector_families_and_lint(tmp_path):
+    from prometheus_client import CollectorRegistry
+
+    from foremast_tpu.observe.metrics_lint import lint_registry
+
+    s1 = _store(shards=1)
+    snap1 = RingSnapshotter(s1, str(tmp_path), clock=lambda: NOW)
+    snap1.restore()
+    snap1.attach()
+    _fill(s1, snap1, n=2)
+    snap1.snapshot()
+
+    cache = ModelCache(max_size=8)
+    j = FitJournal(str(tmp_path / "fit-z"))
+    cache.restore_lazy(j.restore())
+    j.attach(cache)
+    cache.put("k", 1)
+
+    reg = CollectorRegistry()
+    reg.register(SnapshotCollector(snap1, journals=[j]))
+    assert lint_registry(reg) == []
+    assert reg.get_sample_value("foremast_snapshot_writes_total") == 1.0
+    assert (
+        reg.get_sample_value(
+            "foremast_snapshot_discards_total", {"reason": "torn_log"}
+        )
+        == 0.0
+    )
+    assert reg.get_sample_value("foremast_snapshot_restored_series") == 0.0
+    age = reg.get_sample_value("foremast_snapshot_age_seconds")
+    assert age is not None and age >= 0.0
+    snap1.close()
+    j.close()
+
+
+def test_worker_debug_state_durability_section(tmp_path):
+    from foremast_tpu.config import BrainConfig
+    from foremast_tpu.jobs.store import InMemoryStore
+    from foremast_tpu.jobs.worker import BrainWorker
+    from foremast_tpu.metrics.source import StaticSource
+
+    worker = BrainWorker(
+        InMemoryStore(),
+        StaticSource({}),
+        config=BrainConfig(algorithm="moving_average_all"),
+        worker_id="dbg",
+    )
+    assert worker.debug_state()["durability"] is None
+    worker.enable_fit_persistence(str(tmp_path))
+    ring = _store(shards=1)
+    snap = RingSnapshotter(ring, str(tmp_path), clock=lambda: NOW)
+    worker.attach_ring_snapshotter(snap)
+    state = worker.debug_state()["durability"]
+    assert set(state["fit_journals"]) >= {"fits", "gaps"}
+    assert state["ring"]["directory"] == str(tmp_path)
+    worker.close()
+    snap.close()
