@@ -6,6 +6,14 @@ PY ?= python
 test:
 	$(PY) -m pytest tests/ -q
 
+# fast tier-1 slice (skips @slow): the test half of `make ci`
+test-fast:
+	$(PY) -m pytest tests/ -q -m 'not slow'
+
+# the whole gate in one command: every static contract, then the fast
+# tier-1 tests (docs/static-analysis.md, CONTRIBUTING.md)
+ci: check test-fast
+
 bench:
 	$(PY) bench.py
 
@@ -62,6 +70,17 @@ metrics-lint: check
 env-docs:
 	$(PY) -m foremast_tpu.analysis --update-env-docs
 
+# regenerate the metric-family index in docs/observability.md from
+# observe/metrics_lint.py's registry (rule: metrics-contract)
+metrics-docs:
+	$(PY) -m foremast_tpu.analysis --update-metrics-docs
+
+# recompute + commit the static lock-acquisition graph
+# (analysis_lockgraph.json; rule: lock-order — `make check` fails when
+# the committed artifact drifts from the computed graph)
+lockgraph:
+	$(PY) -m foremast_tpu.analysis --write-lockgraph
+
 docker-build:
 	docker build -t foremast/foremast-tpu:0.1.0 .
 
@@ -69,4 +88,4 @@ clean:
 	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 
-.PHONY: test bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest bench-scaleout bench-restart native deploy-render check metrics-lint env-docs docker-build clean
+.PHONY: test test-fast ci bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest bench-scaleout bench-restart native deploy-render check metrics-lint env-docs metrics-docs lockgraph docker-build clean

@@ -23,7 +23,14 @@ letting new ones in. The runner folds in the metric naming lint
 exits non-zero on any new finding, which a tier-1 test enforces.
 
 Rules: jit-hygiene, async-blocking, lock-discipline, env-contract,
-metrics-lint. See docs/static-analysis.md.
+metrics-contract (per-module); lock-order, thread-escape,
+blocking-under-lock (whole-program, over `interproc.Program`'s
+cross-module call resolution — they model the threaded data plane the
+per-class rules cannot see); metrics-lint (registry fold-in). The
+static lock-acquisition graph is committed as
+``analysis_lockgraph.json`` and cross-checked at runtime by
+``analysis/witness.py`` (FOREMAST_LOCK_WITNESS). See
+docs/static-analysis.md.
 """
 
 from __future__ import annotations
@@ -41,17 +48,23 @@ from foremast_tpu.analysis.core import (
 
 
 def all_checkers() -> list[Checker]:
-    """One instance of every AST checker, in report order."""
+    """One instance of every per-module AST checker, in report order.
+    The whole-program concurrency rules (lock-order, thread-escape,
+    blocking-under-lock) live outside this list — they need the
+    complete package and run from the default full scan only
+    (`__main__.program_findings`)."""
     from foremast_tpu.analysis.async_blocking import AsyncBlockingChecker
     from foremast_tpu.analysis.env_contract import EnvContractChecker
     from foremast_tpu.analysis.jit_hygiene import JitHygieneChecker
     from foremast_tpu.analysis.lock_discipline import LockDisciplineChecker
+    from foremast_tpu.analysis.metrics_contract import MetricsContractChecker
 
     return [
         JitHygieneChecker(),
         AsyncBlockingChecker(),
         LockDisciplineChecker(),
         EnvContractChecker(),
+        MetricsContractChecker(),
     ]
 
 

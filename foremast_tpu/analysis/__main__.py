@@ -5,6 +5,14 @@ usage. Folds in the metric naming lint (observe/metrics_lint.py) so ONE
 command gates every machine-checked contract; `--write-baseline`
 snapshots today's findings as grandfathered debt (the committed
 `analysis_baseline.json` should only ever shrink).
+
+The default (no-paths) run is the full gate: per-module rules over the
+package + benchmarks/ + tests/, the whole-program concurrency rules
+(lock-order / thread-escape / blocking-under-lock — they need the
+complete package, so explicit path runs skip them), and the three
+generated-artifact contracts (env table, metric-family table, lock
+graph). `--sarif` emits SARIF 2.1.0 for code-review UIs; `make ci`
+chains this gate with the fast tier-1 tests.
 """
 
 from __future__ import annotations
@@ -48,18 +56,105 @@ def metrics_lint_findings() -> list[Finding]:
     ]
 
 
+def program_findings(root: str, modules) -> list[Finding]:
+    """The whole-program concurrency rules (full-scan only): static
+    lock graph + cycle/staleness gate, thread-escape, and
+    blocking-under-lock, with per-line suppressions applied."""
+    from foremast_tpu.analysis.blocking_under_lock import (
+        apply_suppressions,
+        check_blocking_under_lock,
+    )
+    from foremast_tpu.analysis.interproc import Program
+    from foremast_tpu.analysis.lock_order import check_lock_order
+    from foremast_tpu.analysis.thread_escape import check_thread_escape
+
+    pkg = [m for m in modules if m.relpath.startswith("foremast_tpu/")]
+    program = Program(pkg)
+    findings = (
+        check_lock_order(root, program)
+        + check_thread_escape(program)
+        + check_blocking_under_lock(program)
+    )
+    return apply_suppressions(findings, pkg)
+
+
+def to_sarif(new: list[Finding], grandfathered: list[Finding]) -> dict:
+    """SARIF 2.1.0: new findings as error-level results, grandfathered
+    ones carried with an `accepted` suppression so viewers can show
+    (but not gate on) the known debt."""
+    rules = sorted({f.rule for f in [*new, *grandfathered]})
+
+    def result(f: Finding, suppressed: bool) -> dict:
+        out = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message + (f" — {f.hint}" if f.hint else "")},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {"startLine": max(1, f.line)},
+                    }
+                }
+            ],
+            "fingerprints": {"foremastCheck/v1": f.fingerprint()},
+        }
+        if suppressed:
+            out["suppressions"] = [
+                {
+                    "kind": "external",
+                    "status": "accepted",
+                    "justification": f"grandfathered in {BASELINE_NAME}",
+                }
+            ]
+        return out
+
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "foremast-check",
+                        "informationUri": "docs/static-analysis.md",
+                        "rules": [{"id": r} for r in rules],
+                    }
+                },
+                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+                "results": [result(f, False) for f in new]
+                + [result(f, True) for f in grandfathered],
+            }
+        ],
+    }
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m foremast_tpu.analysis",
         description="foremast-check: jit-hygiene, async-blocking, "
-        "lock-discipline, env-contract, metrics-lint",
+        "lock-discipline, env-contract, metrics-contract, lock-order, "
+        "thread-escape, blocking-under-lock, metrics-lint",
     )
     p.add_argument(
         "paths",
         nargs="*",
-        help="files/directories to scan (default: the foremast_tpu package)",
+        help="files/directories to scan (default: foremast_tpu + "
+        "benchmarks + tests; whole-program rules need the default scan)",
     )
     p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--sarif",
+        action="store_true",
+        help="emit SARIF 2.1.0 on stdout (new findings as results, "
+        "baselined ones as accepted suppressions)",
+    )
     p.add_argument(
         "--baseline",
         default=None,
@@ -80,6 +175,18 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="regenerate the env-knob table in docs/operations.md and exit",
     )
+    p.add_argument(
+        "--update-metrics-docs",
+        action="store_true",
+        help="regenerate the metric-family table in docs/observability.md "
+        "and exit",
+    )
+    p.add_argument(
+        "--write-lockgraph",
+        action="store_true",
+        help="recompute the static lock-acquisition graph, write "
+        "analysis_lockgraph.json, and exit",
+    )
     return p
 
 
@@ -94,12 +201,51 @@ def main(argv: list[str] | None = None) -> int:
             else "env docs already in sync"
         )
         return 0
+    if args.update_metrics_docs:
+        from foremast_tpu.analysis.metrics_contract import update_metrics_docs
+
+        changed = update_metrics_docs(root)
+        print(
+            "metric-family docs regenerated"
+            if changed
+            else "metric-family docs already in sync"
+        )
+        return 0
+    if args.write_lockgraph:
+        from foremast_tpu.analysis.interproc import Program
+        from foremast_tpu.analysis.lock_order import (
+            GRAPH_NAME,
+            build_graph,
+            write_graph,
+        )
+
+        pkg = [
+            m
+            for m in collect_modules(root)
+            if m.relpath.startswith("foremast_tpu/")
+        ]
+        graph = build_graph(Program(pkg))
+        write_graph(root, graph)
+        print(
+            f"wrote {GRAPH_NAME}: {len(graph['nodes'])} lock(s), "
+            f"{len(graph['edges'])} edge(s)"
+        )
+        return 0
 
     modules = collect_modules(root, args.paths or None)
     findings = analyze_modules(modules, all_checkers())
     if not args.paths:
-        # repo-level contracts only make sense on the default full scan
+        # repo-level + whole-program contracts only make sense on the
+        # default full scan
+        from foremast_tpu.analysis.metrics_contract import (
+            check_metrics_docs,
+            check_registry_coverage,
+        )
+
         findings.extend(check_env_docs(root))
+        findings.extend(check_metrics_docs(root))
+        findings.extend(check_registry_coverage(modules))
+        findings.extend(program_findings(root, modules))
         if not args.no_metrics_lint:
             findings.extend(metrics_lint_findings())
     findings.sort(key=Finding.sort_key)
@@ -117,7 +263,10 @@ def main(argv: list[str] | None = None) -> int:
     new, grandfathered = baseline.split(findings)
     stale = baseline.stale(findings)
 
-    if args.json:
+    if args.sarif:
+        json.dump(to_sarif(new, grandfathered), sys.stdout, indent=2)
+        print()
+    elif args.json:
         json.dump(
             {
                 "findings": [f.to_json() for f in new],

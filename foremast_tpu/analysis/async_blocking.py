@@ -87,6 +87,7 @@ def _store_receiver(node: ast.AST) -> str | None:
 class AsyncBlockingChecker(Checker):
     rule = "async-blocking"
     description = "blocking calls inside async def bodies (event-loop stalls)"
+    scope = "repo"  # async test/bench helpers stall loops just as well
 
     def check(self, module: Module) -> list[Finding]:
         findings: list[Finding] = []

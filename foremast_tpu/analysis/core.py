@@ -21,9 +21,12 @@ from typing import Iterable, Sequence
 # `# foremast: ignore` (all rules). Valid on the finding's line or on a
 # comment-only line directly above it — suppressions live next to the
 # code they excuse, so a refactor that moves the code moves (or drops)
-# the excuse with it.
+# the excuse with it. Whitespace is tolerated anywhere in the form:
+# `ignore [rule]` used to silently degrade to the bare suppress-ALL
+# (the bracket list failed to parse), which is the dangerous direction
+# — a regression test pins the multi-rule and spaced forms.
 _SUPPRESS_RE = re.compile(
-    r"#\s*foremast:\s*ignore(?:\[(?P<rules>[a-z0-9_,\- ]+)\])?"
+    r"#\s*foremast:\s*ignore(?:\s*\[(?P<rules>[a-z0-9_,\- ]+)\])?"
 )
 _ALL_RULES = "*"
 
@@ -127,8 +130,18 @@ class Checker:
 
     rule: str = ""
     description: str = ""
+    # "package": product sources only — tests/ and benchmarks/ are
+    # excluded (fixture paths and ad-hoc files still count).
+    # "repo": the rule also runs over tests/ and benchmarks/ (the
+    # async-blocking and env-contract contracts hold there too: bench
+    # scripts read knobs, test helpers run on event loops).
+    scope: str = "package"
 
     def applies_to(self, relpath: str) -> bool:
+        if self.scope == "package" and relpath.startswith(
+            ("tests/", "benchmarks/")
+        ):
+            return False
         return True
 
     def check(self, module: Module) -> list[Finding]:  # pragma: no cover
@@ -161,9 +174,18 @@ def collect_modules(
     root: str, paths: Sequence[str] | None = None
 ) -> list[Module]:
     """Parse every .py file under `paths` (default: the foremast_tpu
-    package). Files that fail to parse surface as a synthetic finding
-    from `analyze_modules`, not a crash."""
-    targets = list(paths) if paths else [os.path.join(root, "foremast_tpu")]
+    package plus benchmarks/ and tests/ — repo-scoped rules cover
+    those, package-scoped rules skip them via `Checker.applies_to`).
+    Files that fail to parse surface as a synthetic finding from
+    `analyze_modules`, not a crash."""
+    if paths:
+        targets = list(paths)
+    else:
+        targets = [os.path.join(root, "foremast_tpu")]
+        for extra in ("benchmarks", "tests"):
+            d = os.path.join(root, extra)
+            if os.path.isdir(d):
+                targets.append(d)
     files: list[str] = []
     for target in targets:
         if os.path.isfile(target):

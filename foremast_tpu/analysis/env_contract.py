@@ -56,6 +56,9 @@ class EnvContractChecker(Checker):
     description = (
         "os.environ reads outside config.py must name a registered knob"
     )
+    # bench scripts and test helpers read knobs too — an unregistered
+    # read there is config surface operators can't enumerate either
+    scope = "repo"
 
     # the registry module itself, and the analysis package (which would
     # otherwise flag its own documentation strings' AST fixtures)
@@ -71,7 +74,7 @@ class EnvContractChecker(Checker):
         return self._names
 
     def applies_to(self, relpath: str) -> bool:
-        return relpath not in self.EXEMPT
+        return super().applies_to(relpath) and relpath not in self.EXEMPT
 
     def check(self, module: Module) -> list[Finding]:
         # bare `environ`/`getenv` only count when actually imported from

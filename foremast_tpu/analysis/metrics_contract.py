@@ -1,0 +1,289 @@
+"""metrics-contract: constructed families, the lint registry, and the
+docs can never drift.
+
+Before this rule there were THREE places a metric family lived — the
+constructor call in code, the `observe/metrics_lint.py` registry
+(`ALLOWED_LABELS`, which pins label sets), and the hand-written tables
+in `docs/observability.md` — and nothing tied them together: the
+PR-3 pipeline families shipped constructed-but-unregistered, so their
+label sets were never checked (this rule's first real catch; they are
+registered now).
+
+The contract, machine-checked on every `make check`:
+
+  1. every LITERAL ``foremast*`` family name passed to a metric
+     constructor (`Counter`/`Gauge`/`Histogram`/`*MetricFamily`/the
+     `counter()` helper) anywhere in the package must appear in
+     `ALLOWED_LABELS` (collected names — a counter's ``_total`` suffix
+     is stripped) AND carry a one-line meaning in `FAMILY_DOCS`;
+  2. every registry entry must be constructed somewhere (or be
+     declared in `DYNAMIC_FAMILIES` — names built with f-strings, like
+     the gauge-family drop counter) — the registry shrinks when code
+     does;
+  3. the "family index" table in `docs/observability.md` between the
+     markers below is GENERATED from the registry (`make metrics-docs`
+     / ``--update-metrics-docs``) and a stale committed table is a
+     finding — the same mechanism as `make env-docs`.
+
+The per-series model-output gauges (``foremastbrain_<series>_upper``
+etc.) are name-templated per job config and stay outside the registry
+on purpose; `BrainGauges` builds them with f-strings, so rule 1 never
+sees them.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from foremast_tpu.analysis.core import Checker, Finding, Module
+
+RULE = "metrics-contract"
+
+DOCS_RELPATH = "docs/observability.md"
+DOCS_BEGIN = "<!-- BEGIN METRIC FAMILIES (generated: make metrics-docs) -->"
+DOCS_END = "<!-- END METRIC FAMILIES -->"
+
+_CONSTRUCTORS = frozenset(
+    {
+        "Counter",
+        "Gauge",
+        "Histogram",
+        "Summary",
+        "Info",
+        "Enum",
+        "CounterMetricFamily",
+        "GaugeMetricFamily",
+        "HistogramMetricFamily",
+        "SummaryMetricFamily",
+        "counter",  # observe.spans.counter shared-family helper
+    }
+)
+
+_FAMILY_RE = re.compile(r"^foremast(brain)?_[a-z0-9_]+$")
+
+# families whose NAMES are built dynamically (f-strings) and therefore
+# invisible to the literal scan — rule 2's explicit exemptions
+DYNAMIC_FAMILIES = frozenset({"foremastbrain_gauge_families_dropped"})
+
+
+def collected_name(name: str) -> str:
+    """prometheus_client collects counters without the `_total` suffix."""
+    return name[:-6] if name.endswith("_total") else name
+
+
+def _registry():
+    from foremast_tpu.observe import metrics_lint
+
+    return metrics_lint.ALLOWED_LABELS, metrics_lint.FAMILY_DOCS
+
+
+def scan_constructions(module: Module) -> list[tuple[str, int]]:
+    """(literal family name, line) for every metric-constructor call —
+    first positional arg or the `name=` keyword (both are legal
+    prometheus_client spellings)."""
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None
+        )
+        if name not in _CONSTRUCTORS:
+            continue
+        first = node.args[0] if node.args else next(
+            (kw.value for kw in node.keywords if kw.arg == "name"), None
+        )
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            if _FAMILY_RE.match(first.value):
+                out.append((first.value, node.lineno))
+    return out
+
+
+class MetricsContractChecker(Checker):
+    rule = RULE
+    description = (
+        "every constructed foremast_* family must be registered in "
+        "metrics_lint (ALLOWED_LABELS + FAMILY_DOCS)"
+    )
+
+    def __init__(self, registry=None, docs=None):
+        self._reg = registry
+        self._docs = docs
+
+    def _load(self):
+        if self._reg is None:
+            self._reg, self._docs = _registry()
+        return self._reg, self._docs
+
+    def check(self, module: Module) -> list[Finding]:
+        allowed, docs = self._load()
+        findings: list[Finding] = []
+        for name, line in scan_constructions(module):
+            coll = collected_name(name)
+            if coll not in allowed:
+                findings.append(
+                    module.finding(
+                        self.rule,
+                        line,
+                        f"metric family {name!r} is constructed here but "
+                        "not registered in metrics_lint.ALLOWED_LABELS — "
+                        "its label set is unchecked and the docs table "
+                        "cannot list it",
+                        hint="add it to ALLOWED_LABELS (exact label set) "
+                        "+ FAMILY_DOCS (one-line meaning) in "
+                        "observe/metrics_lint.py, then `make metrics-docs`",
+                    )
+                )
+            elif coll not in docs:
+                findings.append(
+                    module.finding(
+                        self.rule,
+                        line,
+                        f"metric family {name!r} has no FAMILY_DOCS entry "
+                        "— the generated observability table cannot "
+                        "describe it",
+                        hint="add a one-line meaning to FAMILY_DOCS in "
+                        "observe/metrics_lint.py, then `make metrics-docs`",
+                    )
+                )
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# repo-level checks: registry coverage + generated docs table
+# ---------------------------------------------------------------------------
+
+
+def check_registry_coverage(modules) -> list[Finding]:
+    """Rule 2: registry entries must be constructed (or declared
+    dynamic) and FAMILY_DOCS must cover ALLOWED_LABELS exactly."""
+    allowed, docs = _registry()
+    constructed = set()
+    for m in modules:
+        if not m.relpath.startswith("foremast_tpu/"):
+            continue
+        for name, _ in scan_constructions(m):
+            constructed.add(collected_name(name))
+    lint_path = "foremast_tpu/observe/metrics_lint.py"
+    findings = []
+    for name in sorted(set(allowed) - constructed - DYNAMIC_FAMILIES):
+        findings.append(
+            Finding(
+                rule=RULE,
+                path=lint_path,
+                line=1,
+                message=f"registry entry {name!r} is never constructed in "
+                "the package — dead registry weight (or a construction "
+                "the literal scan cannot see)",
+                hint="remove the entry, or add the family name to "
+                "metrics_contract.DYNAMIC_FAMILIES if it is built "
+                "dynamically",
+            )
+        )
+    for name in sorted(set(allowed) ^ set(docs)):
+        where = "ALLOWED_LABELS" if name in allowed else "FAMILY_DOCS"
+        findings.append(
+            Finding(
+                rule=RULE,
+                path=lint_path,
+                line=1,
+                message=f"family {name!r} appears in {where} only — "
+                "ALLOWED_LABELS and FAMILY_DOCS must cover the same set",
+                hint="keep the two dicts in observe/metrics_lint.py "
+                "key-for-key identical",
+            )
+        )
+    return findings
+
+
+def render_family_table() -> str:
+    """The generated family-index block for docs/observability.md."""
+    allowed, docs = _registry()
+    lines = [
+        DOCS_BEGIN,
+        "",
+        "| Family (as collected) | Labels | Meaning |",
+        "|---|---|---|",
+    ]
+    for name in sorted(allowed):
+        labels = ", ".join(f"`{lb}`" for lb in sorted(allowed[name])) or "—"
+        doc = docs.get(name, "").replace("|", "\\|")
+        lines.append(f"| `{name}` | {labels} | {doc} |")
+    lines.append("")
+    lines.append(
+        "Counters are listed as collected (without their `_total` "
+        "suffix). This table is generated from "
+        "`observe/metrics_lint.py`'s registry — edit "
+        "`ALLOWED_LABELS`/`FAMILY_DOCS`, then run `make metrics-docs`. "
+        "`make check` fails when the table, the registry, and the "
+        "constructor calls in code drift (rule `metrics-contract`)."
+    )
+    lines.append(DOCS_END)
+    return "\n".join(lines)
+
+
+def _split_docs(text: str) -> tuple[str, str, str] | None:
+    try:
+        head, rest = text.split(DOCS_BEGIN, 1)
+        _, tail = rest.split(DOCS_END, 1)
+    except ValueError:
+        return None
+    return head, text[len(head): len(text) - len(tail)], tail
+
+
+def check_metrics_docs(root: str) -> list[Finding]:
+    path = os.path.join(root, DOCS_RELPATH)
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    parts = _split_docs(text)
+    hint = (
+        "run `make metrics-docs` (or python -m foremast_tpu.analysis "
+        "--update-metrics-docs)"
+    )
+    if parts is None:
+        return [
+            Finding(
+                rule=RULE,
+                path=DOCS_RELPATH,
+                line=1,
+                message="METRIC FAMILIES markers missing from "
+                "observability docs",
+                hint=hint,
+            )
+        ]
+    if parts[1] != render_family_table():
+        return [
+            Finding(
+                rule=RULE,
+                path=DOCS_RELPATH,
+                line=text[: text.index(DOCS_BEGIN)].count("\n") + 1,
+                message="generated metric-family table is stale vs the "
+                "metrics_lint registry",
+                hint=hint,
+            )
+        ]
+    return []
+
+
+def update_metrics_docs(root: str) -> bool:
+    path = os.path.join(root, DOCS_RELPATH)
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    parts = _split_docs(text)
+    if parts is None:
+        raise SystemExit(
+            f"{DOCS_RELPATH}: METRIC FAMILIES markers not found; add\n"
+            f"{DOCS_BEGIN}\n{DOCS_END}\nwhere the table belongs"
+        )
+    head, old, tail = parts
+    new = render_family_table()
+    if old == new:
+        return False
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(head + new + tail)
+    return True

@@ -280,6 +280,16 @@ def _persistent_worker_id(snap_dir: str, minted: str) -> str:
 
 
 def cmd_worker(args: argparse.Namespace) -> int:
+    # FIRST, before the imports below create any package lock (native
+    # and observe.spans both make module-level locks at import time):
+    # FOREMAST_LOCK_WITNESS=1 wraps threading.Lock/RLock to record real
+    # acquisition order and verify it against the committed static lock
+    # graph at exit — installing later would leave those locks raw and
+    # their edges invisible to the witness
+    from foremast_tpu.analysis.witness import install_from_env
+
+    install_from_env()
+
     from foremast_tpu import native
     from foremast_tpu.config import BrainConfig
     from foremast_tpu.jobs.worker import BrainWorker

@@ -704,6 +704,16 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "dump jax.profiler traces around scoring",
     ),
     EnvKnob(
+        "FOREMAST_LOCK_WITNESS",
+        None,
+        "bool",
+        "`1` wraps this package's locks to record real acquisition "
+        "order (one list append per acquire) and logs at exit any "
+        "edge missing from the committed `analysis_lockgraph.json` — "
+        "the static lock-order model's runtime witness "
+        "(docs/static-analysis.md)",
+    ),
+    EnvKnob(
         "FOREMAST_SERVICE_ENDPOINT",
         "http://localhost:8099",
         "str",

@@ -95,8 +95,11 @@ class RingShard:
                 n or start is not None or end is not None
             ):
                 # empty backfills still carry an authority claim worth
-                # persisting; pure no-op pushes do not
-                journal(key, times, values, start, end)
+                # persisting; pure no-op pushes do not. DELIBERATELY
+                # under the shard lock (PR-7 replay-order contract, see
+                # the docstring above): journaling outside it let two
+                # racing same-timestamp revisions restore stale.
+                journal(key, times, values, start, end)  # foremast: ignore[blocking-under-lock]
             return n
 
     def query(

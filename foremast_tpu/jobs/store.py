@@ -679,7 +679,11 @@ class ElasticsearchStore(JobStore):
         # runs on probe/varz handler threads: uses the dedicated probe
         # session (never self._s, which the tick thread owns); the short
         # timeout keeps liveness probes fast even when ES is wedged
+        # _probe_lock exists to serialize the one probe Session between
+        # scrape/health threads — the HTTP round trip IS its critical
+        # section; worker ticks use the main session, never this lock
         with span("es.count_open"), self._probe_lock:
+            # foremast: ignore[blocking-under-lock]
             r = self._probe_s.post(
                 self._url("_count"),
                 json={"query": self._OPEN_QUERY},

@@ -130,7 +130,11 @@ class MeshRouter:
         if not first:
             # counters are monotonic telemetry, deliberately unguarded
             # (single-writer per counter key in practice; drift under a
-            # race is bounded and harmless)
+            # race is bounded and harmless). Keep it consistent: the
+            # thread-escape rule treats an attribute as lock-guarded the
+            # moment ONE mutation site takes a lock — if you ever guard
+            # one of these bumps, guard all of them or `make check`
+            # fails the stragglers.
             self.counters["rebalances"] += 1
             log.info(
                 "mesh rebalance: members %s -> %s",

@@ -343,13 +343,18 @@ class FitJournal:
             records = [("del", k) for k, _ in items]
         else:
             records = [("put", k, v) for k, v in items]
+        # the lock serializes the file handle between the judge's
+        # write-through and compaction's handle swap — held page-cache
+        # appends are its purpose (mirrors _ShardLog.append)
         with self._lock:
             if self._fh is None:
                 d = os.path.dirname(os.path.abspath(self.log_path))
                 os.makedirs(d, exist_ok=True)
+                # foremast: ignore[blocking-under-lock]
                 self._fh = open(self.log_path, "ab")
                 self._log_bytes = self._fh.tell()
             for rec in records:
+                # foremast: ignore[blocking-under-lock]
                 self._log_bytes += append_record(
                     self._fh,
                     pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL),
@@ -416,6 +421,9 @@ class FitJournal:
         with self._lock:
             if self._fh is not None:
                 self._fh.close()
+            # truncate-and-swap must be atomic against append() writing
+            # through the old handle — the held open is the swap itself
+            # foremast: ignore[blocking-under-lock]
             self._fh = open(self.log_path, "wb")
             self._log_bytes = 0
             self.counters["compactions"] += 1

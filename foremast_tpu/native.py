@@ -68,8 +68,9 @@ def ensure_built() -> bool:
         # Best-effort make BEFORE anything is mapped: a current build is a
         # timestamp no-op, a source-newer-than-.so build refreshes, and a
         # toolchain-less image fails harmlessly — a prebuilt .so on disk
-        # still loads below.
-        _build()
+        # still loads below. One-shot STARTUP path; the lock must span
+        # the build so a racing load() cannot dlopen a half-written .so.
+        _build()  # foremast: ignore[blocking-under-lock]
         if not os.path.exists(_LIB_PATH):
             return False
         _tried = False  # allow a fresh load even if one ran before the build

@@ -34,6 +34,12 @@ ALLOWED_LABELS: dict[str, frozenset[str]] = {
     "foremast_worker_tick_seconds": frozenset(),
     "foremast_worker_arena_events": frozenset({"event"}),
     "foremast_worker_fast_docs": frozenset({"kind"}),
+    # slow-path chunk pipeline (observe/gauges.py WorkerMetrics) — these
+    # predate the metrics-contract rule, which surfaced them missing
+    # from the registry (their label sets were unchecked)
+    "foremast_worker_pipeline_idle_seconds": frozenset(),
+    "foremast_worker_pipeline_overlap_ratio": frozenset(),
+    "foremast_worker_pipeline_write_queue_peak": frozenset(),
     "foremast_service_requests": frozenset({"route", "code"}),
     "foremast_controller_transitions": frozenset({"phase"}),
     "foremastbrain_gauge_families_dropped": frozenset(),
@@ -56,6 +62,97 @@ ALLOWED_LABELS: dict[str, frozenset[str]] = {
     "foremast_snapshot_restored_fits": frozenset(),
     "foremast_snapshot_writes": frozenset(),
     "foremast_snapshot_age_seconds": frozenset(),
+}
+
+# one-line operator meaning per family — the source the generated
+# "family index" table in docs/observability.md renders from (rule
+# `metrics-contract`: every constructed family must appear in
+# ALLOWED_LABELS AND here, and the committed table must match; the
+# three sources can no longer drift). Keys match ALLOWED_LABELS
+# (collected names: counters WITHOUT their `_total` suffix).
+FAMILY_DOCS: dict[str, str] = {
+    "foremast_tick_stage_seconds": (
+        "histogram of one judgment-tick stage (worker stages: claim … "
+        "write_back; controller stages: poll … pause)"
+    ),
+    "foremast_worker_jobs": "documents finalized, by resulting status",
+    "foremast_worker_windows": "metric windows judged",
+    "foremast_worker_tick_seconds": (
+        "histogram of the whole claim-fetch-judge-write cycle"
+    ),
+    "foremast_worker_arena_events": (
+        "device state-arena traffic (hits/misses/evictions/fallbacks)"
+    ),
+    "foremast_worker_fast_docs": (
+        "documents scored on the columnar fast path, by model kind"
+    ),
+    "foremast_worker_pipeline_idle_seconds": (
+        "seconds the judge stage sat stalled waiting on a chunk's fetch"
+    ),
+    "foremast_worker_pipeline_overlap_ratio": (
+        "latest slow-path tick: fraction of stage-busy seconds hidden "
+        "by fetch/judge/write overlap"
+    ),
+    "foremast_worker_pipeline_write_queue_peak": (
+        "latest slow-path tick: peak verdict write-back queue depth"
+    ),
+    "foremast_service_requests": (
+        "gateway requests by route pattern and status code"
+    ),
+    "foremast_controller_transitions": (
+        "DeploymentMonitor phase transitions observed by the poller"
+    ),
+    "foremastbrain_gauge_families_dropped": (
+        "distinct metric families dropped past the gauge-family cap"
+    ),
+    "foremast_ingest_fetches": (
+        "ring TSDB fetch outcomes (hit/miss/stale/uncovered)"
+    ),
+    "foremast_ingest_samples": (
+        "samples accepted by the ingest plane (receiver + direct push)"
+    ),
+    "foremast_ingest_evictions": (
+        "whole series evicted under FOREMAST_INGEST_BUDGET_BYTES"
+    ),
+    "foremast_ingest_series_resident": (
+        "series currently resident in the ring TSDB"
+    ),
+    "foremast_ingest_bytes_resident": (
+        "column bytes currently allocated by resident series"
+    ),
+    "foremast_ingest_receiver_lag_seconds": (
+        "now minus the newest sample timestamp of the latest push"
+    ),
+    "foremast_mesh_members": (
+        "live mesh members (fresh leases, including this worker)"
+    ),
+    "foremast_mesh_rebalances": (
+        "hash-ring swaps after membership changes"
+    ),
+    "foremast_mesh_redirect_hints": (
+        "receiver responses pointing a pusher at a series' owner"
+    ),
+    "foremast_mesh_claim_docs": (
+        "documents seen by the partition claim filter (owned/skipped)"
+    ),
+    "foremast_snapshot_discards": (
+        "state discarded during snapshot restore, by reason"
+    ),
+    "foremast_snapshot_restored_series": (
+        "ring series restored by the last startup restore"
+    ),
+    "foremast_snapshot_restored_samples": (
+        "ring samples restored by the last startup restore"
+    ),
+    "foremast_snapshot_restored_fits": (
+        "fit-cache entries restored (lazily rehydrated on first claim)"
+    ),
+    "foremast_snapshot_writes": (
+        "ring snapshot passes completed (all shards, atomic rename)"
+    ),
+    "foremast_snapshot_age_seconds": (
+        "seconds since the last completed ring snapshot"
+    ),
 }
 
 

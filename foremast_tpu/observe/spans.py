@@ -374,7 +374,16 @@ class Tracer:
         target = path or self.trace_path()
         with self._io_lock:
             os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
+            # _io_lock exists to serialize exactly this dump between
+            # explicit flush() callers and the autoflush daemon — two
+            # unguarded writers would truncate each other's temp file
+            # foremast: ignore[blocking-under-lock]
             self.ring.dump_jsonl(target)
+        # `_last_flush` is _flush_lock state (the autoflush scheduler's
+        # elapsed check reads it there); stamping it under _io_lock
+        # raced the two critical sections against each other
+        # (thread-escape mixed-guard finding)
+        with self._flush_lock:
             self._last_flush = time.monotonic()
         return target
 

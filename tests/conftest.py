@@ -12,7 +12,9 @@ import os
 # backend and exports JAX_PLATFORMS=axon; tests must never dial the tunnel
 # (single real chip, and CI has none), so force the CPU backend outright.
 os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
+# XLA's own variable, not a foremast knob — the registry enumerates
+# OUR config surface, not the toolchain's
+_flags = os.environ.get("XLA_FLAGS", "")  # foremast: ignore[env-contract]
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"

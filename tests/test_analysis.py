@@ -443,6 +443,775 @@ def test_env_docs_block_in_sync_with_registry():
 # ---------------------------------------------------------------------------
 
 
+def _program(sources: dict):
+    """Program over fixture sources (path -> source)."""
+    from foremast_tpu.analysis.core import Module
+    from foremast_tpu.analysis.interproc import Program
+
+    return Program([Module(p, src(s)) for p, s in sources.items()])
+
+
+# ---------------------------------------------------------------------------
+# lock-order (ISSUE 8 tentpole)
+# ---------------------------------------------------------------------------
+
+LOCK_ORDER_NESTED = {
+    "foremast_tpu/fix/a.py": """
+        import threading
+
+        from foremast_tpu.fix.b import Inner
+
+        class Outer:
+            def __init__(self, inner: Inner):
+                self._lock = threading.Lock()
+                self.inner = inner
+
+            def work(self):
+                with self._lock:
+                    self.inner.poke()
+
+            def hook_up(self, sink):
+                sink.on_data = self.inner.poke
+    """,
+    "foremast_tpu/fix/b.py": """
+        import threading
+
+        class Inner:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def poke(self):
+                with self._lock:
+                    self.n += 1
+
+        class Sink:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.on_data = None
+
+            def deliver(self):
+                with self._lock:
+                    on_data = self.on_data
+                    on_data()
+    """,
+}
+
+
+def test_lock_order_graph_interprocedural_edges():
+    """Direct nesting through a TYPED attribute call, and a CALLBACK
+    registered by attribute assignment in another module, both become
+    static edges — the cross-module resolution PR-2 had no answer to."""
+    from foremast_tpu.analysis.lock_order import build_graph
+
+    g = build_graph(_program(LOCK_ORDER_NESTED))
+    ids = {n["id"] for n in g["nodes"]}
+    assert {"Outer._lock", "Inner._lock", "Sink._lock"} <= ids
+    edges = {(e["from"], e["to"]) for e in g["edges"]}
+    assert ("Outer._lock", "Inner._lock") in edges  # typed-attr call
+    assert ("Sink._lock", "Inner._lock") in edges   # callback table
+
+
+def test_lock_order_cycle_is_a_finding(tmp_path):
+    from foremast_tpu.analysis.lock_order import (
+        build_graph,
+        check_lock_order,
+        find_cycles,
+        write_graph,
+    )
+
+    prog = _program(
+        {
+            "foremast_tpu/fix/cycle.py": """
+                import threading
+
+                class A:
+                    def __init__(self, b: "B"):
+                        self._lock = threading.Lock()
+                        self.b = b
+
+                    def fwd(self):
+                        with self._lock:
+                            self.b.take()
+
+                    def take(self):
+                        with self._lock:
+                            pass
+
+                class B:
+                    def __init__(self, a: A):
+                        self._lock = threading.Lock()
+                        self.a = a
+
+                    def take(self):
+                        with self._lock:
+                            pass
+
+                    def back(self):
+                        with self._lock:
+                            self.a.take()
+            """
+        }
+    )
+    g = build_graph(prog)
+    assert find_cycles(g), "A->B and B->A must form a cycle"
+    write_graph(str(tmp_path), g)  # artifact in sync: only the cycle fires
+    findings = check_lock_order(str(tmp_path), prog)
+    assert len(findings) == 1
+    assert "lock-order cycle" in findings[0].message
+    assert "A._lock" in findings[0].message and "B._lock" in findings[0].message
+
+
+def test_lock_order_nested_def_does_not_inherit_lock_context():
+    """Code-review regression: a call inside a def DEFINED under a
+    `with lock:` runs later (possibly on another thread, unlocked) —
+    it must not fabricate an acquisition edge at the definition site."""
+    from foremast_tpu.analysis.lock_order import build_graph
+
+    prog = _program(
+        {
+            "foremast_tpu/fix/nested.py": """
+                import threading
+
+                class B:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def poke(self):
+                        with self._lock:
+                            pass
+
+                class A:
+                    def __init__(self, b: B):
+                        self._lock = threading.Lock()
+                        self.b = b
+
+                    def sched(self):
+                        with self._lock:
+                            def task():
+                                self.b.poke()
+                            return task
+            """
+        }
+    )
+    edges = {(e["from"], e["to"]) for e in build_graph(prog)["edges"]}
+    assert ("A._lock", "B._lock") not in edges
+
+
+def test_thread_escape_closure_under_lock_is_not_guard_evidence():
+    """Code-review regression: a thread-target closure DEFINED inside a
+    locked region runs unlocked — its mutation must not count as locked
+    guard evidence (which would hide the race), and the unlocked
+    mutation of genuinely-guarded state must still be flagged."""
+    from foremast_tpu.analysis.thread_escape import check_thread_escape
+
+    sources = dict(THREAD_ESCAPE_SRC)
+    sources["foremast_tpu/fix/runner.py"] = """
+        import threading
+
+        from foremast_tpu.fix.guarded import Guarded
+
+        class Runner:
+            def __init__(self, g: Guarded):
+                self.g = g
+
+            def start(self):
+                with self.g._lock:
+                    def loop():
+                        self.g.hits += 1
+                    threading.Thread(target=loop, daemon=True).start()
+    """
+    findings = check_thread_escape(_program(sources))
+    assert len(findings) == 1
+    assert "Guarded.hits" in findings[0].message
+
+
+def test_blocking_under_lock_nested_def_not_attributed_inline():
+    findings = _blocking_findings(
+        {
+            "foremast_tpu/fix/blk4.py": """
+                import threading
+                import time
+
+                class Poller:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def sched(self):
+                        with self._lock:
+                            def later():
+                                time.sleep(1)
+                            return later
+            """
+        }
+    )
+    assert findings == []
+
+
+def test_lock_order_rlock_reentrancy_is_not_a_cycle():
+    from foremast_tpu.analysis.lock_order import build_graph, find_cycles
+
+    prog = _program(
+        {
+            "foremast_tpu/fix/rl.py": """
+                import threading
+
+                class Cache:
+                    def __init__(self):
+                        self._lock = threading.RLock()
+
+                    def get(self):
+                        with self._lock:
+                            return self._fill()
+
+                    def _fill(self):
+                        with self._lock:
+                            return 1
+            """
+        }
+    )
+    g = build_graph(prog)
+    assert find_cycles(g) == []
+    assert [r["id"] for r in g["reentrant"]] == ["Cache._lock"]
+
+
+def test_lock_order_plain_lock_self_deadlock_is_a_cycle():
+    from foremast_tpu.analysis.lock_order import build_graph, find_cycles
+
+    prog = _program(
+        {
+            "foremast_tpu/fix/dead.py": """
+                import threading
+
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def outer(self):
+                        with self._lock:
+                            self.inner()
+
+                    def inner(self):
+                        with self._lock:
+                            pass
+            """
+        }
+    )
+    assert find_cycles(build_graph(prog)) == [["Box._lock", "Box._lock"]]
+
+
+def test_lockgraph_artifact_roundtrip_and_staleness(tmp_path):
+    import json
+
+    from foremast_tpu.analysis.lock_order import (
+        GRAPH_NAME,
+        build_graph,
+        check_lock_order,
+        load_graph,
+        write_graph,
+    )
+
+    prog = _program(LOCK_ORDER_NESTED)
+    g = build_graph(prog)
+    root = str(tmp_path)
+    # missing artifact is a finding
+    missing = check_lock_order(root, prog)
+    assert any("missing" in f.message for f in missing)
+    # committed + in sync: clean
+    write_graph(root, g)
+    assert load_graph(root) == g
+    assert check_lock_order(root, prog) == []
+    # drift (an edge disappears from the committed file) is a finding
+    stale = dict(g)
+    stale["edges"] = g["edges"][1:]
+    with open(tmp_path / GRAPH_NAME, "w") as f:
+        json.dump(stale, f)
+    findings = check_lock_order(root, prog)
+    assert any("stale" in f.message for f in findings)
+
+
+def test_tree_lockgraph_committed_in_sync_and_cycle_free():
+    """Acceptance: analysis_lockgraph.json is committed, matches the
+    computed graph, and is cycle-free."""
+    from foremast_tpu.analysis.interproc import Program
+    from foremast_tpu.analysis.lock_order import (
+        build_graph,
+        check_lock_order,
+        find_cycles,
+        load_graph,
+    )
+
+    root = repo_root()
+    pkg = [
+        m for m in collect_modules(root)
+        if m.relpath.startswith("foremast_tpu/")
+    ]
+    program = Program(pkg)
+    assert check_lock_order(root, program) == []
+    graph = load_graph(root)
+    assert graph is not None and find_cycles(graph) == []
+    # the known deepest nesting is present (journal hook under the
+    # shard lock — the PR-7 replay-order contract)
+    edges = {(e["from"], e["to"]) for e in graph["edges"]}
+    assert ("RingShard._lock", "_ShardLog._lock") in edges
+    assert ("InMemoryStore._lock", "MeshRouter._lock") in edges
+
+
+# ---------------------------------------------------------------------------
+# thread-escape
+# ---------------------------------------------------------------------------
+
+
+def test_thread_escape_mixed_guard():
+    from foremast_tpu.analysis.thread_escape import check_thread_escape
+
+    prog = _program(
+        {
+            "foremast_tpu/fix/mix.py": """
+                import threading
+
+                class T:
+                    def __init__(self):
+                        self._a = threading.Lock()
+                        self._b = threading.Lock()
+                        self.stamp = 0.0
+
+                    def sched(self):
+                        with self._a:
+                            self.stamp = 1.0
+
+                    def flush(self):
+                        with self._b:
+                            self.stamp = 2.0
+            """
+        }
+    )
+    findings = check_thread_escape(prog)
+    assert len(findings) == 1
+    assert "T.stamp" in findings[0].message
+    assert "DIFFERENT locks" in findings[0].message
+
+
+def test_thread_escape_nested_locks_are_not_mixed_guard():
+    """A mutation under BOTH locks shares a lock with a mutation under
+    one of them — consistently guarded, not mixed (the false positive
+    the intersection criterion exists for)."""
+    from foremast_tpu.analysis.thread_escape import check_thread_escape
+
+    prog = _program(
+        {
+            "foremast_tpu/fix/nest.py": """
+                import threading
+
+                class T:
+                    def __init__(self):
+                        self._pass = threading.Lock()
+                        self._meta = threading.Lock()
+                        self.count = 0
+
+                    def heavy(self):
+                        with self._pass:
+                            with self._meta:
+                                self.count += 1
+
+                    def light(self):
+                        with self._meta:
+                            self.count += 1
+            """
+        }
+    )
+    assert check_thread_escape(prog) == []
+
+
+THREAD_ESCAPE_SRC = {
+    "foremast_tpu/fix/guarded.py": """
+        import threading
+
+        class Guarded:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.hits = 0
+
+            def bump(self):
+                with self._lock:
+                    self.hits += 1
+    """,
+    "foremast_tpu/fix/runner.py": """
+        import threading
+
+        from foremast_tpu.fix.guarded import Guarded
+
+        class Runner:
+            def __init__(self, g: Guarded):
+                self.g = g
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                self.g.hits += 1
+
+            def safe_loop(self):
+                with self.g._lock:
+                    self.g.hits += 1
+    """,
+}
+
+
+def test_thread_escape_cross_module_unlocked_mutation():
+    from foremast_tpu.analysis.thread_escape import check_thread_escape
+
+    findings = check_thread_escape(_program(THREAD_ESCAPE_SRC))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.path == "foremast_tpu/fix/runner.py"
+    assert "Guarded.hits" in f.message and "Runner._loop" in f.message
+    # safe_loop holds the owner's lock through the typed receiver — clean
+
+
+def test_thread_escape_needs_a_thread_root():
+    """The same unlocked cross-class mutation with NO thread anywhere
+    is not flagged — the rule is about state threads can reach."""
+    from foremast_tpu.analysis.thread_escape import check_thread_escape
+
+    sources = dict(THREAD_ESCAPE_SRC)
+    sources["foremast_tpu/fix/runner.py"] = """
+        from foremast_tpu.fix.guarded import Guarded
+
+        class Runner:
+            def __init__(self, g: Guarded):
+                self.g = g
+
+            def _loop(self):
+                self.g.hits += 1
+    """
+    assert check_thread_escape(_program(sources)) == []
+
+
+def test_thread_escape_roots_include_handlers_and_collectors():
+    from foremast_tpu.analysis.thread_escape import thread_roots
+
+    prog = _program(
+        {
+            "foremast_tpu/fix/surface.py": """
+                from http.server import BaseHTTPRequestHandler
+
+                class Handler(BaseHTTPRequestHandler):
+                    def do_GET(self):
+                        pass
+
+                class StatsCollector:
+                    def collect(self):
+                        yield 1
+
+                def wire(registry):
+                    registry.register(StatsCollector())
+            """
+        }
+    )
+    names = {f.qualname for f in thread_roots(prog)}
+    assert "Handler.do_GET" in names
+    assert "StatsCollector.collect" in names
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+
+def _blocking_findings(sources):
+    from foremast_tpu.analysis.blocking_under_lock import (
+        apply_suppressions,
+        check_blocking_under_lock,
+    )
+
+    prog = _program(sources)
+    return apply_suppressions(
+        check_blocking_under_lock(prog), prog.modules
+    )
+
+
+def test_blocking_under_lock_direct_and_clean():
+    findings = _blocking_findings(
+        {
+            "foremast_tpu/fix/blk.py": """
+                import threading
+                import time
+
+                class Poller:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def bad(self):
+                        with self._lock:
+                            time.sleep(1)
+
+                    def good(self):
+                        with self._lock:
+                            x = 1
+                        time.sleep(1)
+                        return x
+            """
+        }
+    )
+    assert len(findings) == 1
+    assert "time.sleep" in findings[0].message
+    assert "Poller.bad" in findings[0].message
+
+
+def test_blocking_under_lock_interprocedural():
+    findings = _blocking_findings(
+        {
+            "foremast_tpu/fix/blk2.py": """
+                import threading
+                import requests
+
+                def _fetch(url):
+                    return requests.get(url)
+
+                class Client:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def refresh(self):
+                        with self._lock:
+                            return _fetch("http://upstream")
+            """
+        }
+    )
+    msgs = "\n".join(f.message for f in findings)
+    assert "_fetch" in msgs and "HTTP call" in msgs
+    assert "Client.refresh" in msgs
+
+
+def test_blocking_under_lock_suppression_in_place():
+    findings = _blocking_findings(
+        {
+            "foremast_tpu/fix/blk3.py": """
+                import threading
+                import time
+
+                class Poller:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def deliberate(self):
+                        with self._lock:
+                            # the lock IS the serializer here (fixture)
+                            time.sleep(0)  # foremast: ignore[blocking-under-lock]
+            """
+        }
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# metrics-contract
+# ---------------------------------------------------------------------------
+
+
+def _metrics_checker():
+    from foremast_tpu.analysis.metrics_contract import MetricsContractChecker
+
+    return MetricsContractChecker(
+        registry={"foremast_known": frozenset()},
+        docs={"foremast_known": "a known family"},
+    )
+
+
+def test_metrics_contract_flags_unregistered_and_undocumented():
+    source = src(
+        """
+        from prometheus_client import Counter
+
+        def build(reg):
+            Counter("foremast_known_total", "fine", registry=reg)
+            Counter("foremast_rogue_total", "not registered", registry=reg)
+        """
+    )
+    findings = analyze_source(
+        source, "foremast_tpu/observe/fixture.py", [_metrics_checker()]
+    )
+    assert len(findings) == 1
+    assert "foremast_rogue_total" in findings[0].message
+    assert "ALLOWED_LABELS" in findings[0].message
+
+
+def test_metrics_contract_counts_metric_family_constructors():
+    source = src(
+        """
+        from prometheus_client.core import GaugeMetricFamily
+
+        def collect():
+            yield GaugeMetricFamily("foremast_mystery", "nope")
+        """
+    )
+    findings = analyze_source(
+        source, "foremast_tpu/observe/fixture.py", [_metrics_checker()]
+    )
+    assert len(findings) == 1 and "foremast_mystery" in findings[0].message
+
+
+def test_metrics_contract_checks_name_keyword_form():
+    """Code-review regression: `Counter(name="foremast_x_total", ...)`
+    is legal prometheus_client usage and must not escape the contract."""
+    source = src(
+        """
+        from prometheus_client import Counter
+
+        def build(reg):
+            Counter(name="foremast_rogue_total", documentation="d", registry=reg)
+        """
+    )
+    findings = analyze_source(
+        source, "foremast_tpu/observe/fixture.py", [_metrics_checker()]
+    )
+    assert len(findings) == 1 and "foremast_rogue_total" in findings[0].message
+
+
+def test_metrics_contract_ignores_dynamic_and_nonmetric_strings():
+    source = src(
+        """
+        from prometheus_client import Counter
+
+        def build(reg, ns):
+            Counter(f"{ns}_dynamic_total", "f-string: not checked", registry=reg)
+            print("foremast_not_a_constructor")
+        """
+    )
+    assert analyze_source(
+        source, "foremast_tpu/observe/fixture.py", [_metrics_checker()]
+    ) == []
+
+
+def test_metrics_registry_docs_and_table_in_sync():
+    """Acceptance: ALLOWED_LABELS == FAMILY_DOCS keys, every registry
+    entry is constructed (or declared dynamic), and the committed
+    observability table matches the renderer."""
+    import os as _os
+
+    from foremast_tpu.analysis.metrics_contract import (
+        check_metrics_docs,
+        check_registry_coverage,
+        render_family_table,
+    )
+
+    root = repo_root()
+    assert check_metrics_docs(root) == []
+    assert check_registry_coverage(collect_modules(root)) == []
+    with open(_os.path.join(root, "docs", "observability.md")) as f:
+        assert render_family_table() in f.read()
+
+
+# ---------------------------------------------------------------------------
+# runtime witness (analysis/witness.py)
+# ---------------------------------------------------------------------------
+
+
+def test_witness_observes_ordered_fixture_and_matches_graph(tmp_path):
+    """A deliberately ordered fixture: the ring journal hook nests
+    _ShardLog._lock under RingShard._lock on a REAL push. The witness
+    must observe exactly that edge, and the committed static graph must
+    contain it; a doctored graph missing the edge must be reported."""
+    import numpy as np
+
+    from foremast_tpu.analysis import witness
+    from foremast_tpu.analysis.lock_order import load_graph
+    from foremast_tpu.ingest import RingSnapshotter, RingStore
+
+    wit = witness.install()
+    try:
+        store = RingStore(shards=1)
+        snap = RingSnapshotter(store, str(tmp_path))
+        snap.attach()
+        t = np.arange(0, 300, 60, np.int64)
+        store.push(
+            'm{app="w"}', t, np.ones(len(t), np.float32), start=0.0, now=300.0
+        )
+        snap.close()
+    finally:
+        witness.uninstall()
+    shard_site = "foremast_tpu/ingest/shards.py"
+    log_site = "foremast_tpu/ingest/snapshot.py"
+    observed = wit.edges()
+    assert any(
+        a.startswith(shard_site) and b.startswith(log_site)
+        for a, b in observed
+    ), observed
+    graph = load_graph(repo_root())
+    assert graph is not None
+    assert wit.unobserved_edges(graph) == []
+    # a graph missing the journal edge must be reported as a hole
+    doctored = dict(graph)
+    doctored["edges"] = [
+        e
+        for e in graph["edges"]
+        if (e["from"], e["to"]) != ("RingShard._lock", "_ShardLog._lock")
+    ]
+    assert ("RingShard._lock", "_ShardLog._lock") in wit.unobserved_edges(
+        doctored
+    )
+
+
+def test_witness_reentrant_rlock_records_no_self_edge():
+    from foremast_tpu.analysis import witness
+    from foremast_tpu.models.cache import ModelCache
+
+    wit = witness.install()
+    try:
+        cache = ModelCache(max_size=4)
+        cache.restore_lazy({("k", "m"): 1})
+        assert cache.get(("k", "m")) == 1  # locked get -> locked rehydrate
+    finally:
+        witness.uninstall()
+    cache_site = "foremast_tpu/models/cache.py"
+    assert not any(
+        a.startswith(cache_site) and b.startswith(cache_site)
+        for a, b in wit.edges()
+    )
+
+
+def test_witness_ignores_non_package_locks():
+    import threading
+
+    from foremast_tpu.analysis import witness
+
+    wit = witness.install()
+    try:
+        outer = threading.Lock()  # created HERE: a tests/ frame
+        inner = threading.Lock()
+        with outer:
+            with inner:
+                pass
+        # created from a tests/ frame: raw locks, no edges recorded
+        assert not hasattr(outer, "site")
+        assert wit.edges() == set()
+    finally:
+        witness.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# scan scopes (benchmarks/ + tests/ for the repo-scoped rules)
+# ---------------------------------------------------------------------------
+
+
+def test_scope_repo_rules_cover_tests_and_benchmarks():
+    from foremast_tpu.analysis.lock_discipline import LockDisciplineChecker
+    from foremast_tpu.analysis.metrics_contract import MetricsContractChecker
+
+    assert AsyncBlockingChecker().applies_to("tests/test_x.py")
+    assert env_checker().applies_to("benchmarks/bench_x.py")
+    assert not LockDisciplineChecker().applies_to("tests/test_x.py")
+    assert not JitHygieneChecker().applies_to("benchmarks/bench_x.py")
+    assert not MetricsContractChecker().applies_to("tests/test_x.py")
+
+
+def test_default_scan_includes_tests_and_benchmarks():
+    relpaths = {m.relpath for m in collect_modules(repo_root())}
+    assert any(p.startswith("tests/") for p in relpaths)
+    assert any(p.startswith("benchmarks/") for p in relpaths)
+
+
 def test_suppression_same_line_by_rule():
     source = src(
         """
@@ -495,6 +1264,55 @@ def test_suppression_on_other_statement_does_not_leak_down():
     assert len(findings) == 1
 
 
+def test_suppression_multi_rule_on_one_line():
+    """ISSUE 8 regression: `ignore[rule-a,rule-b]` must suppress each
+    listed rule — and ONLY those (spaces around the commas allowed)."""
+    source = src(
+        """
+        import time
+
+        async def handler(request):
+            time.sleep(1)  # foremast: ignore[async-blocking, jit-hygiene]
+        """
+    )
+    assert analyze_source(source, ASYNC_PATH, [AsyncBlockingChecker()]) == []
+    other = src(
+        """
+        import time
+
+        async def handler(request):
+            time.sleep(1)  # foremast: ignore[jit-hygiene,lock-discipline]
+        """
+    )
+    findings = analyze_source(other, ASYNC_PATH, [AsyncBlockingChecker()])
+    assert len(findings) == 1  # async-blocking is NOT in the list
+
+
+def test_suppression_spaced_bracket_is_rule_scoped_not_ignore_all():
+    """Regression: `ignore [rule]` used to fail the bracket parse and
+    silently degrade to the bare suppress-EVERYTHING form — the
+    dangerous direction. It must scope to the listed rules."""
+    source = src(
+        """
+        import time
+
+        async def handler(request):
+            time.sleep(1)  # foremast: ignore [jit-hygiene]
+        """
+    )
+    findings = analyze_source(source, ASYNC_PATH, [AsyncBlockingChecker()])
+    assert len(findings) == 1  # NOT suppressed: the list names jit only
+    scoped = src(
+        """
+        import time
+
+        async def handler(request):
+            time.sleep(1)  # foremast: ignore [async-blocking]
+        """
+    )
+    assert analyze_source(scoped, ASYNC_PATH, [AsyncBlockingChecker()]) == []
+
+
 # ---------------------------------------------------------------------------
 # baseline round-trip
 # ---------------------------------------------------------------------------
@@ -539,12 +1357,22 @@ def test_missing_baseline_means_empty():
 
 def test_tree_clean_against_committed_baseline():
     """`python -m foremast_tpu.analysis` exits 0 on this tree: every
-    AST checker over the whole package, the env-docs sync contract, and
-    the committed (empty-or-shrinking) baseline."""
+    per-module checker over package + benchmarks + tests, the
+    whole-program concurrency rules, the three generated-artifact
+    contracts, and the committed (empty-or-shrinking) baseline."""
+    from foremast_tpu.analysis.__main__ import program_findings
+    from foremast_tpu.analysis.metrics_contract import (
+        check_metrics_docs,
+        check_registry_coverage,
+    )
+
     root = repo_root()
     modules = collect_modules(root)
     findings = analyze_modules(modules, all_checkers())
     findings.extend(check_env_docs(root))
+    findings.extend(check_metrics_docs(root))
+    findings.extend(check_registry_coverage(modules))
+    findings.extend(program_findings(root, modules))
     baseline = Baseline.load(os.path.join(root, "analysis_baseline.json"))
     new, _ = baseline.split(findings)
     assert new == [], "\n" + "\n".join(f.render() for f in new)

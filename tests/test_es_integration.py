@@ -22,7 +22,10 @@ import uuid
 
 import pytest
 
-ES_URL = os.environ.get("FOREMAST_ES_URL")
+# test-suite-only opt-in gate (points tier-2 at a LIVE Elasticsearch);
+# deliberately not in ENV_KNOBS — it configures this test run, not the
+# product, and registering it would put it in the operator docs
+ES_URL = os.environ.get("FOREMAST_ES_URL")  # foremast: ignore[env-contract]
 
 pytestmark = pytest.mark.skipif(
     not ES_URL, reason="FOREMAST_ES_URL not set (no live Elasticsearch)"

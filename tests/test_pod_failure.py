@@ -31,11 +31,36 @@ import sys
 import threading
 import time
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NOW = 1_760_000_000.0
 SERVICES = 4
 HIST_LEN = 64
 CUR_LEN = 16
+
+
+@pytest.fixture(scope="module", autouse=True)
+def lock_witness():
+    """ISSUE 8: the runtime lock witness rides this module — the mesh
+    crash/restart tests drive the InMemory claim path through the mesh
+    partition filter (store lock -> router lock) and the restart tests
+    replay the snapshot plane, all on real threads. At teardown every
+    OBSERVED acquisition edge must exist in the committed static lock
+    graph (the subprocess workers are outside this process's witness;
+    their lock topology is the same code the in-process tests cover)."""
+    from foremast_tpu.analysis import witness
+
+    wit = witness.install()
+    yield wit
+    graph = witness.load_graph()
+    witness.uninstall()
+    assert graph is not None, "analysis_lockgraph.json missing from repo root"
+    missing = wit.unobserved_edges(graph)
+    assert not missing, (
+        "runtime lock-acquisition edges missing from the static graph "
+        f"(run `make lockgraph` and review): {missing}"
+    )
 
 
 def _free_port() -> int:

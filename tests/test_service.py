@@ -156,8 +156,9 @@ def test_observability_surface():
             assert r.status == 200
             jid = (await r.json())["jobId"]
             # correlation ID minted at create rides on the stored doc
-            # (and round-trips the wire format as traceId)
-            doc = store.get(jid)
+            # (and round-trips the wire format as traceId); read off
+            # the loop the way the app itself would (async-blocking)
+            doc = await asyncio.to_thread(store.get, jid)
             assert doc.trace_id
             assert doc.to_json()["traceId"] == doc.trace_id
 

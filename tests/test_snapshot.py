@@ -29,6 +29,29 @@ from foremast_tpu.models.cache import FitJournal, ModelCache
 NOW = 1_760_000_000.0
 
 
+@pytest.fixture(scope="module", autouse=True)
+def lock_witness():
+    """ISSUE 8: the runtime lock witness rides this whole module — the
+    snapshot/journal suite exercises the ring's deepest lock nesting
+    (shard lock -> journal log lock, pass mutex -> everything) on real
+    threads, and at teardown every OBSERVED acquisition edge must
+    already exist in the committed static lock graph. A failure here
+    means the static model (analysis_lockgraph.json) has a hole: run
+    `make lockgraph`, review the new edge, and commit it."""
+    from foremast_tpu.analysis import witness
+
+    wit = witness.install()
+    yield wit
+    graph = witness.load_graph()
+    witness.uninstall()
+    assert graph is not None, "analysis_lockgraph.json missing from repo root"
+    missing = wit.unobserved_edges(graph)
+    assert not missing, (
+        "runtime lock-acquisition edges missing from the static graph "
+        f"(run `make lockgraph` and review): {missing}"
+    )
+
+
 def _store(shards=4, stale=300.0):
     return RingStore(shards=shards, stale_seconds=stale)
 
