@@ -50,6 +50,14 @@ bench-scaleout:
 bench-restart:
 	$(PY) -m benchmarks.restart_bench
 
+# chaos soak (ISSUE 9): 3-worker mesh + receivers + fault-injected
+# store/Prometheus under a scheduled FaultPlan (store brownout, prom
+# blackhole, pusher flood, skewed clocks, worker crash) with in-run
+# asserts: zero lost/duplicated verdicts, breakers re-close, recovery
+# <= 2 ticks per fault, lock witness clean, memory bounded
+bench-chaos:
+	$(PY) -m benchmarks.chaos_bench
+
 native:
 	$(MAKE) -C native
 
@@ -88,4 +96,4 @@ clean:
 	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 
-.PHONY: test test-fast ci bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest bench-scaleout bench-restart native deploy-render check metrics-lint env-docs metrics-docs lockgraph docker-build clean
+.PHONY: test test-fast ci bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest bench-scaleout bench-restart bench-chaos native deploy-render check metrics-lint env-docs metrics-docs lockgraph docker-build clean

@@ -146,6 +146,16 @@ def build_fleet(store, services: int, aliases: int, hist_len: int,
 # ---------------------------------------------------------------------------
 
 
+class _InjectedStoreFault(Exception):
+    """A fault-hook hit: the HTTP handler answers `status` (not 500),
+    so clients see the same wire behavior a browning-out ES would
+    produce (503s on the write path classify as transient)."""
+
+    def __init__(self, status: int, op: str):
+        super().__init__(f"injected fault: HTTP {status} on {op!r}")
+        self.status = status
+
+
 class StoreServer:
     """InMemoryStore behind one JSON-RPC endpoint, with the mesh claim
     filter applied SERVER-SIDE through the real membership + ring code
@@ -171,6 +181,42 @@ class StoreServer:
         self.seen: dict[str, set] = {}
         self.op_seconds: dict[str, list] = {}  # op -> [count, seconds]
         self._srv = None
+        # fault hooks (ISSUE 9 satellite): chaos tests drive a REAL
+        # store server answering real error statuses per RPC op —
+        # {"op": substr(""=all), "status": int, "latency": seconds,
+        # "times": remaining fires (None=until removed)}; clear with
+        # clear_faults(). Matching faults with a status short-circuit
+        # the dispatch (the op never reaches the store).
+        self.faults: list[dict] = []
+
+    def add_fault(
+        self,
+        op: str = "",
+        status: int = 503,
+        latency: float = 0.0,
+        times: int | None = None,
+    ) -> None:
+        with self._lock:
+            self.faults.append(
+                {"op": op, "status": status, "latency": latency,
+                 "times": times}
+            )
+
+    def clear_faults(self) -> None:
+        with self._lock:
+            self.faults = []
+
+    def _take_fault(self, op: str) -> dict | None:
+        with self._lock:
+            for f in self.faults:
+                if f["op"] and f["op"] not in op:
+                    continue
+                if f["times"] is not None:
+                    if f["times"] <= 0:
+                        continue
+                    f["times"] -= 1
+                return dict(f)
+        return None
 
     # -- mesh ownership, computed from the records IN the store --------
 
@@ -237,6 +283,12 @@ class StoreServer:
     def _rpc(self, req: dict) -> dict:
         t0 = time.perf_counter()
         try:
+            fault = self._take_fault(req["op"])
+            if fault is not None:
+                if fault["latency"]:
+                    time.sleep(fault["latency"])
+                if fault["status"]:
+                    raise _InjectedStoreFault(fault["status"], req["op"])
             return self._dispatch(req)
         finally:
             dt = time.perf_counter() - t0
@@ -352,6 +404,8 @@ class StoreServer:
                 try:
                     body = outer._rpc(json.loads(self.rfile.read(n)))
                     code = 200
+                except _InjectedStoreFault as e:
+                    body, code = {"error": str(e)}, e.status
                 except Exception as e:  # noqa: BLE001 — surface to the client
                     body, code = {"error": repr(e)}, 500
                 payload = json.dumps(body, separators=(",", ":")).encode()
@@ -379,7 +433,7 @@ class HttpFleetStore:
     server-side from the same membership records with the same ring
     code, so the predicate callable never needs to cross the wire."""
 
-    def __init__(self, base_url: str, worker_id: str):
+    def __init__(self, base_url: str, worker_id: str, chaos=None, breaker=None):
         import requests
 
         from foremast_tpu.jobs.store import JobStore  # noqa: F401 — interface
@@ -388,6 +442,13 @@ class HttpFleetStore:
         self.worker_id = worker_id
         self.tag = ""  # phase tag stamped onto judgment writes
         self._s = requests.Session()
+        if chaos is not None or breaker is not None:
+            # the same one-choke-point seam ElasticsearchStore carries
+            # (ISSUE 9): chaos benches drive the REAL degradation paths
+            # through this client too
+            from foremast_tpu.chaos import GuardedSession
+
+            self._s = GuardedSession(self._s, chaos=chaos, breaker=breaker)
         # docs the server has shipped in full (slim re-claims return
         # ids only; the shared Document objects mirror InMemoryStore's
         # same-object semantics)

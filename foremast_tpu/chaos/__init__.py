@@ -1,0 +1,67 @@
+"""Chaos plane: deterministic fault injection + graceful degradation.
+
+The system's whole value proposition is staying sane while the cluster
+around it misbehaves (the reference is a *health manager*), yet nothing
+in the repo could *inject* dependency failures on demand. This package
+is both halves of ISSUE 9:
+
+* **Injection** (`plan.py`): a seeded `FaultPlan` — latency, error
+  rate, blackhole, slow-drip, clock skew — threaded through ONE
+  interception seam in each dependency client (`PrometheusSource`,
+  `ElasticsearchStore`, `HttpKube`, the ingest receiver,
+  `RoutingPusher`, the bench `HttpFleetStore` server). Activated by
+  `FOREMAST_CHAOS_PLAN` (off in production: every seam is a
+  `None`-check pass-through) or by direct injection in tests.
+* **Degradation** (`breaker.py`, `degrade.py`): a small shared
+  circuit breaker (closed/open/half-open, per dependency edge) reusing
+  `PrometheusSource`'s transient classification, a bounded write-behind
+  buffer so a store outage degrades write-back instead of failing the
+  tick, per-tick deadlines with partial-tick release semantics, and the
+  shared `DegradeStats` counters every piece reports through.
+* **Proof**: `benchmarks/chaos_bench.py` (`make bench-chaos`) soaks a
+  3-worker mesh + receivers + fault-injected store/Prometheus under a
+  scheduled plan and asserts exactly-once judgment, breaker re-close,
+  and bounded recovery in-run.
+
+Metric families (`foremast_chaos_*` / `foremast_breaker_*` /
+`foremast_degraded_*`) export via `ChaosCollector` and are registered
+through the PR-8 metrics-contract gate (docs/observability.md).
+"""
+
+from foremast_tpu.chaos.breaker import (
+    BreakerOpen,
+    BreakerRegistry,
+    CircuitBreaker,
+)
+from foremast_tpu.chaos.collector import ChaosCollector
+from foremast_tpu.chaos.degrade import (
+    DegradeStats,
+    Degradation,
+    WriteBehindBuffer,
+    is_transient_error,
+)
+from foremast_tpu.chaos.guard import GuardedSession
+from foremast_tpu.chaos.plan import (
+    EdgeChaos,
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    chaos_from_env,
+)
+
+__all__ = [
+    "BreakerOpen",
+    "BreakerRegistry",
+    "ChaosCollector",
+    "CircuitBreaker",
+    "DegradeStats",
+    "Degradation",
+    "EdgeChaos",
+    "FaultPlan",
+    "FaultRule",
+    "GuardedSession",
+    "InjectedFault",
+    "WriteBehindBuffer",
+    "chaos_from_env",
+    "is_transient_error",
+]

@@ -147,13 +147,19 @@ def cmd_score(args: argparse.Namespace) -> int:
     )
 
 
-def _make_store(elastic_url: str | None):
+def _make_store(elastic_url: str | None, chaos=None, breaker=None, stop=None):
     """ES-backed store with the reference's connect-retry loop
     (service main.go:248-260), or in-memory when no URL is given.
 
     Falls back to the reference's env vars (`ELASTIC_URL` for the service,
     `ES_ENDPOINT` for the engine, main.go:236-243 / foremast-brain.yaml:22)
-    so the deployed containers need no flags."""
+    so the deployed containers need no flags.
+
+    The connect loop is bounded (ISSUE 9 satellite):
+    `FOREMAST_ES_CONNECT_DEADLINE_SECONDS` (0/unset = the reference's
+    forever-retry) turns a store that never comes up into a LOUD exit
+    instead of an un-stoppable wait, and `stop` (shutdown signal) is
+    honored between retries."""
     import os
 
     from foremast_tpu.jobs.store import ElasticsearchStore, InMemoryStore
@@ -163,8 +169,27 @@ def _make_store(elastic_url: str | None):
     )
     if not elastic_url:
         return InMemoryStore()
-    store = ElasticsearchStore(elastic_url)
-    store.wait_ready()
+    store = ElasticsearchStore(elastic_url, chaos=chaos, breaker=breaker)
+    deadline = float(
+        os.environ.get("FOREMAST_ES_CONNECT_DEADLINE_SECONDS", "") or 0.0
+    )
+    if not store.wait_ready(max_wait=deadline or None, stop=stop):
+        if stop and stop():
+            # a SIGTERM during the connect loop is a GRACEFUL shutdown:
+            # exit 0, or a rolling restart reads as a crash loop
+            print(
+                "shutdown requested during Elasticsearch connect; "
+                "exiting cleanly",
+                file=sys.stderr,
+            )
+            raise SystemExit(0)
+        state = store.connect_state
+        raise SystemExit(
+            f"could not reach Elasticsearch at {elastic_url} within "
+            f"{deadline:.0f}s ({state['attempts']} attempts, last error: "
+            f"{state['last_error']}); set "
+            "FOREMAST_ES_CONNECT_DEADLINE_SECONDS=0 to wait forever"
+        )
     return store
 
 
@@ -216,7 +241,10 @@ def _enable_compile_cache() -> None:
     )
 
 
-def _mount_ingest(inner, gauge_port: int, router=None, snapshot_dir=None):
+def _mount_ingest(
+    inner, gauge_port: int, router=None, snapshot_dir=None,
+    chaos=None, degrade=None,
+):
     """FOREMAST_INGEST=1: wrap the pull source in the push-plane
     RingSource (docs/operations.md "Ingest plane") — warm fetches become
     resident ring gathers, cold misses fall back to `inner` and are
@@ -251,7 +279,9 @@ def _mount_ingest(inner, gauge_port: int, router=None, snapshot_dir=None):
     srv = None
     if port or router is not None:
         srv, _ = start_ingest_server(
-            port, ring, book=source.book, router=router
+            port, ring, book=source.book, router=router,
+            chaos=chaos,
+            degrade_stats=degrade.stats if degrade is not None else None,
         )
     if gauge_port:
         from prometheus_client import REGISTRY
@@ -305,6 +335,37 @@ def cmd_worker(args: argparse.Namespace) -> int:
     native.ensure_built()  # startup-time compile, never in the hot path
     config = BrainConfig.from_env()
 
+    # chaos plane + degradation bundle (ISSUE 9): FOREMAST_CHAOS_PLAN
+    # unset (production) means chaos_plan is None and every injection
+    # seam below receives None — a plain attribute check, no other
+    # cost. The Degradation bundle (breakers, write-behind, tick
+    # budget) is ALWAYS on: degrading through a real outage must not
+    # require having opted into chaos testing.
+    from foremast_tpu.chaos import Degradation, chaos_from_env
+
+    chaos_plan = chaos_from_env()
+
+    def _edge(name: str):
+        return chaos_plan.edge(name) if chaos_plan is not None else None
+
+    degrade = Degradation.from_env(
+        max_stuck_seconds=config.max_stuck_seconds, chaos_plan=chaos_plan
+    )
+
+    # graceful shutdown flag, installed BEFORE the store connect loop so
+    # a SIGTERM during an ES outage at startup stops the retry promptly
+    # (wait_ready polls `stop` between sliced sleeps) instead of dying
+    # on the default disposition; the worker loop reuses the same event
+    import signal
+    import threading
+
+    stop_event = threading.Event()
+    try:
+        signal.signal(signal.SIGTERM, lambda s, f: stop_event.set())
+        signal.signal(signal.SIGINT, lambda s, f: stop_event.set())
+    except ValueError:
+        pass  # not the main thread (embedded use); rely on the caller
+
     from foremast_tpu.engine.multivariate import MultivariateJudge
 
     univariate = None
@@ -326,12 +387,22 @@ def cmd_worker(args: argparse.Namespace) -> int:
         import jax as _jax_pm
 
         store = (
-            _make_store(args.elastic_url)
+            _make_store(
+                args.elastic_url,
+                chaos=_edge("store"),
+                breaker=degrade.breakers.get("store"),
+                stop=stop_event.is_set,
+            )
             if _jax_pm.process_index() == 0
             else None
         )
     else:
-        store = _make_store(args.elastic_url)
+        store = _make_store(
+            args.elastic_url,
+            chaos=_edge("store"),
+            breaker=degrade.breakers.get("store"),
+            stop=stop_event.is_set,
+        )
 
     ckpt_path = None
     ckpt_save = None
@@ -463,10 +534,18 @@ def cmd_worker(args: argparse.Namespace) -> int:
         # docs into one SPMD program (docs/operations.md runbook).
         from foremast_tpu.parallel import LeaderSource, LeaderStore, PodWorker
 
-        pod_inner = PrometheusSource() if store is not None else None
+        pod_inner = (
+            PrometheusSource(
+                chaos=_edge("prometheus"),
+                breaker=degrade.breakers.get("prometheus"),
+            )
+            if store is not None
+            else None
+        )
         if ingest_on and pod_inner is not None:
             pod_inner, _pod_ring, ingest_srv, _ = _mount_ingest(
-                pod_inner, args.gauge_port
+                pod_inner, args.gauge_port,
+                chaos=_edge("receiver"), degrade=degrade,
             )
         worker = PodWorker(
             LeaderStore(store),
@@ -477,6 +556,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
             on_verdict=on_verdict,
             metrics=worker_metrics,
             tracer=tracer,
+            degrade=degrade,
         )
     else:
         # mesh identity is minted HERE so the membership record and the
@@ -512,6 +592,13 @@ def cmd_worker(args: argparse.Namespace) -> int:
         if mesh_on:
             from foremast_tpu.mesh import Membership, MeshRouter
 
+            mesh_kw = {}
+            if chaos_plan is not None:
+                # chaos "clock" edge: skew rules shift the clock this
+                # member stamps leases with AND reads peers' leases by
+                # (membership.py documents the tolerance: renewal every
+                # lease/3 means a reader surviving skew < 2/3 lease)
+                mesh_kw["clock"] = chaos_plan.edge("clock").clock()
             membership = Membership(
                 store,
                 worker_id,
@@ -519,6 +606,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
                     os.environ.get("FOREMAST_MESH_LEASE_SECONDS", "")
                     or "15"
                 ),
+                **mesh_kw,
             )
             router = MeshRouter(
                 membership,
@@ -527,13 +615,17 @@ def cmd_worker(args: argparse.Namespace) -> int:
                     os.environ.get("FOREMAST_MESH_ROUTE_LABEL", "") or "app"
                 ),
             )
-        single_source = PrometheusSource()
+        single_source = PrometheusSource(
+            chaos=_edge("prometheus"),
+            breaker=degrade.breakers.get("prometheus"),
+        )
         single_ring = None
         if ingest_on:
             single_source, single_ring, ingest_srv, snapshotter = (
                 _mount_ingest(
                     single_source, args.gauge_port, router=router,
                     snapshot_dir=snap_dir,
+                    chaos=_edge("receiver"), degrade=degrade,
                 )
             )
         if mesh_on:
@@ -564,6 +656,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
             metrics=worker_metrics,
             tracer=tracer,
             mesh=mesh_node,
+            degrade=degrade,
         )
         if snap_dir:
             # fit journals restore lazily (the first claim of each doc
@@ -604,6 +697,12 @@ def cmd_worker(args: argparse.Namespace) -> int:
                     snapshotter, journals=worker._fit_journals.values()
                 )
             )
+        # chaos/degradation exposition rides the same scrape port:
+        # breaker states, degraded-doc counters, injected-fault counts
+        from foremast_tpu.chaos import ChaosCollector
+        from prometheus_client import REGISTRY as _REG3
+
+        _REG3.register(ChaosCollector(degrade))
 
     after_tick = None
     if ckpt_path:
@@ -618,19 +717,11 @@ def cmd_worker(args: argparse.Namespace) -> int:
                 ckpt_save(ckpt_path)
                 _state["dirty"] = False
 
-    # graceful pod shutdown: k8s sends SIGTERM; finish the in-flight tick
-    # (claimed docs get written back) instead of dying mid-judgment —
-    # abandoned claims would otherwise wait out MAX_STUCK_IN_SECONDS
-    import signal
-    import threading
-
-    stop_event = threading.Event()
-    try:
-        signal.signal(signal.SIGTERM, lambda s, f: stop_event.set())
-        signal.signal(signal.SIGINT, lambda s, f: stop_event.set())
-    except ValueError:
-        pass  # not the main thread (embedded use); rely on the caller
-
+    # graceful shutdown: the SIGTERM/SIGINT handlers were installed
+    # before the store connect loop (top of this function); from here
+    # `stop_event` makes the worker finish the in-flight tick (claimed
+    # docs get written back) instead of dying mid-judgment — abandoned
+    # claims would otherwise wait out MAX_STUCK_IN_SECONDS
     if args.warmup:
         worker.warmup()
 
@@ -758,7 +849,19 @@ def cmd_watch_plane(args: argparse.Namespace) -> int:
     from foremast_tpu.watch.plane import WatchPlane
 
     setup_logging()
-    kube = HttpKube(base_url=args.api_server)
+    # the controller's one dependency edge gets the same chaos seam +
+    # breaker the worker's clients carry (ISSUE 9): a FOREMAST_CHAOS_PLAN
+    # rule on edge "kube" injects here, and a dead API server fails
+    # fast once the breaker opens instead of stalling every poll
+    from foremast_tpu.chaos import Degradation, chaos_from_env
+
+    chaos_plan = chaos_from_env()
+    degrade = Degradation.from_env(chaos_plan=chaos_plan)
+    kube = HttpKube(
+        base_url=args.api_server,
+        chaos=chaos_plan.edge("kube") if chaos_plan is not None else None,
+        breaker=degrade.breakers.get("kube"),
+    )
     plane = WatchPlane(
         kube,
         own_namespace=args.namespace or os.environ.get("NAMESPACE", "foremast"),
@@ -768,8 +871,11 @@ def cmd_watch_plane(args: argparse.Namespace) -> int:
         # the transition counter and poll-stage histogram register on
         # the default registry — without this server they'd be
         # unscrapeable in the only process that produces them
+        from foremast_tpu.chaos import ChaosCollector
         from foremast_tpu.observe.spans import start_observe_server
+        from prometheus_client import REGISTRY as _REG
 
+        _REG.register(ChaosCollector(degrade))
         start_observe_server(args.gauge_port, state_fn=plane.debug_state)
     plane.run()
     return 0

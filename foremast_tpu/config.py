@@ -633,6 +633,86 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "bounding restart replay time",
     ),
     EnvKnob(
+        "FOREMAST_CHAOS_PLAN",
+        None,
+        "str",
+        "deterministic fault-injection plan (docs/operations.md "
+        "\"Failure modes & degradation\"): inline JSON or `@path` to a "
+        "JSON file — seeded rules injecting latency / error-rate / "
+        "blackhole / clock-skew faults into each dependency edge "
+        "(prometheus, store, kube, receiver, pusher, clock). UNSET in "
+        "production: every injection seam is then a pass-through "
+        "attribute check. Test/soak tooling only",
+    ),
+    EnvKnob(
+        "FOREMAST_BREAKER_FAILURES",
+        "5",
+        "int",
+        "circuit breaker: consecutive transient failures (connection/"
+        "timeout errors, HTTP 429/5xx) on one dependency edge before "
+        "its breaker opens and further calls fail fast (BreakerOpen) "
+        "instead of stalling on timeouts",
+    ),
+    EnvKnob(
+        "FOREMAST_BREAKER_OPEN_SECONDS",
+        "10",
+        "float",
+        "circuit breaker: open-state cooldown before ONE half-open "
+        "probe call is allowed through; probe success re-closes, "
+        "failure re-opens with a fresh cooldown",
+    ),
+    EnvKnob(
+        "FOREMAST_TICK_BUDGET_SECONDS",
+        "0",
+        "float",
+        "per-tick deadline (0 = unbounded): docs whose fetch/judge "
+        "turn comes after the budget are RELEASED un-judged — status "
+        "back to preprocess_completed, claimable next tick, counted on "
+        "`foremast_degraded_docs{reason=\"deadline_released\"}` — so "
+        "one slow dependency bounds tick latency instead of wedging "
+        "the whole claim behind it",
+    ),
+    EnvKnob(
+        "FOREMAST_WRITE_BEHIND_DOCS",
+        "65536",
+        "int",
+        "write-behind buffer entry cap: verdicts whose store write "
+        "failed transiently buffer locally and replay when the store "
+        "heals; past the cap the OLDEST entries drop (counted). "
+        "Entries aging past MAX_STUCK_IN_SECONDS always drop — past "
+        "the stuck window a peer's claim-CAS takeover owns the doc, "
+        "and a late replay would double-write its verdict",
+    ),
+    EnvKnob(
+        "FOREMAST_INGEST_MAX_INFLIGHT",
+        "64",
+        "int",
+        "ingest receiver overload shedding: concurrent push handlers "
+        "allowed before a push is answered 429 + Retry-After BEFORE "
+        "its body is read (pushers retry-then-buffer client-side); "
+        "`0` disables shedding",
+    ),
+    EnvKnob(
+        "FOREMAST_ES_CONNECT_DEADLINE_SECONDS",
+        "0",
+        "float",
+        "bound on the Elasticsearch connect-retry loop at startup "
+        "(`0` = the reference's forever-retry): past it the worker "
+        "exits loudly with the retry state instead of waiting "
+        "invisibly; the retry progress is always surfaced on "
+        "`/debug/state` `store_connect`",
+    ),
+    EnvKnob(
+        "FOREMAST_KUBE_TIMEOUT_SECONDS",
+        "30",
+        "float",
+        "per-request socket timeout for the in-cluster K8s API client "
+        "(HttpKube; applies to connect and read). Transient API-server "
+        "failures (429/5xx, connection errors) retry under "
+        "FOREMAST_FETCH_RETRIES with jittered backoff; hard 4xx fails "
+        "fast",
+    ),
+    EnvKnob(
         "FOREMAST_MESH",
         "0",
         "bool",

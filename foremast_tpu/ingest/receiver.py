@@ -49,6 +49,9 @@ log = logging.getLogger("foremast_tpu.ingest")
 
 WRITE_PATH = "/api/v1/write"
 DEFAULT_MAX_BODY_BYTES = 8 * 1024 * 1024
+# concurrent push handlers allowed before the receiver sheds with
+# 429 + Retry-After (FOREMAST_INGEST_MAX_INFLIGHT; 0 = unbounded)
+DEFAULT_MAX_INFLIGHT = 64
 # a handler stuck mid-read (pusher died with the body half-sent) frees
 # its thread after this instead of holding it forever
 HANDLER_TIMEOUT_SECONDS = 30.0
@@ -119,6 +122,9 @@ def start_ingest_server(
     book=None,
     router=None,
     max_body_bytes: int | None = None,
+    max_inflight: int | None = None,
+    chaos=None,
+    degrade_stats=None,
 ):
     """Serve the push plane; returns (server, thread). Port 0 binds an
     ephemeral port (tests) — read it back from server.server_address.
@@ -130,7 +136,17 @@ def start_ingest_server(
     mesh-aware pusher lands on the right shard from its next cycle.
 
     `max_body_bytes` caps request bodies (413 past it); None reads
-    `FOREMAST_INGEST_MAX_BODY_BYTES` (default 8 MiB)."""
+    `FOREMAST_INGEST_MAX_BODY_BYTES` (default 8 MiB).
+
+    Overload shedding (ISSUE 9): `max_inflight` bounds concurrent push
+    handlers (None reads ``FOREMAST_INGEST_MAX_INFLIGHT``, default 64;
+    0 disables) — past it a push is answered 429 + ``Retry-After``
+    BEFORE its body is read, so a pusher flood degrades to client-side
+    retry-then-buffer (`RoutingPusher` treats 429 as transient) instead
+    of a handler-thread pileup. `chaos` (chaos.EdgeChaos) injects
+    latency/errors at the handler seam — faults are ANSWERED as their
+    HTTP status, never raised into the server loop. `degrade_stats`
+    (chaos.DegradeStats) counts sheds."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     if max_body_bytes is None:
@@ -139,6 +155,12 @@ def start_ingest_server(
             or DEFAULT_MAX_BODY_BYTES
         )
     cap = int(max_body_bytes)
+    if max_inflight is None:
+        max_inflight = int(
+            os.environ.get("FOREMAST_INGEST_MAX_INFLIGHT", "")
+            or DEFAULT_MAX_INFLIGHT
+        )
+    inflight_cap = int(max_inflight)
     inflight = _Inflight()
 
     class Handler(BaseHTTPRequestHandler):
@@ -150,10 +172,18 @@ def start_ingest_server(
         def log_message(self, *a):  # push traffic must not spam stderr
             pass
 
-        def _send(self, code: int, body: bytes, ctype: str = "application/json"):
+        def _send(
+            self,
+            code: int,
+            body: bytes,
+            ctype: str = "application/json",
+            headers: dict | None = None,
+        ):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -166,6 +196,26 @@ def start_ingest_server(
             if path != WRITE_PATH:
                 self._send(404, b'{"reason": "not found"}')
                 return
+            # shed BEFORE reading the body: under overload the cheapest
+            # possible answer, and the pusher's buffer (not our heap)
+            # holds the samples until the flood passes
+            if inflight_cap and inflight.count > inflight_cap:
+                if degrade_stats is not None:
+                    degrade_stats.count_event("receiver", "shed")
+                self._send(
+                    429,
+                    b'{"reason": "receiver overloaded"}',
+                    headers={"Retry-After": "1"},
+                )
+                return
+            if chaos is not None:
+                fault = chaos.perturb(path, raise_faults=False)
+                if fault is not None:
+                    self._send(
+                        fault.status,
+                        json.dumps({"reason": str(fault)}).encode(),
+                    )
+                    return
             length = int(self.headers.get("Content-Length", "0") or 0)
             if length > cap:
                 # reject BEFORE buffering: an oversized push must not

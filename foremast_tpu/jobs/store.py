@@ -258,17 +258,41 @@ class ElasticsearchStore(JobStore):
 
     Connection-retry semantics mirror the service's forever-retry loop
     (`service main.go:248-260`) via `wait_ready`, which also creates the
-    index with the explicit `INDEX_MAPPINGS` (idempotent).
+    index with the explicit `INDEX_MAPPINGS` (idempotent) — but bounded
+    on request (`max_wait` / `stop`): a worker stuck in connect-retry
+    must be stoppable promptly, and `connect_state` surfaces the retry
+    loop's progress on `/debug/state` instead of looking like a hang.
+
+    Chaos/degradation seams (ISSUE 9): `chaos`/`breaker` wrap the main
+    session once (`chaos.GuardedSession`) — every store round trip
+    passes the one choke point; both default None = raw session, zero
+    overhead. The probe session stays unwrapped: varz/liveness must
+    report THROUGH an outage, not degrade with it.
     """
 
     INDEX = "documents"
     TYPE = "document"
 
-    def __init__(self, endpoint: str, session=None, timeout: float = 10.0):
+    def __init__(
+        self,
+        endpoint: str,
+        session=None,
+        timeout: float = 10.0,
+        chaos=None,
+        breaker=None,
+    ):
         import requests
 
         self.endpoint = endpoint.rstrip("/")
         self._s = session or requests.Session()
+        # wait_ready's retry-loop progress, replaced (never mutated) so
+        # varz threads always read a consistent snapshot
+        self.connect_state = {
+            "connected": False,
+            "attempts": 0,
+            "waiting_seconds": 0.0,
+            "last_error": None,
+        }
         # probe/varz handlers (count_open) run on their own threads and
         # requests.Session is not thread-safe — give them a dedicated
         # session mirroring the main one's auth/TLS config. Injected
@@ -294,21 +318,56 @@ class ElasticsearchStore(JobStore):
         # their use of the one probe session
         self._probe_lock = threading.Lock()
         self.timeout = timeout
+        if chaos is not None or breaker is not None:
+            from foremast_tpu.chaos.guard import GuardedSession
+
+            self._s = GuardedSession(self._s, chaos=chaos, breaker=breaker)
 
     # -- helpers --------------------------------------------------------
 
     def _url(self, *parts: str) -> str:
         return "/".join((self.endpoint, self.INDEX, *parts))
 
-    def wait_ready(self, retry_seconds: float = 3.0, max_wait: float | None = None):
+    def wait_ready(
+        self,
+        retry_seconds: float = 3.0,
+        max_wait: float | None = None,
+        stop=None,
+    ):
+        """Block until ES answers and the index is ensured. Returns
+        False (instead of looping forever) when `max_wait` seconds
+        elapse or `stop()` (a callable, e.g. a shutdown event's
+        ``is_set``) turns true — the deadline + clean-shutdown bound on
+        the reference's forever-retry loop. Progress is published on
+        ``self.connect_state`` (attempts, last error, elapsed) so a
+        worker stuck here reads as "retrying ES", not as a hang."""
         start = time.time()
+        attempts = 0
+        last_error = None
+        # probe with the RAW session, bypassing any chaos/breaker guard
+        # (GuardedSession.inner): the connect loop's repeated failures
+        # would otherwise open the store breaker, after which every
+        # retry reports "BreakerOpen" instead of the real refused-
+        # connection/DNS error the runbook tells the operator to read,
+        # and reconnection would wait out breaker cooldowns instead of
+        # the retry interval. Runtime traffic stays guarded.
+        probe_s = getattr(self._s, "inner", self._s)
         while True:
             reachable = False
+            attempts += 1
             try:
-                r = self._s.get(self.endpoint, timeout=self.timeout)
+                r = probe_s.get(self.endpoint, timeout=self.timeout)
                 reachable = r.ok
-            except Exception:
-                pass
+                if not reachable:
+                    last_error = f"HTTP {r.status_code}"
+            except Exception as e:
+                last_error = f"{type(e).__name__}: {e}"
+            self.connect_state = {
+                "connected": False,
+                "attempts": attempts,
+                "waiting_seconds": round(time.time() - start, 3),
+                "last_error": last_error,
+            }
             if reachable:
                 # connectivity retries are silent (the reference's
                 # forever-retry loop); index/mapping problems are CONFIG
@@ -317,6 +376,12 @@ class ElasticsearchStore(JobStore):
                 # races during cluster start) logs and retries
                 try:
                     self.ensure_index()
+                    self.connect_state = {
+                        "connected": True,
+                        "attempts": attempts,
+                        "waiting_seconds": round(time.time() - start, 3),
+                        "last_error": None,
+                    }
                     return True
                 except MappingDivergence:
                     raise
@@ -326,10 +391,21 @@ class ElasticsearchStore(JobStore):
                     )
                     if status is not None and 400 <= status < 500 and status != 429:
                         raise
+                    last_error = f"ensure_index: {e}"
                     log.warning("ensure_index failed, retrying: %s", e)
             if max_wait is not None and time.time() - start > max_wait:
                 return False
-            time.sleep(retry_seconds)
+            # sleep in short slices so a stop request (SIGTERM during
+            # startup) is honored within ~a quarter second, not after a
+            # full retry interval
+            deadline = time.time() + retry_seconds
+            while True:
+                if stop is not None and stop():
+                    return False
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                time.sleep(min(0.25, remaining))
 
     # claim()'s server-side semantics stand on exactly these field types;
     # ensure_index verifies them against a pre-existing index's live

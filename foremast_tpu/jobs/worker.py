@@ -22,6 +22,13 @@ from typing import Callable
 
 import numpy as np
 
+from foremast_tpu.chaos.degrade import (
+    REASON_DEADLINE,
+    REASON_FETCH,
+    REASON_REPLAYED,
+    Degradation,
+    is_transient_error,
+)
 from foremast_tpu.config import BrainConfig
 from foremast_tpu.engine import (
     HEALTHY,
@@ -60,6 +67,16 @@ HIST_SETTLED_SECONDS = 120.0
 
 _EMPTY_TIMES = np.zeros(0, np.int64)
 _EMPTY_VALUES = np.zeros(0, np.float32)
+
+# Partial-tick sentinels (ISSUE 9): a doc whose fetch failed
+# TRANSIENTLY (dependency down, breaker open) or whose turn came after
+# the tick budget is RELEASED — status back to preprocess_completed,
+# claimable next tick, counted on foremast_degraded_docs{reason} —
+# instead of terminally preprocess_failed (permanent errors keep that
+# reference behavior) or wedging the tick. Two sentinels so the
+# counters attribute the release to the right cause.
+RELEASED = object()  # transient fetch failure
+RELEASED_DEADLINE = object()  # tick budget exceeded
 
 
 def _hist_end_epoch(url: str) -> float | None:
@@ -118,6 +135,7 @@ class BrainWorker:
         band_mode: str = "last",
         tracer=None,  # observe.spans.Tracer (optional)
         mesh=None,  # mesh.node.MeshNode (optional fleet partitioning)
+        degrade: Degradation | None = None,
     ):
         """`band_mode` controls how much of the model band each verdict
         carries back from the device: "last" (default — only the final
@@ -267,6 +285,31 @@ class BrainWorker:
         # at INFO would flood logs at fleet scale
         self._judged_status: dict[str, str] = {}
         self._JUDGED_STATUS_CAP = 16384
+        # Graceful degradation (ISSUE 9): write-behind buffer for store
+        # outages, per-tick deadline, breaker registry + shared
+        # counters. ALWAYS present — when everything is healthy the
+        # machinery costs a try/except per store write and one deadline
+        # compare per chunk. The write-behind age cap is wired to the
+        # stuck window so a late replay can never double-write a doc a
+        # peer's claim-CAS takeover re-judged (the exactly-once net).
+        self._degrade = (
+            degrade
+            if degrade is not None
+            else Degradation.from_env(
+                max_stuck_seconds=self.config.max_stuck_seconds
+            )
+        )
+        self._tick_deadline: float | None = None
+        # the current tick's claim instant (monotonic): write-behind
+        # entries are stamped with THIS, not with the write-failure
+        # time — the buffer's age cutoff must measure from the claim,
+        # because stuck-takeover eligibility runs off the claim's
+        # modified_at. Stamping at buffer time would let a slow
+        # fetch/judge push the replay window past the takeover boundary
+        # and double-write a doc a peer already re-judged.
+        self._tick_claim_mono = time.monotonic()
+        # one WARNING per degradation episode, not per buffered write
+        self._write_degraded = False
 
     # -- preprocess: document -> MetricTasks ----------------------------
 
@@ -328,8 +371,10 @@ class BrainWorker:
         self._meta_cache.put(doc.id, meta)
         return meta
 
-    def _fetch_tasks(self, doc: Document, now: float) -> list[MetricTask] | None:
-        """Fetch every window of every alias; None => preprocess failure."""
+    def _fetch_tasks(self, doc: Document, now: float):
+        """Fetch every window of every alias; None => preprocess failure
+        (permanent), the RELEASED sentinel => transient dependency
+        failure, give the doc back un-judged (ISSUE 9)."""
         aliases, _, _ = self._doc_meta(doc)
         if not aliases:
             return None
@@ -422,6 +467,13 @@ class BrainWorker:
                     )
                 )
         except Exception as e:  # fetch failures fail the preprocess stage
+            if is_transient_error(e):
+                # dependency outage / breaker open: release un-judged
+                # (claimable next tick) instead of terminal failure
+                log.warning(
+                    "preprocess released (transient) for %s: %s", doc.id, e
+                )
+                return RELEASED
             log.warning("preprocess failed for %s: %s", doc.id, e)
             return None
         return tasks
@@ -494,7 +546,7 @@ class BrainWorker:
                 v.alias: v.anomaly_pairs for v in verdicts if v.anomaly_pairs
             }
         self._decide_status(doc, job_verdict, values, now, end)
-        return self.store.update(doc)
+        return self._store_update(doc)
 
     def warmup(self, hist_len: int = 10_080, cur_len: int = 30) -> None:
         """Precompile the scoring programs for the canonical shapes.
@@ -718,6 +770,109 @@ class BrainWorker:
                 self._snapshotter.maybe_snapshot()
         except Exception:  # noqa: BLE001 — durability must not kill ticks
             log.exception("durability housekeeping failed")
+
+    # -- degraded store writes (ISSUE 9) ---------------------------------
+
+    def _store_update(self, doc: Document) -> Document:
+        """`store.update` with write-behind degradation: a TRANSIENT
+        store failure (connection/timeout, 429/5xx, breaker open) parks
+        the doc in the bounded buffer for replay instead of failing the
+        tick; permanent errors propagate."""
+        try:
+            doc = self.store.update(doc)
+            self._write_degraded = False
+            return doc
+        except Exception as e:
+            if not is_transient_error(e):
+                raise
+            self._note_write_degraded(e)
+            # stamped at the CLAIM instant (see _tick_claim_mono)
+            self._degrade.write_behind.add(
+                [doc], now=self._tick_claim_mono
+            )
+            return doc
+
+    def _store_update_many(self, docs: list[Document]) -> None:
+        """Batched `_store_update` (the fast tick's write-back path)."""
+        if not docs:
+            return
+        try:
+            self.store.update_many(docs)
+            self._write_degraded = False
+        except Exception as e:
+            if not is_transient_error(e):
+                raise
+            self._note_write_degraded(e)
+            self._degrade.write_behind.add(
+                docs, now=self._tick_claim_mono
+            )
+
+    def _note_write_degraded(self, e: BaseException) -> None:
+        if not self._write_degraded:
+            log.warning(
+                "store write failed transiently (%s: %s); degrading to "
+                "write-behind — verdicts buffer locally and replay when "
+                "the store heals (docs/operations.md \"Failure modes\")",
+                type(e).__name__, e,
+            )
+            self._write_degraded = True
+        self._degrade.stats.count_event("store", "write_error")
+
+    def _flush_write_behind(self) -> None:
+        """Replay the write-behind backlog (tick start + idle ticks).
+        Entries that aged past the stuck window were dropped by
+        `drain` — claim-CAS takeover owns those docs now."""
+        buf = self._degrade.write_behind
+        if not len(buf):
+            return
+        # headroom for the replay RPC itself: an entry that passes the
+        # age check must also LAND inside the stuck window, so the
+        # drain cutoff advances by the store's round-trip bound (capped
+        # at a third of the window so tiny test windows keep working)
+        margin = min(
+            float(getattr(self.store, "timeout", 10.0) or 10.0),
+            buf.max_age_seconds / 3.0,
+        )
+        entries = buf.drain(margin=margin)
+        if not entries:
+            return
+        docs = [d for _, d in entries]
+        try:
+            self.store.update_many(docs)
+        except Exception as e:
+            buf.requeue(entries)
+            if not is_transient_error(e):
+                raise
+            return
+        self._write_degraded = False
+        self._degrade.stats.count_docs(REASON_REPLAYED, len(docs))
+        self._degrade.stats.count_event("store", "replay_flush")
+        log.info(
+            "write-behind replay: %d buffered doc(s) flushed to the "
+            "recovered store", len(docs),
+        )
+
+    def _release_docs(self, docs: list[Document], reason: str) -> None:
+        """Partial-tick semantics: give docs back un-judged (status →
+        preprocess_completed, claimable next tick) and count them —
+        never wedge a tick behind a slow dependency, never terminally
+        fail a doc for a dependency's transient sin."""
+        if not docs:
+            return
+        for doc in docs:
+            doc.status = STATUS_PREPROCESS_COMPLETED
+        self._store_update_many(docs)
+        self._degrade.stats.count_docs(reason, len(docs))
+        log.warning(
+            "released %d doc(s) un-judged (%s); they stay claimable "
+            "for the next tick", len(docs), reason,
+        )
+
+    def _deadline_exceeded(self) -> bool:
+        return (
+            self._tick_deadline is not None
+            and time.perf_counter() > self._tick_deadline
+        )
 
     # -- columnar fast path ---------------------------------------------
 
@@ -1136,6 +1291,14 @@ class BrainWorker:
             try:
                 return [self.source.fetch(u) for u in urls]
             except Exception as e:
+                if is_transient_error(e):
+                    # dependency outage (or breaker open): release the
+                    # doc un-judged instead of terminally failing it
+                    log.warning(
+                        "preprocess released (transient) for %s: %s",
+                        item[0].id, e,
+                    )
+                    return RELEASED
                 log.warning("preprocess failed for %s: %s", item[0].id, e)
                 return None
 
@@ -1154,6 +1317,7 @@ class BrainWorker:
                 series = [fetch_doc(entry) for entry in fetch_items]
 
         failed = []
+        released = []
         ok_items = []
         ok_joint = []
         for (item, _urls), s in zip(fetch_items, series):
@@ -1162,17 +1326,20 @@ class BrainWorker:
                 doc.status = STATUS_PREPROCESS_FAILED
                 doc.status_code = "500"
                 doc.reason = "metric fetch failed"
-                self.store.update(doc)
+                self._store_update(doc)
                 failed.append(doc)
+            elif s is RELEASED:
+                released.append(item[0])
             elif len(item) == 4:
                 ok_items.append((item, s))
             else:
                 ok_joint.append((item, s))
+        self._release_docs(released, REASON_FETCH)
         if self.metrics:
             for doc in failed:
                 self.metrics.observe_doc(doc.status, 0)
         if not ok_items and not ok_joint:
-            return len(failed), slow
+            return len(failed) + len(released), slow
         updated_all: list = []
         n_joint = 0
         kind_counts = {"univariate": 0, "bivariate": 0, "lstm": 0}
@@ -1192,8 +1359,8 @@ class BrainWorker:
         with span(
             "worker.write_back", stage="write_back", docs=len(updated_all)
         ):
-            self.store.update_many(updated_all)
-        return len(ok_items) + n_joint + len(failed), slow
+            self._store_update_many(updated_all)
+        return len(ok_items) + n_joint + len(failed) + len(released), slow
 
     def _judge_uni_fast(self, ok_items, now: float) -> list:
         """Columnar warm judgment of admitted univariate rows: one
@@ -1368,7 +1535,12 @@ class BrainWorker:
 
     def _tick(self, now: float | None = None) -> int:
         t0 = time.perf_counter()
+        self._tick_deadline = self._degrade.deadline(t0)
         now = time.time() if now is None else now
+        # replay any write-behind backlog FIRST: the store may have
+        # healed, and re-check docs buffered as preprocess_completed
+        # must become claimable before this tick's claim
+        self._flush_write_behind()
         claim_kw = {}
         if self.mesh is not None:
             # idle ticks renew too — the lease must outlive quiet
@@ -1376,13 +1548,32 @@ class BrainWorker:
             # injectable clocks, not this tick's possibly-simulated now)
             self.mesh.on_tick()
             claim_kw["claim_filter"] = self.mesh.claim_filter
+        self._tick_claim_mono = time.monotonic()
         with span("worker.claim", stage="claim", limit=self.claim_limit):
-            docs = self.store.claim(
-                self.worker_id,
-                self.config.max_stuck_seconds,
-                self.claim_limit,
-                **claim_kw,
-            )
+            try:
+                docs = self.store.claim(
+                    self.worker_id,
+                    self.config.max_stuck_seconds,
+                    self.claim_limit,
+                    **claim_kw,
+                )
+            except Exception as e:
+                # a store outage must degrade to an idle tick, not kill
+                # the worker loop: nothing was claimed, nothing is owed
+                if not is_transient_error(e):
+                    raise
+                self._degrade.stats.count_event("store", "claim_error")
+                log.warning(
+                    "claim degraded to empty tick (store transient "
+                    "error: %s)", e,
+                )
+                docs = []
+        if docs and self._deadline_exceeded():
+            # the claim alone blew the tick budget (store brownout):
+            # give everything back un-judged rather than start a fetch/
+            # judge pass that is already over budget
+            self._release_docs(docs, REASON_DEADLINE)
+            docs = []
         if not docs:
             # idle cycles still did the claim round-trip (real store I/O)
             # and must be visible on the tick histogram; an idle WORKER
@@ -1487,10 +1678,16 @@ class BrainWorker:
     def _fetch_chunk(self, chunk, now: float, use_pool: bool):
         """Pipeline stage 1: every window of every doc in the chunk.
         Runs on a prefetch thread when the pipeline is engaged; per-doc
-        failures come back as None entries (fail-fast isolation), never
+        failures come back as None entries (fail-fast isolation) or the
+        RELEASED sentinel (transient — released un-judged), never
         exceptions. The fetches are HTTP round trips to Prometheus
         (latency-bound), fanned over the persistent fetch pool so chunk
-        wall-clock scales with the slowest fetch, not the claim count."""
+        wall-clock scales with the slowest fetch, not the claim count.
+        A chunk whose turn comes after the tick deadline skips its
+        fetches entirely — every doc releases (partial-tick
+        semantics)."""
+        if self._deadline_exceeded():
+            return [RELEASED_DEADLINE] * len(chunk)
         with span("worker.fetch", stage="metric_fetch", docs=len(chunk)):
             if use_pool:
                 from functools import partial as _partial
@@ -1506,16 +1703,21 @@ class BrainWorker:
     def _judge_chunk(self, chunk, fetched):
         """Pipeline stage 2 (tick thread, strict chunk order): ONE
         batched judgment for every window of the chunk's jobs. Returns
-        (ok_docs, failed_docs, verdicts by job id); store writes belong
-        to stage 3. A judge exception becomes a StageError carrying the
-        failed-only partial result: the chunk's fetch-failure markings
-        must still reach the store (the pre-pipeline loop persisted
-        them before judging), only the writer thread may touch the
-        store, and no further chunk may be dispatched to the broken
-        judge — StageError is exactly that contract."""
+        (ok_docs, failed_docs, verdicts by job id, released (doc,
+        reason) pairs); store writes belong to stage 3. A judge
+        exception becomes a StageError carrying the failed/released
+        partial result: the chunk's fetch-failure markings must still
+        reach the store (the pre-pipeline loop persisted them before
+        judging), only the writer thread may touch the store, and no
+        further chunk may be dispatched to the broken judge —
+        StageError is exactly that contract. A chunk reaching the judge
+        after the tick deadline releases every fetched doc un-judged
+        (partial-tick semantics) instead of running over budget."""
         all_tasks: list[MetricTask] = []
         failed: list[Document] = []
         ok_docs: list[Document] = []
+        released: list[tuple[Document, str]] = []
+        past_deadline = self._deadline_exceeded()
         for doc, tasks in zip(chunk, fetched):
             # claim() already flipped + persisted preprocess_inprogress
             if tasks is None:
@@ -1523,6 +1725,10 @@ class BrainWorker:
                 doc.status_code = "500"
                 doc.reason = "metric fetch failed"
                 failed.append(doc)
+            elif tasks is RELEASED:
+                released.append((doc, REASON_FETCH))
+            elif tasks is RELEASED_DEADLINE or past_deadline:
+                released.append((doc, REASON_DEADLINE))
             else:
                 ok_docs.append(doc)
                 all_tasks.extend(tasks)
@@ -1531,11 +1737,11 @@ class BrainWorker:
         except BaseException as e:  # noqa: BLE001 — re-raised post-drain
             from foremast_tpu.jobs.pipeline import StageError
 
-            raise StageError(e, ([], failed, {})) from e
+            raise StageError(e, ([], failed, {}, released)) from e
         by_job: dict[str, list[MetricVerdict]] = {}
         for v in verdicts:
             by_job.setdefault(v.job_id, []).append(v)
-        return ok_docs, failed, by_job
+        return ok_docs, failed, by_job, released
 
     def _write_chunk(self, chunk, result, now: float) -> None:
         """Pipeline stage 3 (single writer thread, FIFO): status
@@ -1544,9 +1750,18 @@ class BrainWorker:
         valid; the store is only ever called from one thread at a time
         during the slow path (the writer), preserving the serial loop's
         write sequence one chunk behind the judgment."""
-        ok_docs, failed, by_job = result
+        ok_docs, failed, by_job, released = result
+        if released:
+            # one bulk write per reason group, not a round trip per doc
+            # (a blackholed Prometheus releases WHOLE chunks — exactly
+            # when the tick can least afford per-doc write latency)
+            by_reason: dict[str, list[Document]] = {}
+            for doc, reason in released:
+                by_reason.setdefault(reason, []).append(doc)
+            for reason, docs_r in by_reason.items():
+                self._release_docs(docs_r, reason)
         for doc in failed:
-            self.store.update(doc)
+            self._store_update(doc)
             if self.metrics:
                 self.metrics.observe_doc(doc.status, 0)
         with span("worker.decide", stage="decide", docs=len(ok_docs)):
@@ -1661,6 +1876,10 @@ class BrainWorker:
             "claim_limit": self.claim_limit,
             "queue_depth": queue_depth,
             "store_ok": store_ok,
+            # ES connect-retry progress (jobs/store.py wait_ready): a
+            # worker stuck dialing the store reads as "retrying", not
+            # as a hang; None for stores without the loop (in-memory)
+            "store_connect": getattr(self.store, "connect_state", None),
             "model_cache": {
                 "fit_entries": len(self._fit_cache),
                 "fit_capacity": self.config.max_cache_size,
@@ -1712,6 +1931,11 @@ class BrainWorker:
                 if self._fit_journals or self._snapshotter is not None
                 else None
             ),
+            # chaos plane + graceful degradation (ISSUE 9): write-behind
+            # occupancy, tick budget, per-edge breaker states, released/
+            # buffered/replayed doc counters, active chaos plan (tests/
+            # soaks only — None in production)
+            "degradation": self._degrade.debug_state(),
         }
         # registered knobs explicitly set in this process's env — with
         # the config fingerprint, the enumerable answer to "why do two

@@ -15,6 +15,16 @@ READER's clock) as dead. Clocks therefore need only coarse agreement —
 a skew much smaller than the lease, the same assumption the store's
 MAX_STUCK_IN_SECONDS takeover already makes about ``modified_at``.
 
+The tolerance is pinned (`CLOCK_SKEW_TOLERANCE_FRACTION`, test:
+tests/test_mesh.py clock-skew cases): a renewing member's record is at
+most ``lease/3`` stale (the renewal cadence) plus store write latency,
+so a reader whose clock runs FAST by strictly less than ``2/3 ×
+lease_seconds`` can never see a healthy renewing peer as expired.
+Deployments should keep worst-case clock skew at or below ``lease/2``
+(7.5 s at the 15 s default) — comfortably inside the bound with margin
+for write latency. A reader running SLOW only delays dead-peer
+detection; it never falsely kills anyone.
+
 Dead-peer handling is deliberately lazy: an expired record simply
 stops counting toward `live_members`, the hash ring heals around it
 (mesh/partition.py minimal movement), and the dead worker's in-flight
@@ -45,6 +55,10 @@ STATUS_MESH_MEMBER = "mesh_member"
 STATUS_MESH_LEFT = "mesh_left"
 
 DEFAULT_LEASE_SECONDS = 15.0
+
+# A fast reader tolerates skew < lease × (1 - 1/3 renewal cadence);
+# ops guidance is half the lease (see module docstring — test-pinned).
+CLOCK_SKEW_TOLERANCE_FRACTION = 2.0 / 3.0
 
 
 def member_doc_id(worker_id: str) -> str:

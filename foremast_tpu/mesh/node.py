@@ -75,12 +75,31 @@ class MeshNode:
         injectable clocks, so a test driving worker.tick(now=t) injects
         clocks there instead of threading t through here (a parameter
         that was accepted but ignored would make simulated-time tests
-        lie)."""
+        lie).
+
+        A TRANSIENT store failure during renew/refresh degrades, never
+        raises (ISSUE 9): the lease holds until expiry and retries next
+        tick, the ring keeps its last view — a stale ring only
+        mis-scopes claims, and claim-CAS already nets double judgment.
+        A store down long enough to expire the lease costs this worker
+        its seat, exactly the price a genuinely dead worker pays."""
         if not self._started:
             self.start()
             return
-        self.membership.renew()
-        if self.router.refresh() and self.ring_store is not None:
+        from foremast_tpu.chaos.degrade import is_transient_error
+
+        try:
+            self.membership.renew()
+            changed = self.router.refresh()
+        except Exception as e:
+            if not is_transient_error(e):
+                raise
+            log.warning(
+                "mesh renew/refresh degraded (transient store error: "
+                "%s); keeping the last ring view", e,
+            )
+            return
+        if changed and self.ring_store is not None:
             dropped = self.ring_store.evict_unowned(self.router.owns_series)
             if dropped:
                 log.info(

@@ -250,11 +250,16 @@ class RoutingPusher:
         buffer_bytes: int = DEFAULT_PUSH_BUFFER_BYTES,
         sleep=time.sleep,
         rng=None,
+        chaos=None,
     ):
         if not addresses:
             raise ValueError("RoutingPusher needs at least one address")
         self.addresses = list(addresses)
         self.timeout = timeout
+        # chaos.EdgeChaos seam (ISSUE 9) at the POST choke point;
+        # injected faults are OSErrors, so they exercise exactly the
+        # retry-then-buffer degradation a real receiver outage would
+        self.chaos = chaos
         self.retries = max(0, int(retries))
         self.backoff_seconds = float(backoff_seconds)
         self.buffer_bytes = int(buffer_bytes)
@@ -280,6 +285,8 @@ class RoutingPusher:
 
         from foremast_tpu.ingest.receiver import WRITE_PATH
 
+        if self.chaos is not None:
+            self.chaos.perturb(address)
         req = urllib.request.Request(
             f"http://{address}{WRITE_PATH}",
             data=_json.dumps({"timeseries": entries}).encode(),

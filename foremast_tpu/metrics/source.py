@@ -83,6 +83,14 @@ class PrometheusSource(MetricSource):
     exponential jittered backoff — a single flaky round trip must not
     fail the whole document's preprocess stage. Non-transient errors
     (4xx, parse errors) still raise on the first attempt.
+
+    Chaos/degradation seams (ISSUE 9, both default None = pass-through):
+    `chaos` (chaos.EdgeChaos) perturbs every attempt at this — the one
+    — request choke point; `breaker` (chaos.CircuitBreaker) is checked
+    once per fetch and records the fetch's final outcome, so a dead
+    Prometheus fails further fetches in microseconds (BreakerOpen is a
+    ConnectionError — existing fetch-failure isolation applies) instead
+    of a full timeout-times-retries stall per document.
     """
 
     def __init__(
@@ -91,6 +99,8 @@ class PrometheusSource(MetricSource):
         timeout: float = 10.0,
         retries: int | None = None,
         backoff_seconds: float = 0.25,
+        chaos=None,
+        breaker=None,
     ):
         self._injected = session
         self._local = threading.local()
@@ -99,6 +109,8 @@ class PrometheusSource(MetricSource):
             retries = int(os.environ.get("FOREMAST_FETCH_RETRIES", "") or 2)
         self.retries = max(0, retries)
         self.backoff_seconds = backoff_seconds
+        self.chaos = chaos
+        self.breaker = breaker
 
     @property
     def _session(self):
@@ -113,15 +125,29 @@ class PrometheusSource(MetricSource):
 
     def _get_with_retries(self, url: str):
         transient = _transient_exceptions()
+        breaker = self.breaker
+        if breaker is not None:
+            breaker.allow()  # BreakerOpen (a ConnectionError) fails fast
+        chaos = self.chaos
         for attempt in range(self.retries + 1):
             last = attempt == self.retries
             try:
+                if chaos is not None:
+                    chaos.perturb(url)  # injected faults are transient
                 resp = self._session.get(url, timeout=self.timeout)
             except transient:
                 if last:
+                    if breaker is not None:
+                        breaker.record_failure()
                     raise
             else:
-                if resp.status_code not in RETRY_STATUSES or last:
+                if resp.status_code not in RETRY_STATUSES:
+                    if breaker is not None:
+                        breaker.record_success()
+                    return resp
+                if last:
+                    if breaker is not None:
+                        breaker.record_failure()
                     return resp
             # bounded jittered exponential backoff: 0.5-1x of
             # base * 2^attempt, so a thundering herd of claim fetches

@@ -55,6 +55,13 @@ ALLOWED_LABELS: dict[str, frozenset[str]] = {
     "foremast_mesh_rebalances": frozenset(),
     "foremast_mesh_redirect_hints": frozenset(),
     "foremast_mesh_claim_docs": frozenset({"result"}),
+    # chaos plane + degradation (foremast_tpu/chaos/collector.py)
+    "foremast_chaos_injections": frozenset({"edge", "kind"}),
+    "foremast_breaker_state": frozenset({"edge"}),
+    "foremast_breaker_transitions": frozenset({"edge", "state"}),
+    "foremast_breaker_short_circuits": frozenset({"edge"}),
+    "foremast_degraded_docs": frozenset({"reason"}),
+    "foremast_degraded_events": frozenset({"edge", "action"}),
     # durable data plane (foremast_tpu/ingest/snapshot.py SnapshotCollector)
     "foremast_snapshot_discards": frozenset({"reason"}),
     "foremast_snapshot_restored_series": frozenset(),
@@ -134,6 +141,28 @@ FAMILY_DOCS: dict[str, str] = {
     ),
     "foremast_mesh_claim_docs": (
         "documents seen by the partition claim filter (owned/skipped)"
+    ),
+    "foremast_chaos_injections": (
+        "faults injected by the active FOREMAST_CHAOS_PLAN, by "
+        "dependency edge and fault kind"
+    ),
+    "foremast_breaker_state": (
+        "circuit-breaker state per dependency edge "
+        "(0=closed, 1=half-open, 2=open)"
+    ),
+    "foremast_breaker_transitions": (
+        "circuit-breaker state transitions, by edge and target state"
+    ),
+    "foremast_breaker_short_circuits": (
+        "calls rejected without touching the dependency (breaker open)"
+    ),
+    "foremast_degraded_docs": (
+        "documents handled by degradation machinery (released "
+        "un-judged, buffered/replayed/dropped write-backs), by reason"
+    ),
+    "foremast_degraded_events": (
+        "non-per-document degradation events (claim errors survived, "
+        "receiver sheds, replay flushes), by edge and action"
     ),
     "foremast_snapshot_discards": (
         "state discarded during snapshot restore, by reason"
@@ -241,6 +270,24 @@ def default_registry_families():
     node.claim_filter(Document(id="lint-doc", app_name="lint-app"))
     node.claim_counts["skipped"] += 1  # both label values must appear
     registry.register(MeshCollector(node))
+    # chaos plane: a plan with one fired rule, a breaker walked through
+    # its states, and one counter of each degradation family
+    from foremast_tpu.chaos import ChaosCollector, Degradation, FaultPlan
+
+    plan = FaultPlan(
+        rules=({"edge": "lint", "error_rate": 1.0},), seed=1
+    ).activate()
+    try:
+        plan.edge("lint").perturb("lint-op")
+    except ConnectionError:
+        pass
+    degrade = Degradation(chaos_plan=plan)
+    br = degrade.breakers.get("lint")
+    for _ in range(br.failure_threshold):
+        br.record_failure()
+    degrade.stats.count_docs("deadline_released")
+    degrade.stats.count_event("receiver", "shed")
+    registry.register(ChaosCollector(degrade))
     return registry
 
 

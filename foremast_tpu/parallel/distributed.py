@@ -186,12 +186,28 @@ class LeaderStore(JobStore):
         self.inner = inner
 
     def claim(self, worker_id, max_stuck_seconds, limit=64):
-        docs = (
-            self.inner.claim(worker_id, max_stuck_seconds, limit)
-            if is_leader()
-            else None
-        )
-        return broadcast_obj(docs)
+        # a leader-side claim failure must CROSS the broadcast (ISSUE 9):
+        # raising before broadcast_obj would leave every follower blocked
+        # in the collective while the leader's worker loop moved on —
+        # silent broadcast misalignment, worse than the crash it used to
+        # be. The error ships as a marker and re-raises on every process
+        # with its transience preserved, so the worker's claim
+        # degradation (transient -> empty tick) stays pod-consistent.
+        if is_leader():
+            try:
+                docs = self.inner.claim(worker_id, max_stuck_seconds, limit)
+            except Exception as e:  # noqa: BLE001 — must cross processes
+                from foremast_tpu.chaos.degrade import is_transient_error
+
+                docs = _ClaimError(repr(e), is_transient_error(e))
+        else:
+            docs = None
+        docs = broadcast_obj(docs)
+        if isinstance(docs, _ClaimError):
+            if docs.transient:
+                raise ConnectionError(docs.msg)
+            raise RuntimeError(docs.msg)
+        return docs
 
     def update(self, doc):
         if is_leader():
@@ -262,6 +278,16 @@ class _FetchError:
         self.msg = msg
 
 
+class _ClaimError:
+    """Broadcast marker for a leader-side claim failure (see
+    `LeaderStore.claim`); `transient` carries the degradation
+    classification across processes."""
+
+    def __init__(self, msg: str, transient: bool):
+        self.msg = msg
+        self.transient = transient
+
+
 class PodWorker(BrainWorker):
     """BrainWorker for the pod-spanning mode: broadcast tick clock.
 
@@ -301,6 +327,14 @@ class PodWorker(BrainWorker):
             if is_leader()
             else None
         )
+        # the per-tick deadline (ISSUE 9 partial-tick release) decides
+        # per-doc control flow off a LOCAL wall clock: two processes
+        # disagreeing on "past the budget" would judge differently-
+        # shaped batches into one SPMD program and deadlock the
+        # collectives. Until the release decision is leader-broadcast,
+        # pod mode runs unbudgeted (the pod watchdog still bounds a
+        # wedged collective via FOREMAST_POD_TIMEOUT_SECONDS).
+        self._degrade.tick_budget_seconds = 0.0
         if knobs is not None and not is_leader():
             self.cold_chunk_docs = knobs[0]
             # pipeline depth/pool size are broadcast for completeness:

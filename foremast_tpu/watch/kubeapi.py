@@ -331,6 +331,22 @@ class HttpKube:
     Deployments, list on ReplicaSets/Pods/Namespaces, CRUD on the two
     foremast CRDs. Uses blocking urllib (call sites run it via
     ``asyncio.to_thread`` when inside the event loop).
+
+    Robustness (ISSUE 9 satellite — this was the last HTTP client with
+    neither timeouts nor a retry policy): every request carries an
+    explicit socket timeout (`timeout`, env
+    ``FOREMAST_KUBE_TIMEOUT_SECONDS``, covering connect AND read — the
+    urllib timeout applies to each blocking socket op), and transient
+    failures on GETs retry with jittered exponential backoff under
+    exactly `PrometheusSource`'s classification: HTTP 429/5xx +
+    connection/timeout errors retry (`retries`, env
+    ``FOREMAST_FETCH_RETRIES``), hard 4xx fails fast (404 stays
+    `NotFound`). Writes (POST/PUT/PATCH/DELETE) stay single-shot: a
+    timeout is AMBIGUOUS — the server may have committed — so a blind
+    retry could duplicate an Event or turn a committed PUT into a
+    spurious 409; the control loop's own reconcile cycle is the retry
+    for writes. `chaos`/`breaker` (both default None = pass-through)
+    are the ISSUE 9 seams at the one request choke point.
     """
 
     def __init__(
@@ -338,6 +354,11 @@ class HttpKube:
         base_url: str | None = None,
         token: str | None = None,
         ca_file: str | None = None,
+        timeout: float | None = None,
+        retries: int | None = None,
+        backoff_seconds: float = 0.25,
+        chaos=None,
+        breaker=None,
     ) -> None:
         host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
@@ -348,9 +369,27 @@ class HttpKube:
         self.token = token
         ca = ca_file or (f"{_SA_DIR}/ca.crt" if os.path.exists(f"{_SA_DIR}/ca.crt") else None)
         self._ctx = ssl.create_default_context(cafile=ca) if ca else None
+        if timeout is None:
+            timeout = float(
+                os.environ.get("FOREMAST_KUBE_TIMEOUT_SECONDS", "") or 30.0
+            )
+        self.timeout = timeout
+        if retries is None:
+            retries = int(os.environ.get("FOREMAST_FETCH_RETRIES", "") or 2)
+        self.retries = max(0, int(retries))
+        self.backoff_seconds = float(backoff_seconds)
+        self.chaos = chaos
+        self.breaker = breaker
 
+    # the transient-status set shared with PrometheusSource: throttling
+    # and server-side failures retry; configuration errors fail fast
     def _req(self, method: str, path: str, body: dict | None = None,
              content_type: str = "application/json") -> dict:
+        import random as _random
+        import time as _time
+
+        from foremast_tpu.metrics.source import RETRY_STATUSES
+
         url = f"{self.base_url}{path}"
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
@@ -359,13 +398,51 @@ class HttpKube:
             req.add_header("Content-Type", content_type)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
-        try:
-            with urllib.request.urlopen(req, context=self._ctx, timeout=30) as resp:
-                return json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                raise NotFound(path)
-            raise
+        breaker = self.breaker
+        if breaker is not None:
+            breaker.allow()
+        # non-idempotent verbs never retry (see class docstring)
+        retries = self.retries if method == "GET" else 0
+        for attempt in range(retries + 1):
+            last = attempt == retries
+            try:
+                if self.chaos is not None:
+                    self.chaos.perturb(path)
+                with urllib.request.urlopen(
+                    req, context=self._ctx, timeout=self.timeout
+                ) as resp:
+                    out = json.loads(resp.read() or b"{}")
+                if breaker is not None:
+                    breaker.record_success()
+                return out
+            except urllib.error.HTTPError as e:
+                code = e.code
+                e.close()
+                if code not in RETRY_STATUSES:
+                    # the API server ANSWERED: the endpoint is alive
+                    # regardless of what it thought of the request
+                    if breaker is not None:
+                        breaker.record_success()
+                    if code == 404:
+                        raise NotFound(path) from None
+                    raise
+                if last:
+                    if breaker is not None:
+                        breaker.record_failure()
+                    raise
+            except OSError:
+                # URLError (connection refused/reset/DNS), socket
+                # timeouts, and injected chaos faults all land here
+                if last:
+                    if breaker is not None:
+                        breaker.record_failure()
+                    raise
+            _time.sleep(
+                self.backoff_seconds
+                * (2**attempt)
+                * (0.5 + 0.5 * _random.random())
+            )
+        raise AssertionError("unreachable")  # pragma: no cover
 
     # --- builtin workloads ----------------------------------------------
 

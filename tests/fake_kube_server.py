@@ -54,6 +54,46 @@ class FakeKubeState:
             "deploymentmetadatas": {},
         }
         self.requests: list[tuple[str, str, dict]] = []  # (method, path, headers)
+        # fault hooks (ISSUE 9 satellite): chaos tests drive a REAL
+        # server answering real statuses, not monkeypatched clients.
+        # Each fault: {"path": substr, "method": "GET"|None, "status":
+        # int(0=none), "latency": seconds, "times": remaining fires
+        # (None = forever)} — consumed in registration order.
+        self.faults: list[dict] = []
+
+    def add_fault(
+        self,
+        path: str = "",
+        method: str | None = None,
+        status: int = 0,
+        latency: float = 0.0,
+        times: int | None = None,
+    ) -> None:
+        with self.lock:
+            self.faults.append(
+                {
+                    "path": path,
+                    "method": method,
+                    "status": status,
+                    "latency": latency,
+                    "times": times,
+                }
+            )
+
+    def take_fault(self, method: str, path: str) -> dict | None:
+        """Pop (decrement) the first matching armed fault, or None."""
+        with self.lock:
+            for f in self.faults:
+                if f["method"] not in (None, method):
+                    continue
+                if f["path"] and f["path"] not in path:
+                    continue
+                if f["times"] is not None:
+                    if f["times"] <= 0:
+                        continue
+                    f["times"] -= 1
+                return dict(f)
+        return None
 
     def next_rv(self) -> str:
         self.rv += 1
@@ -143,8 +183,25 @@ def _handler(state: FakeKubeState):
                 (self.command, self.path, dict(self.headers.items()))
             )
 
+        def _fault(self) -> bool:
+            """Apply an armed fault hook; True = request already
+            answered (the caller returns immediately)."""
+            f = state.take_fault(self.command, self.path)
+            if f is None:
+                return False
+            if f["latency"]:
+                import time
+
+                time.sleep(f["latency"])
+            if f["status"]:
+                self._send(f["status"], {"reason": "injected fault"})
+                return True
+            return False  # latency-only fault: continue normally
+
         def do_GET(self):
             self._record()
+            if self._fault():
+                return
             kind, ns, name, mode = self._route()
             if kind is None:
                 return self._send(404, {"reason": "NotFound"})
@@ -164,6 +221,8 @@ def _handler(state: FakeKubeState):
 
         def do_POST(self):
             self._record()
+            if self._fault():
+                return
             kind, ns, name, mode = self._route()
             if kind is None or mode == "item":
                 return self._send(404, {"reason": "NotFound"})
@@ -181,6 +240,8 @@ def _handler(state: FakeKubeState):
 
         def do_PUT(self):
             self._record()
+            if self._fault():
+                return
             kind, ns, name, mode = self._route()
             if kind is None or mode != "item":
                 return self._send(404, {"reason": "NotFound"})
@@ -203,6 +264,8 @@ def _handler(state: FakeKubeState):
 
         def do_PATCH(self):
             self._record()
+            if self._fault():
+                return
             kind, ns, name, mode = self._route()
             if kind is None or mode != "item":
                 return self._send(404, {"reason": "NotFound"})
@@ -221,6 +284,8 @@ def _handler(state: FakeKubeState):
 
         def do_DELETE(self):
             self._record()
+            if self._fault():
+                return
             kind, ns, name, mode = self._route()
             if kind is None or mode != "item":
                 return self._send(404, {"reason": "NotFound"})

@@ -215,6 +215,39 @@ def test_restart_bench_small_smoke(capsys):
         assert ln["parked_docs_at_kill"] > 0
 
 
+def test_chaos_bench_small_smoke(capsys):
+    """`make bench-chaos --small` smoke (ISSUE 9): the 3-worker chaos
+    soak — store brownout, prometheus blackhole, pusher flood, skewed
+    clocks, worker crash — with every acceptance assert in-run (the
+    bench FAILS on a lost/duplicated verdict, a breaker that never
+    re-closes, recovery > 2 busy ticks, a lock-witness miss, or an
+    unbounded buffer). The summary line echoes the bars; `make ci`
+    runs this via test-fast."""
+    import benchmarks.chaos_bench as chaos_bench
+
+    chaos_bench.main(["--small"])
+    lines = [
+        json.loads(line)
+        for line in capsys.readouterr().out.strip().splitlines()
+    ]
+    summary = lines[-1]
+    assert summary["config"] == "c-chaos-soak"
+    assert summary["phases"] == [
+        "baseline", "brownout", "blackhole", "flood", "skew", "crash",
+    ]
+    assert summary["no_lost_or_duplicated_verdicts"] is True
+    assert summary["breakers_reclosed"] is True
+    assert summary["recovery_within_2_ticks"] is True
+    assert summary["lock_witness_clean"] is True
+    assert summary["memory_bounded"] is True
+    by_phase = {ln["phase"]: ln for ln in lines}
+    assert by_phase["brownout"]["buffered"] > 0
+    assert by_phase["brownout"]["replayed"] > 0
+    assert by_phase["blackhole"]["released"] > 0
+    assert by_phase["flood"]["sheds"] > 0
+    assert by_phase["crash"]["parked_at_wedge"] > 0
+
+
 def test_plane_bench_small_smoke():
     """Watch-plane scale benchmark (VERDICT r5 #7) at CI shapes: the
     informer resync and the controller poll tick must run and stay

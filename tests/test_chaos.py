@@ -1,0 +1,711 @@
+"""Chaos plane + graceful degradation (ISSUE 9): fault-plan
+determinism/scheduling, circuit-breaker state machine, injection seams
+in the dependency clients, write-behind store degradation with
+exactly-once replay, partial-tick release semantics, and receiver
+overload shedding."""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from foremast_tpu.chaos import (
+    BreakerOpen,
+    ChaosCollector,
+    CircuitBreaker,
+    Degradation,
+    FaultPlan,
+    GuardedSession,
+    InjectedFault,
+    chaos_from_env,
+    is_transient_error,
+)
+from foremast_tpu.chaos.degrade import (
+    REASON_BUFFERED,
+    REASON_DEADLINE,
+    REASON_DROPPED_AGE,
+    REASON_FETCH,
+    REASON_REPLAYED,
+    WriteBehindBuffer,
+)
+
+NOW = 1_760_000_000.0
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_deterministic_across_replays():
+    """Same seed + same call order => identical injection decisions;
+    a different seed diverges (the whole point of seeding)."""
+
+    def run(seed):
+        plan = FaultPlan(
+            rules=({"edge": "prometheus", "error_rate": 0.5},), seed=seed
+        ).activate()
+        edge = plan.edge("prometheus")
+        hits = []
+        for i in range(64):
+            try:
+                edge.perturb(f"http://p/{i}")
+                hits.append(0)
+            except InjectedFault:
+                hits.append(1)
+        return hits
+
+    a, b, c = run(7), run(7), run(8)
+    assert a == b
+    assert a != c
+    assert 0 < sum(a) < 64  # actually probabilistic, not all-or-nothing
+
+
+def test_fault_plan_schedule_windows_and_edges():
+    """Rules fire only inside their [after, after+duration) window on
+    their own edge, measured on the injectable plan clock."""
+    t = [100.0]
+    plan = FaultPlan(
+        rules=(
+            {"edge": "store", "after": 5.0, "duration": 10.0,
+             "error_rate": 1.0},
+        ),
+        clock=lambda: t[0],
+    ).activate()
+    edge = plan.edge("store")
+    other = plan.edge("prometheus")
+    edge.perturb("op")  # t=0: before the window, no fault
+    t[0] = 106.0
+    with pytest.raises(InjectedFault):
+        edge.perturb("op")
+    other.perturb("op")  # other edges untouched
+    t[0] = 116.0
+    edge.perturb("op")  # window over
+    assert plan.injections_snapshot() == {("store", "connection"): 1}
+
+
+def test_fault_plan_latency_blackhole_and_status():
+    t = [0.0]
+    plan = FaultPlan(
+        rules=(
+            {"edge": "a", "latency_seconds": 0.02},
+            {"edge": "b", "blackhole": True},
+            {"edge": "c", "error_rate": 1.0, "kind": "status",
+             "status": 503},
+        ),
+        clock=lambda: t[0],
+    ).activate()
+    t0 = time.perf_counter()
+    plan.edge("a").perturb("x")
+    assert time.perf_counter() - t0 >= 0.02
+    with pytest.raises(TimeoutError):  # blackhole = injected timeout
+        plan.edge("b").perturb("x")
+    fault = plan.edge("c").perturb("x", raise_faults=False)
+    assert fault is not None and fault.status == 503
+    assert is_transient_error(fault)  # faults classify transient
+
+
+def test_fault_plan_op_substring_scoping():
+    plan = FaultPlan(
+        rules=({"edge": "store", "op": "_bulk", "error_rate": 1.0},)
+    ).activate()
+    edge = plan.edge("store")
+    edge.perturb("http://es/documents/_search")  # unscoped op: clean
+    with pytest.raises(InjectedFault):
+        edge.perturb("http://es/documents/_bulk")
+
+
+def test_chaos_from_env_inline_file_and_unset(tmp_path, monkeypatch):
+    monkeypatch.delenv("FOREMAST_CHAOS_PLAN", raising=False)
+    assert chaos_from_env() is None
+    monkeypatch.setenv(
+        "FOREMAST_CHAOS_PLAN",
+        '{"seed": 3, "rules": [{"edge": "store", "error_rate": 1.0}]}',
+    )
+    plan = chaos_from_env()
+    assert plan is not None and plan.seed == 3 and len(plan.rules) == 1
+    p = tmp_path / "plan.json"
+    p.write_text('{"rules": [{"edge": "kube"}]}')
+    monkeypatch.setenv("FOREMAST_CHAOS_PLAN", f"@{p}")
+    assert chaos_from_env().rules[0].edge == "kube"
+    monkeypatch.setenv("FOREMAST_CHAOS_PLAN", '{"rules": [{"bad": 1}]}')
+    with pytest.raises((ValueError, TypeError)):
+        chaos_from_env()  # a chaos run that tests nothing must not start
+
+
+def test_clock_skew_edge():
+    t = [50.0]
+    plan = FaultPlan(
+        rules=({"edge": "clock", "after": 10.0, "skew_seconds": 7.5},),
+        clock=lambda: t[0],
+    ).activate()
+    clock = plan.edge("clock").clock(base=lambda: 1000.0)
+    assert clock() == 1000.0  # before the window: no skew
+    t[0] = 65.0
+    assert clock() == 1007.5
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_opens_after_threshold_and_recovers_half_open():
+    t = [0.0]
+    br = CircuitBreaker(
+        "es", failure_threshold=3, open_seconds=10.0, clock=lambda: t[0]
+    )
+    for _ in range(2):
+        br.allow()
+        br.record_failure()
+    assert br.state == "closed"
+    br.allow()
+    br.record_failure()
+    assert br.state == "open"
+    with pytest.raises(BreakerOpen) as ei:
+        br.allow()
+    assert isinstance(ei.value, ConnectionError)  # existing nets catch it
+    assert br.short_circuits == 1
+    t[0] = 10.5  # cooldown elapsed: ONE probe allowed
+    br.allow()
+    with pytest.raises(BreakerOpen):
+        br.allow()  # second concurrent probe short-circuits
+    br.record_success()
+    assert br.state == "closed"
+    br.allow()  # closed again: calls flow
+
+
+def test_breaker_failed_probe_reopens_with_fresh_cooldown():
+    t = [0.0]
+    br = CircuitBreaker(
+        "es", failure_threshold=1, open_seconds=5.0, clock=lambda: t[0]
+    )
+    br.allow()
+    br.record_failure()
+    t[0] = 6.0
+    br.allow()  # half-open probe
+    br.record_failure()
+    assert br.state == "open"
+    with pytest.raises(BreakerOpen):
+        br.allow()
+    t[0] = 10.0  # 4s into the FRESH cooldown: still open
+    with pytest.raises(BreakerOpen):
+        br.allow()
+    t[0] = 11.5
+    br.allow()
+    br.record_success()
+    assert br.state == "closed"
+    assert br.transitions["open"] == 2 and br.transitions["closed"] == 1
+
+
+def test_breaker_success_resets_consecutive_failures():
+    br = CircuitBreaker("p", failure_threshold=2)
+    for _ in range(5):
+        br.allow()
+        br.record_failure()
+        br.allow()
+        br.record_success()
+    assert br.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# client seams
+# ---------------------------------------------------------------------------
+
+
+class _Resp:
+    def __init__(self, status=200):
+        self.status_code = status
+
+    def raise_for_status(self):
+        if self.status_code >= 400:
+            raise RuntimeError(f"http {self.status_code}")
+
+    def json(self):
+        return {
+            "status": "success",
+            "data": {"result": [{"values": [[100, "1.0"]]}]},
+        }
+
+
+class _OkSession:
+    def __init__(self):
+        self.calls = 0
+
+    def get(self, url, timeout=None):
+        self.calls += 1
+        return _Resp(200)
+
+
+def test_prometheus_source_chaos_injection_exhausts_retries():
+    from foremast_tpu.metrics.source import PrometheusSource
+
+    plan = FaultPlan(
+        rules=({"edge": "prometheus", "error_rate": 1.0},)
+    ).activate()
+    sess = _OkSession()
+    src = PrometheusSource(
+        session=sess, retries=2, backoff_seconds=0.001,
+        chaos=plan.edge("prometheus"),
+    )
+    with pytest.raises(InjectedFault):
+        src.fetch("http://p/q")
+    assert sess.calls == 0  # faults injected BEFORE the wire
+    assert plan.injections_snapshot()[("prometheus", "connection")] == 3
+
+
+def test_prometheus_source_breaker_opens_and_fails_fast():
+    from foremast_tpu.metrics.source import PrometheusSource
+
+    class _DeadSession:
+        def __init__(self):
+            self.calls = 0
+
+        def get(self, url, timeout=None):
+            self.calls += 1
+            raise ConnectionError("refused")
+
+    t = [0.0]
+    br = CircuitBreaker(
+        "prometheus", failure_threshold=2, open_seconds=30.0,
+        clock=lambda: t[0],
+    )
+    sess = _DeadSession()
+    src = PrometheusSource(
+        session=sess, retries=0, backoff_seconds=0.001, breaker=br
+    )
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            src.fetch("http://p/q")
+    assert br.state == "open"
+    wire_calls = sess.calls
+    with pytest.raises(BreakerOpen):
+        src.fetch("http://p/q")
+    assert sess.calls == wire_calls  # short-circuited, no wire attempt
+    # endpoint heals; cooldown elapses; the probe re-closes the breaker
+    sess.get = lambda url, timeout=None: _Resp(200)
+    t[0] = 31.0
+    ts, vs = src.fetch("http://p/q")
+    assert br.state == "closed"
+    assert ts.tolist() == [100]
+
+
+def test_guarded_session_wraps_chaos_and_breaker():
+    plan = FaultPlan(rules=({"edge": "store", "error_rate": 1.0},)).activate()
+    br = CircuitBreaker("store", failure_threshold=2, open_seconds=60.0)
+    inner = _OkSession()
+    gs = GuardedSession(inner, chaos=plan.edge("store"), breaker=br)
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            gs.get("http://es/")
+    with pytest.raises(BreakerOpen):
+        gs.get("http://es/")
+    assert inner.calls == 0
+    # non-verb attributes delegate (ES store reads .headers etc.)
+    inner.headers = {"x": "y"}
+    assert gs.headers == {"x": "y"}
+
+
+def test_guarded_session_counts_5xx_as_breaker_failure():
+    class _FiveHundred:
+        def post(self, url, **kw):
+            return _Resp(503)
+
+    br = CircuitBreaker("store", failure_threshold=2)
+    gs = GuardedSession(_FiveHundred(), breaker=br)
+    gs.post("http://es/_bulk")
+    gs.post("http://es/_bulk")
+    assert br.state == "open"
+
+
+# ---------------------------------------------------------------------------
+# write-behind buffer
+# ---------------------------------------------------------------------------
+
+
+def test_write_behind_caps_and_ages_out():
+    t = [0.0]
+    buf = WriteBehindBuffer(max_docs=3, max_age_seconds=10.0, clock=lambda: t[0])
+    buf.add(["d1", "d2", "d3", "d4"])  # cap 3: d1 drops (oldest)
+    assert len(buf) == 3
+    snap = buf.stats.docs_snapshot()
+    assert snap[REASON_BUFFERED] == 4
+    assert snap["write_dropped_cap"] == 1
+    t[0] = 11.0  # everything aged past the stuck window
+    assert buf.drain() == []
+    assert buf.stats.docs_snapshot()[REASON_DROPPED_AGE] == 3
+    assert len(buf) == 0
+
+
+def test_write_behind_requeue_preserves_age():
+    t = [0.0]
+    buf = WriteBehindBuffer(max_docs=8, max_age_seconds=10.0, clock=lambda: t[0])
+    buf.add(["d1"])
+    t[0] = 6.0
+    entries = buf.drain()
+    assert [d for _, d in entries] == ["d1"]
+    buf.requeue(entries)  # replay failed: back with the ORIGINAL stamp
+    t[0] = 11.0
+    assert buf.drain() == []  # aged from first buffering, not requeue
+    assert buf.stats.docs_snapshot()[REASON_DROPPED_AGE] == 1
+
+
+# ---------------------------------------------------------------------------
+# worker degradation (the ISSUE 9 acceptance pins)
+# ---------------------------------------------------------------------------
+
+
+class _OutageStore:
+    """Delegating store whose write path (or claim) can be browned out
+    with transient errors — the ES-outage stand-in."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.fail_writes = False
+        self.fail_claims = False
+        self.write_log = []  # (doc_id, status) per landed write
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def claim(self, *a, **kw):
+        if self.fail_claims:
+            raise ConnectionError("store down (claim)")
+        return self.inner.claim(*a, **kw)
+
+    def update(self, doc):
+        if self.fail_writes:
+            raise ConnectionError("store down (write)")
+        self.write_log.append((doc.id, doc.status))
+        return self.inner.update(doc)
+
+    def update_many(self, docs):
+        if self.fail_writes:
+            raise ConnectionError("store down (write)")
+        self.write_log.extend((d.id, d.status) for d in docs)
+        return self.inner.update_many(docs)
+
+
+def _mk_worker(services=3, **worker_kw):
+    from benchmarks.worker_bench import build_fleet
+    from foremast_tpu.config import BrainConfig
+    from foremast_tpu.jobs import BrainWorker
+
+    store, source = build_fleet(services, 256, 30, NOW, seed=0)
+    outage = _OutageStore(store)
+    cfg = BrainConfig(
+        algorithm="moving_average_all", season_steps=24,
+        max_cache_size=4 * services + 64,
+    )
+    worker = BrainWorker(
+        outage, source, config=cfg, claim_limit=2 * services,
+        worker_id="chaos-w", **worker_kw,
+    )
+    return worker, outage, store, source
+
+
+def test_es_outage_mid_warm_tick_degrades_then_replays_exactly_once():
+    """THE acceptance pin: a full store outage during a warm tick
+    buffers write-back (degraded-mode counters) instead of failing the
+    tick; replay after recovery lands each doc's verdict exactly once."""
+    worker, outage, store, _source = _mk_worker(3)
+    assert worker.tick(now=NOW + 150) == 3  # tick 1: warm the fits
+    # every doc is a re-check doc (endTime in the future): healthy
+    # ticks leave them preprocess_completed
+    sts = {d.id: d.status for d in store._docs.values()}
+    assert set(sts.values()) == {"preprocess_completed"}
+
+    outage.fail_writes = True
+    outage.write_log.clear()
+    n = worker.tick(now=NOW + 210)  # warm tick THROUGH the outage
+    assert n == 3  # the tick did not fail wholesale
+    assert outage.write_log == []  # nothing reached the store...
+    snap = worker._degrade.stats.docs_snapshot()
+    assert snap[REASON_BUFFERED] == 3  # ...everything buffered
+    assert len(worker._degrade.write_behind) == 3
+    state = worker.debug_state()["degradation"]
+    assert state["write_behind"]["buffered_docs"] == 3
+    # (no store-status assertion here: InMemoryStore shares Document
+    # OBJECTS with the worker, so in-place status mutations are visible
+    # even though no update() landed — write_log above is the honest
+    # record of what reached the store's write path)
+
+    outage.fail_writes = False
+    n = worker.tick(now=NOW + 270)  # heals: replay THEN a normal tick
+    assert n == 3
+    assert worker._degrade.stats.docs_snapshot()[REASON_REPLAYED] == 3
+    assert len(worker._degrade.write_behind) == 0
+    # exactly-once: each doc got ONE replayed write of the buffered
+    # status, then one write from this tick's own judgment
+    per_doc = {}
+    for doc_id, status in outage.write_log:
+        per_doc.setdefault(doc_id, []).append(status)
+    assert all(
+        v == ["preprocess_completed", "preprocess_completed"]
+        for v in per_doc.values()
+    ), per_doc
+    worker.close()
+
+
+def test_claim_outage_degrades_to_empty_tick_not_a_crash():
+    worker, outage, _store, _source = _mk_worker(2)
+    outage.fail_claims = True
+    assert worker.tick(now=NOW + 150) == 0  # no exception
+    events = worker._degrade.stats.events_snapshot()
+    assert events[("store", "claim_error")] == 1
+    outage.fail_claims = False
+    assert worker.tick(now=NOW + 160) == 2  # worker still usable
+    worker.close()
+
+
+def test_transient_fetch_failure_releases_doc_not_terminal():
+    """A doc whose fetch fails TRANSIENTLY (dependency down / breaker
+    open) is released un-judged — claimable next tick — while a
+    permanent fetch error keeps the reference's preprocess_failed."""
+    worker, outage, store, source = _mk_worker(3)
+    worker._fast_tick = lambda docs, now: (0, docs)  # force slow path
+    source.concurrent_fetch = True
+    orig_fetch = source.fetch
+
+    def fetch(url):
+        if "app0" in url:
+            raise ConnectionError("prometheus down")  # transient
+        if "app1" in url:
+            raise RuntimeError("bad query")  # permanent
+        return orig_fetch(url)
+
+    source.fetch = fetch
+    assert worker.tick(now=NOW + 150) == 3
+    sts = {d.id: d.status for d in store._docs.values()}
+    assert sts["job-0"] == "preprocess_completed"  # released, no verdict
+    assert sts["job-1"] == "preprocess_failed"  # permanent: terminal
+    assert sts["job-2"] == "preprocess_completed"  # judged normally
+    assert worker._degrade.stats.docs_snapshot()[REASON_FETCH] == 1
+    worker.close()
+
+
+def test_tick_budget_releases_unfetched_chunks():
+    """Partial-tick semantics: chunks whose turn comes after the tick
+    budget release their docs un-judged instead of wedging the tick
+    behind a slow dependency."""
+    degrade = Degradation(tick_budget_seconds=0.15)
+    worker, outage, store, source = _mk_worker(6, degrade=degrade)
+    worker._fast_tick = lambda docs, now: (0, docs)
+    worker.cold_chunk_docs = 2
+    worker.pipeline_depth = 1
+    source.concurrent_fetch = True
+    orig_fetch = source.fetch
+
+    def slow_fetch(url):
+        time.sleep(0.02)  # ~0.12s per 2-doc chunk (3 urls per doc)
+        return orig_fetch(url)
+
+    source.fetch = slow_fetch
+    assert worker.tick(now=NOW + 150) == 6
+    sts = {d.id: d.status for d in store._docs.values()}
+    # every doc is accounted for: judged or released, none in-progress
+    assert set(sts.values()) == {"preprocess_completed"}
+    released = worker._degrade.stats.docs_snapshot().get(REASON_DEADLINE, 0)
+    assert released > 0  # the budget actually bit
+    assert worker._last_tick["docs"] == 6
+    worker.close()
+
+
+def test_degradation_debug_state_sections():
+    worker, _outage, _store, _source = _mk_worker(1)
+    state = worker.debug_state()
+    deg = state["degradation"]
+    assert "write_behind" in deg and "breakers" in deg
+    assert deg["chaos"] is None  # no plan: production shape
+    assert state["store_connect"] is None  # in-memory store
+    worker.close()
+
+
+# ---------------------------------------------------------------------------
+# receiver overload shedding
+# ---------------------------------------------------------------------------
+
+
+def _push(addr, payload=b'{"timeseries": []}'):
+    req = urllib.request.Request(
+        f"http://{addr}/api/v1/write", data=payload, method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=5)
+
+
+def test_receiver_sheds_with_429_retry_after_under_overload():
+    from foremast_tpu.chaos.degrade import DegradeStats
+    from foremast_tpu.ingest import RingStore, stop_ingest_server
+    from foremast_tpu.ingest.receiver import start_ingest_server
+
+    # one slow handler (chaos latency) + max_inflight=1 => concurrent
+    # pushes shed deterministically
+    plan = FaultPlan(
+        rules=({"edge": "receiver", "latency_seconds": 0.4},)
+    ).activate()
+    stats = DegradeStats()
+    srv, _ = start_ingest_server(
+        0, RingStore(budget_bytes=1 << 20, shards=1), host="127.0.0.1",
+        max_inflight=1, chaos=plan.edge("receiver"), degrade_stats=stats,
+    )
+    addr = "127.0.0.1:%d" % srv.server_address[1]
+    try:
+        results = {}
+
+        def slow_push():
+            results["slow"] = _push(addr).status
+
+        t = threading.Thread(target=slow_push)
+        t.start()
+        time.sleep(0.1)  # the slow handler is now inside its latency
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _push(addr)
+        assert ei.value.code == 429
+        assert ei.value.headers["Retry-After"] == "1"
+        ei.value.close()
+        t.join()
+        assert results["slow"] == 200  # the in-flight push completed
+        assert stats.events_snapshot()[("receiver", "shed")] >= 1
+        # RoutingPusher classifies 429 as transient (retry-then-buffer)
+        from foremast_tpu.metrics.source import RETRY_STATUSES
+
+        assert 429 in RETRY_STATUSES
+    finally:
+        stop_ingest_server(srv)
+
+
+def test_receiver_chaos_fault_answers_status():
+    from foremast_tpu.ingest import RingStore, stop_ingest_server
+    from foremast_tpu.ingest.receiver import start_ingest_server
+
+    plan = FaultPlan(
+        rules=(
+            {"edge": "receiver", "error_rate": 1.0, "kind": "status",
+             "status": 503},
+        )
+    ).activate()
+    srv, _ = start_ingest_server(
+        0, RingStore(budget_bytes=1 << 20, shards=1), host="127.0.0.1",
+        chaos=plan.edge("receiver"),
+    )
+    addr = "127.0.0.1:%d" % srv.server_address[1]
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _push(addr)
+        assert ei.value.code == 503  # answered, not a dropped thread
+        ei.value.close()
+    finally:
+        stop_ingest_server(srv)
+
+
+# ---------------------------------------------------------------------------
+# ChaosCollector exposition
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_collector_families_lint_clean():
+    from prometheus_client import CollectorRegistry
+
+    from foremast_tpu.observe.metrics_lint import lint_registry
+
+    plan = FaultPlan(rules=({"edge": "x", "error_rate": 1.0},)).activate()
+    with pytest.raises(InjectedFault):
+        plan.edge("x").perturb("op")
+    degrade = Degradation(chaos_plan=plan)
+    br = degrade.breakers.get("x")
+    br.allow()
+    br.record_failure()
+    degrade.stats.count_docs(REASON_DEADLINE)
+    degrade.stats.count_event("receiver", "shed")
+    registry = CollectorRegistry()
+    registry.register(ChaosCollector(degrade))
+    assert lint_registry(registry) == []
+    families = {f.name for f in registry.collect()}
+    assert families == {
+        "foremast_chaos_injections",
+        "foremast_breaker_state",
+        "foremast_breaker_transitions",
+        "foremast_breaker_short_circuits",
+        "foremast_degraded_docs",
+        "foremast_degraded_events",
+    }
+
+
+# ---------------------------------------------------------------------------
+# ElasticsearchStore: guarded session + bounded connect retry
+# ---------------------------------------------------------------------------
+
+
+def test_es_store_chaos_seam_wraps_session():
+    from foremast_tpu.jobs.store import ElasticsearchStore
+
+    plan = FaultPlan(rules=({"edge": "store", "error_rate": 1.0},)).activate()
+    store = ElasticsearchStore(
+        "http://es:9200", session=_OkSession(), chaos=plan.edge("store")
+    )
+    with pytest.raises(InjectedFault):
+        store.get("doc-1")
+    assert plan.injections_snapshot()[("store", "connection")] == 1
+
+
+def test_es_store_wait_ready_deadline_and_stop_and_state():
+    from foremast_tpu.jobs.store import ElasticsearchStore
+
+    class _DownSession:
+        def get(self, url, timeout=None):
+            raise ConnectionError("refused")
+
+    store = ElasticsearchStore("http://es:9200", session=_DownSession())
+    t0 = time.monotonic()
+    assert store.wait_ready(retry_seconds=0.05, max_wait=0.2) is False
+    assert time.monotonic() - t0 < 5.0  # bounded, not forever
+    state = store.connect_state
+    assert state["connected"] is False
+    assert state["attempts"] >= 2
+    assert "ConnectionError" in state["last_error"]
+    # clean shutdown: a stop request is honored between retries
+    t0 = time.monotonic()
+    assert (
+        store.wait_ready(retry_seconds=30.0, stop=lambda: True) is False
+    )
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_breaker_abandoned_probe_reservation_self_heals():
+    """A half-open probe whose caller died without recording an outcome
+    (an unclassified exception between allow() and record_*) must not
+    short-circuit the edge forever: past one cooldown the reservation
+    is considered abandoned and a new probe may take over."""
+    t = [0.0]
+    br = CircuitBreaker(
+        "es", failure_threshold=1, open_seconds=5.0, clock=lambda: t[0]
+    )
+    br.allow()
+    br.record_failure()  # open
+    t[0] = 6.0
+    br.allow()  # probe reserved... and its caller dies silently
+    with pytest.raises(BreakerOpen):
+        br.allow()  # reservation held within the cooldown
+    t[0] = 12.0  # a full cooldown later: reservation abandoned
+    br.allow()  # a NEW probe takes over instead of BreakerOpen forever
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_write_behind_claim_time_stamping_closes_takeover_window():
+    """The worker stamps write-behind entries at the CLAIM instant: an
+    entry buffered late in a slow tick still expires max_age after the
+    CLAIM, so the replay can never land after a peer's stuck-claim
+    takeover (the exactly-once net)."""
+    t = [0.0]
+    buf = WriteBehindBuffer(
+        max_docs=8, max_age_seconds=10.0, clock=lambda: t[0]
+    )
+    claim_at = 0.0
+    t[0] = 9.0  # the write failed 9s into the tick (slow fetch/judge)
+    buf.add(["doc"], now=claim_at)  # stamped at claim, not at failure
+    t[0] = 11.0  # 11s after the CLAIM: takeover owns the doc now
+    assert buf.drain() == []  # dropped, never replayed

@@ -260,3 +260,83 @@ def test_watch_plane_step_against_real_server(srv):
     plane.step(now=1_700_000_000.0)
     monitors = kube.list_monitors("prod")
     assert [m.name for m in monitors] == ["shop"]
+
+
+# ---------------------------------------------------------------------------
+# transient-retry policy + timeouts (ISSUE 9 satellite) — driven through
+# the REAL server's fault hooks, not monkeypatched clients
+# ---------------------------------------------------------------------------
+
+
+def test_httpkube_retries_transient_5xx_then_succeeds(srv):
+    srv.state.put("namespaces", "", {"metadata": {"name": "prod"}})
+    srv.state.add_fault(path="/api/v1/namespaces", status=503, times=2)
+    kube = HttpKube(base_url=srv.url, retries=2, backoff_seconds=0.001)
+    names = [n["metadata"]["name"] for n in kube.list_namespaces()]
+    assert names == ["prod"]
+    # 2 faulted attempts + 1 clean one reached the server
+    assert len([r for r in srv.state.requests if "namespaces" in r[1]]) == 3
+
+
+def test_httpkube_retries_429_and_exhausts_budget(srv):
+    srv.state.add_fault(path="/api/v1/namespaces", status=429)  # forever
+    kube = HttpKube(base_url=srv.url, retries=1, backoff_seconds=0.001)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        kube.list_namespaces()
+    assert ei.value.code == 429
+    assert len([r for r in srv.state.requests if "namespaces" in r[1]]) == 2
+
+
+def test_httpkube_hard_4xx_fails_fast_no_retry(srv):
+    srv.state.add_fault(path="/api/v1/namespaces", status=403)
+    kube = HttpKube(base_url=srv.url, retries=3, backoff_seconds=0.001)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        kube.list_namespaces()
+    assert ei.value.code == 403
+    assert len([r for r in srv.state.requests if "namespaces" in r[1]]) == 1
+
+
+def test_httpkube_404_stays_notfound_after_faulted_retry(srv):
+    srv.state.add_fault(path="/deployments/", status=502, times=1)
+    kube = HttpKube(base_url=srv.url, retries=2, backoff_seconds=0.001)
+    with pytest.raises(NotFound):
+        kube.get_deployment("prod", "ghost")
+
+
+def test_httpkube_explicit_timeout_and_knobs(monkeypatch):
+    monkeypatch.setenv("FOREMAST_KUBE_TIMEOUT_SECONDS", "7.5")
+    monkeypatch.setenv("FOREMAST_FETCH_RETRIES", "4")
+    kube = HttpKube(base_url="http://unused:1")
+    assert kube.timeout == 7.5
+    assert kube.retries == 4
+    monkeypatch.delenv("FOREMAST_KUBE_TIMEOUT_SECONDS")
+    monkeypatch.delenv("FOREMAST_FETCH_RETRIES")
+    kube = HttpKube(base_url="http://unused:1", timeout=3.0, retries=0)
+    assert kube.timeout == 3.0 and kube.retries == 0
+
+
+def test_httpkube_breaker_opens_on_connection_refused():
+    """A dead API server opens the kube breaker; further calls fail in
+    microseconds instead of paying connect timeouts."""
+    from foremast_tpu.chaos import BreakerOpen, CircuitBreaker
+
+    br = CircuitBreaker("kube", failure_threshold=2, open_seconds=60.0)
+    # 127.0.0.1:1 refuses connections immediately
+    kube = HttpKube(
+        base_url="http://127.0.0.1:1", retries=0,
+        backoff_seconds=0.001, timeout=0.2, breaker=br,
+    )
+    for _ in range(2):
+        with pytest.raises(OSError):
+            kube.list_namespaces()
+    with pytest.raises(BreakerOpen):
+        kube.list_namespaces()
+
+
+def test_httpkube_latency_fault_hook_respects_timeout(srv):
+    """The fake server's latency hook + the client's explicit timeout:
+    a hung API server surfaces as a timeout error, not a forever-wait."""
+    srv.state.add_fault(path="/api/v1/namespaces", latency=1.5)
+    kube = HttpKube(base_url=srv.url, timeout=0.2, retries=0)
+    with pytest.raises(OSError):
+        kube.list_namespaces()

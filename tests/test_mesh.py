@@ -117,6 +117,60 @@ def test_membership_join_renew_expire_leave():
     assert [m.worker_id for m in live_members(store, now=t[0])] == ["w-b"]
 
 
+def test_membership_lease_tolerates_reader_clock_skew():
+    """ISSUE 9 satellite: a reader whose clock runs FAST must not
+    declare a healthy renewing peer dead. membership.py reads leases by
+    the READER's clock; a renewing member's record is at most lease/3
+    stale, so the pinned tolerance is skew < 2/3 × lease
+    (`CLOCK_SKEW_TOLERANCE_FRACTION`), with lease/2 the documented ops
+    guidance. This pins both sides of the bound."""
+    from foremast_tpu.mesh.membership import CLOCK_SKEW_TOLERANCE_FRACTION
+
+    lease = 12.0
+    store = InMemoryStore()
+    t = [1000.0]
+    member = Membership(store, "w-m", lease_seconds=lease, clock=_clock(t))
+    member.join()
+    # the member keeps renewing on its own cadence (every lease/3)
+    for step in range(12):
+        t[0] = 1000.0 + (step + 1) * (lease / 3.0)
+        member.renew()
+        # worst-case record staleness right before the NEXT renewal:
+        real_now = t[0] + lease / 3.0 - 0.01
+        # documented guidance (lease/2): always safe
+        assert [
+            m.worker_id
+            for m in live_members(store, now=real_now + lease / 2.0)
+        ] == ["w-m"], f"lease/2-skewed reader killed a healthy peer @{step}"
+        # the pinned bound: any skew strictly under 2/3·lease is safe
+        safe_skew = CLOCK_SKEW_TOLERANCE_FRACTION * lease - 0.05
+        assert [
+            m.worker_id
+            for m in live_members(store, now=real_now + safe_skew)
+        ] == ["w-m"]
+    # and the bound is TIGHT: past 2/3·lease a fast reader CAN misjudge
+    # a peer observed at its stalest (why ops guidance stays at lease/2)
+    stale_now = t[0] + lease / 3.0 - 0.01
+    over_skew = CLOCK_SKEW_TOLERANCE_FRACTION * lease + 0.1
+    assert live_members(store, now=stale_now + over_skew) == []
+
+
+def test_membership_slow_reader_only_delays_death_detection():
+    """A reader running SLOW never falsely kills anyone — it just sees
+    a dead peer as alive for up to the skew longer."""
+    store = InMemoryStore()
+    t = [1000.0]
+    m = Membership(store, "w-dead", lease_seconds=10.0, clock=_clock(t))
+    m.join()
+    # peer dies at t=1000; a true-clock reader drops it at 1010.x
+    assert live_members(store, now=1011.0) == []
+    # a reader 5s slow still sees it until its own clock passes the
+    # lease — delayed detection, never a false kill
+    assert [r.worker_id for r in live_members(store, now=1006.0)] == [
+        "w-dead"
+    ]
+
+
 def test_membership_record_carries_addresses():
     store = InMemoryStore()
     m = Membership(
