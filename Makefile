@@ -43,6 +43,15 @@ bench-ingest:
 bench-scaleout:
 	$(PY) -m benchmarks.scaleout_bench
 
+# cold-start benchmark (ISSUE 10): ring-resident cold fits vs the
+# pull-path baseline at the 16k daily-season shape, 10%-churn tick,
+# short-history newcomer admission + background refinement — with
+# in-run asserts: zero HTTP when the ring covers, byte-identical
+# statuses vs pull, band parity, and (at full shape) the round-12
+# bars (cold <= 120 s, churn <= 8 s, first verdict <= 10 s)
+bench-cold:
+	$(PY) -m benchmarks.cold_bench
+
 # durable-restart crash harness (ISSUE 7): SIGKILL a worker mid-tick,
 # restart it against the same FOREMAST_SNAPSHOT_DIR state, and assert
 # in-run: next tick >= 90% fast-path, ZERO fallback fetches, no lost
@@ -96,4 +105,4 @@ clean:
 	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 
-.PHONY: test test-fast ci bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest bench-scaleout bench-restart bench-chaos native deploy-render check metrics-lint env-docs metrics-docs lockgraph docker-build clean
+.PHONY: test test-fast ci bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest bench-scaleout bench-cold bench-restart bench-chaos native deploy-render check metrics-lint env-docs metrics-docs lockgraph docker-build clean

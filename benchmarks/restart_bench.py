@@ -91,6 +91,17 @@ class _SuicideRing:
                 os.kill(os.getpid(), signal.SIGKILL)
         return self.inner.fetch(url)
 
+    # the ring-first cold path is part of the wrapped surface (ISSUE
+    # 10): a production worker sees RingSource's hist reads directly
+    def hist_columns(self, url, now=None):
+        return self.inner.hist_columns(url, now)
+
+    def hist_coverage(self, url, now=None):
+        return self.inner.hist_coverage(url, now)
+
+    def ingest_debug_state(self):
+        return self.inner.ingest_debug_state()
+
 
 def run_child(args) -> int:
     from foremast_tpu.config import BrainConfig
@@ -155,13 +166,23 @@ def run_child(args) -> int:
     def tick(tag: str) -> int:
         store.tag = tag
         fallback.calls = 0
+        cold0 = worker._cold_snapshot()
         t0 = time.perf_counter()
         n = worker.tick()
+        cold1 = worker._cold_snapshot()
         store.report_tick(
             worker=worker_id, tag=tag, docs=n,
             seconds=round(time.perf_counter() - t0, 4),
             fast=worker._last_tick["fast"],
             fallback_fetches=fallback.calls,
+            ring_hist_reads=(
+                cold1["ring_full"] + cold1["ring_partial"]
+                - cold0["ring_full"] - cold0["ring_partial"]
+            ),
+            http_hist_reads=(
+                cold1["http"] + cold1["cache"]
+                - cold0["http"] - cold0["cache"]
+            ),
             restored_series=restore_stats["restored_series"],
             restored_fits=sum(
                 j.counters["restored_entries"]
@@ -212,6 +233,19 @@ def run_child(args) -> int:
             else:
                 time.sleep(0.5)
             continue
+        if (
+            phase == "coldfit"
+            and args.coldfit
+            and "coldfit" not in done
+        ):
+            # cold-fit recovery (ISSUE 10 satellite): this process
+            # started with the fit journals WIPED — every doc re-fits
+            # cold, and the restored ring must serve those fits alone
+            if tick("coldfit") > 0:
+                arrive("coldfit")
+            else:
+                time.sleep(0.2)
+            continue
         if node is not None:
             node.on_tick()
         time.sleep(0.05)
@@ -227,7 +261,8 @@ def run_child(args) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _spawn(url, snap_dir, worker_id, args, victim=False, recovering=False):
+def _spawn(url, snap_dir, worker_id, args, victim=False, recovering=False,
+           coldfit=False):
     cmd = [
         sys.executable, "-m", "benchmarks.restart_bench", "--child",
         "--store-url", url, "--snapshot-dir", snap_dir,
@@ -243,6 +278,8 @@ def _spawn(url, snap_dir, worker_id, args, victim=False, recovering=False):
         cmd.append("--victim")
     if recovering:
         cmd.append("--recovering")
+    if coldfit:
+        cmd.append("--coldfit")
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("FOREMAST_INGEST", None)
@@ -388,6 +425,52 @@ def run(args, mesh: bool, timeout: float = 900.0) -> dict:
         ]
         assert not lost
 
+        # ---- cold-fit recovery (ISSUE 10 satellite, single variant):
+        # stop the replacement, WIPE the fit journals (only the ring
+        # snapshot/log survives), restart once more — the recovery
+        # tick re-fits every doc COLD and the restored ring alone must
+        # serve those fits with zero fallback fetches
+        coldfit_report = None
+        if not mesh:
+            server.phase = "stop"
+            try:
+                replacement.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                replacement.kill()
+                replacement.wait()
+            for name in os.listdir(dirs[victim_id]):
+                if name.startswith("fit-"):
+                    os.unlink(os.path.join(dirs[victim_id], name))
+            coldfit_proc = _spawn(
+                url, dirs[victim_id], victim_id, args, coldfit=True
+            )
+            server.phase = "coldfit"
+            try:
+                _wait(
+                    lambda: server.barrier_count("coldfit") == 1,
+                    timeout, "cold-fit recovery tick",
+                )
+                cf = next(
+                    r for r in server.tick_reports()
+                    if r["tag"] == "coldfit" and r["docs"] > 0
+                )
+                assert cf["fast"] == 0, cf  # every doc re-fit cold
+                assert cf["fallback_fetches"] == 0, cf
+                assert cf["http_hist_reads"] == 0, cf
+                assert (
+                    cf["ring_hist_reads"]
+                    >= args.services * args.aliases
+                ), cf
+                coldfit_report = cf
+            finally:
+                server.phase = "stop"
+                if coldfit_proc.poll() is None:
+                    try:
+                        coldfit_proc.wait(timeout=60)
+                    except subprocess.TimeoutExpired:
+                        coldfit_proc.kill()
+                        coldfit_proc.wait()
+
         server.phase = "stop"
         for p in list(procs.values()) + [replacement]:
             if p.returncode == KILL_EXIT:
@@ -412,6 +495,17 @@ def run(args, mesh: bool, timeout: float = 900.0) -> dict:
             "restored_fits": rec["restored_fits"],
             "restore_discards": rec.get("discards", {}),
             "exactly_once": True,  # asserted above
+            # single variant: the ring-only recovery (fit journals
+            # wiped) — cold fits served entirely from restored columns
+            "coldfit_recovery": (
+                {
+                    "tick_seconds": coldfit_report["seconds"],
+                    "ring_hist_reads": coldfit_report["ring_hist_reads"],
+                    "fallback_fetches": coldfit_report["fallback_fetches"],
+                }
+                if coldfit_report is not None
+                else None
+            ),
             "metric": "recovery_fast_fraction",
             "value": round(fast_frac, 4),
             "unit": "fraction",
@@ -455,6 +549,9 @@ def main(argv=None):
     ap.add_argument("--victim", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument(
         "--recovering", action="store_true", help=argparse.SUPPRESS
+    )
+    ap.add_argument(
+        "--coldfit", action="store_true", help=argparse.SUPPRESS
     )
     ap.add_argument(
         "--max-stuck", dest="max_stuck", type=float, default=3.0,

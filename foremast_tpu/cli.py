@@ -275,6 +275,23 @@ def _mount_ingest(
         snapshotter.restore()
         snapshotter.attach()
     source = RingSource(ring, fallback=inner)
+    # ring-first cold path (ISSUE 10): the worker reads historical
+    # ranges straight off resident columns (hist_columns), admits
+    # newcomers on short coverage, and refines provisional fits in the
+    # background — say so at startup, with the two knobs that tune it.
+    # Partial admission is pure-push only (source.hist_columns), so a
+    # fallback-configured fleet is told its floor is inert.
+    from foremast_tpu.jobs.refine import refine_docs_per_tick_from_env
+
+    logging.getLogger("foremast_tpu.cli").info(
+        "cold-start path: ring-resident historical reads ON "
+        "(admit floor %.0f s%s — FOREMAST_ADMIT_MIN_COVERAGE_SECONDS; "
+        "refinement %d docs/tick — FOREMAST_REFINE_DOCS_PER_TICK; "
+        "docs/operations.md \"Cold start & churn\")",
+        source.admit_floor,
+        "" if inner is None else " [inert: fallback configured]",
+        refine_docs_per_tick_from_env(),
+    )
     port = _env_int("FOREMAST_INGEST_PORT", 9009)
     srv = None
     if port or router is not None:

@@ -595,6 +595,34 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "a handler thread or balloon the heap",
     ),
     EnvKnob(
+        "FOREMAST_ADMIT_MIN_COVERAGE_SECONDS",
+        "86400",
+        "float",
+        "short-history admission floor (docs/operations.md \"Cold "
+        "start & churn\"): in PURE-PUSH mode (no fallback source) a "
+        "newcomer series whose live ring-coverage span holds at least "
+        "this many seconds of fresh data gets a verdict-capable "
+        "PROVISIONAL fit from the resident columns in its first tick, "
+        "refined toward the full 7-day fit in the background as "
+        "coverage grows; below the floor the fetch stays UNKNOWN. "
+        "With a fallback configured the floor is inert — an uncovered "
+        "window start keeps degrading to the fallback, which may hold "
+        "the full history the ring lost. `0` disables partial "
+        "admission entirely",
+    ),
+    EnvKnob(
+        "FOREMAST_REFINE_DOCS_PER_TICK",
+        "256",
+        "int",
+        "background-refinement budget: at most this many provisional "
+        "fits are upgraded (invalidated for refit from the grown ring "
+        "window) per idle or all-warm tick — bounds the next tick's "
+        "slow-path refit batch. Refits pace geometrically (~1.5x more "
+        "points each), so a fit refines O(log) times on its way from "
+        "the admission floor to the full window; `0` parks provisional "
+        "fits at their admitted history",
+    ),
+    EnvKnob(
         "FOREMAST_SNAPSHOT_DIR",
         None,
         "path",

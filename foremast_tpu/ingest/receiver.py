@@ -74,12 +74,14 @@ class IngestCollector:
         fetches = CounterMetricFamily(
             "foremast_ingest_fetches",
             "ring TSDB fetch outcomes (hit=resident slice served, "
+            "partial=short-history admission slice served, "
             "miss=series not resident, stale=pusher behind the window, "
             "uncovered=resident but not authoritative back to start)",
             labels=["result"],
         )
         for result, count_key in (
             ("hit", "hits"),
+            ("partial", "partial"),
             ("miss", "misses"),
             ("stale", "stale"),
             ("uncovered", "uncovered"),

@@ -15,17 +15,22 @@ the same single-writer discipline the model caches use. Keeping the
 lock one level up lets a shard evict and account bytes atomically with
 the mutation that overflowed them.
 
-Coverage interval: `[covered_from, covered_to]` records the ONE
-contiguous span the ring is AUTHORITATIVE for — extended by live
-pushes and by backfills' requested windows, advanced past samples
-dropped by overwrite. Coverage is deliberately a single interval, not
-a set: two disjoint fetched windows (say a 7-day-old historical slice
-and a live current slice) must NOT imply the gap between them was
-empty, so a disjoint batch keeps whichever interval ends later and the
-other window stays on the pull path. A query reaching outside the
-interval is a miss even when samples exist — which is what keeps
-ring-served judgments matching the pull path instead of silently
-serving truncated windows.
+Coverage intervals: the ring records the (few) contiguous spans it is
+AUTHORITATIVE for — extended by live pushes and by backfills' requested
+windows, advanced past samples dropped by overwrite. Coverage is a
+bounded SET of disjoint intervals, not one: a 7-day-old historical
+backfill and a live push stream are disjoint spans, and rounds 5-8
+kept only whichever ended later — so every cold doc of the same app
+re-paid the historical HTTP fetch the moment a live push landed
+(ISSUE 10 satellite: the fallback's backfill write-through must STICK).
+What a set must still never imply is that the gap between two fetched
+windows was empty: a query is served only when one single interval
+covers it (within the staleness slack), so a window sliding into the
+gap between the historical span and the live head degrades to the pull
+path exactly as before. Intervals within the merge slack of each other
+coalesce; past `MAX_COVER_INTERVALS` the span with the oldest head is
+dropped (that authority degrades back to the pull path, never to a
+wrong answer).
 """
 
 from __future__ import annotations
@@ -34,6 +39,12 @@ import numpy as np
 
 MIN_CAPACITY = 256
 DEFAULT_MAX_POINTS = 16_384  # pow2 >= the reference 10,080-pt history
+
+# Bound on the disjoint coverage-interval set (module docstring): one
+# live span + a historical backfill span is the common case, a couple
+# more absorbs racing backfills of different ranges; past it the span
+# with the oldest head degrades to the pull path.
+MAX_COVER_INTERVALS = 4
 
 # fixed per-sample storage cost: int64 time + float32 value
 BYTES_PER_POINT = 12
@@ -57,7 +68,7 @@ class SeriesRing:
     lock is held (see module docstring)."""
 
     __slots__ = ("_times", "_values", "_start", "_count", "max_points",
-                 "covered_from", "covered_to")
+                 "_cov")
 
     def __init__(
         self,
@@ -70,8 +81,74 @@ class SeriesRing:
         self._values = np.zeros(cap, np.float32)
         self._start = 0
         self._count = 0
-        self.covered_from: float | None = None
-        self.covered_to: float | None = None
+        # disjoint authoritative spans as [from, to] pairs, sorted by
+        # `from` (disjointness makes that sorted by `to` as well, so
+        # the LAST entry is always the live head span)
+        self._cov: list[list[float]] = []
+
+    # -- coverage --------------------------------------------------------
+
+    @property
+    def covered_from(self) -> float | None:
+        """Start of the HEAD span (the one with the newest authority) —
+        the single-interval view stats and staleness accounting keep."""
+        return self._cov[-1][0] if self._cov else None
+
+    @property
+    def covered_to(self) -> float | None:
+        return self._cov[-1][1] if self._cov else None
+
+    @property
+    def head_interval(self) -> tuple[float, float] | None:
+        return tuple(self._cov[-1]) if self._cov else None
+
+    def intervals(self) -> list[tuple[float, float]]:
+        """Every authoritative span, oldest first (snapshot/debug)."""
+        return [tuple(iv) for iv in self._cov]
+
+    def covering(
+        self, t0: float | None, step: float
+    ) -> tuple[float, float] | None:
+        """The best span authoritative AT `t0` (its start within one
+        `step` of the window start), or the head span for unbounded
+        queries; None when no span reaches back to `t0`."""
+        best = None
+        for iv in self._cov:
+            if t0 is None or iv[0] <= t0 + step:
+                if best is None or iv[1] > best[1]:
+                    best = iv
+        return None if best is None else tuple(best)
+
+    def _cover(self, b0: float, b1: float, slack: float) -> None:
+        """Fold the batch's authoritative window into the span set:
+        spans overlapping (or within `slack` of) [b0, b1] coalesce with
+        it; a disjoint window becomes its own span, bounded by
+        MAX_COVER_INTERVALS (oldest-head span dropped past it)."""
+        lo, hi = b0, b1
+        keep = []
+        for iv in self._cov:
+            if iv[1] >= lo - slack and iv[0] <= hi + slack:
+                lo = min(lo, iv[0])
+                hi = max(hi, iv[1])
+            else:
+                keep.append(iv)
+        keep.append([lo, hi])
+        keep.sort(key=lambda iv: iv[0])
+        while len(keep) > MAX_COVER_INTERVALS:
+            keep.remove(min(keep, key=lambda iv: iv[1]))
+        self._cov = keep
+
+    def _clamp_coverage(self, dropped_to: float) -> None:
+        """Overwrite dropped resident samples: no span may claim
+        authority before the oldest RETAINED sample. (Spans are never
+        clamped merely to the oldest sample — a covered range may be
+        provably empty.)"""
+        out = []
+        for iv in self._cov:
+            if iv[1] < dropped_to:
+                continue  # entirely before the retained region
+            out.append([max(iv[0], dropped_to), iv[1]])
+        self._cov = out
 
     # -- introspection ---------------------------------------------------
 
@@ -131,12 +208,13 @@ class SeriesRing:
         `start`/`end` are the batch's authoritative window (a backfill
         asserting "the fallback answered for exactly [start, end]");
         without them the batch covers its own sample span (a live
-        push). The batch's window extends the coverage interval when it
-        overlaps or abuts it within `slack` seconds; a DISJOINT batch
-        keeps whichever interval ends later (see module docstring) —
-        samples are merged either way, only the authority claim is
-        bounded. A batch may be empty when `start`/`end` are given
-        (backfilling a provably-empty range)."""
+        push). The batch's window coalesces with any coverage span it
+        overlaps or abuts within `slack` seconds; a DISJOINT batch
+        becomes its own span (see module docstring — a historical
+        backfill stays authoritative next to the live push stream,
+        while the gap between them stays on the pull path). A batch may
+        be empty when `start`/`end` are given (backfilling a
+        provably-empty range)."""
         ts = np.asarray(times, np.int64)
         vs = np.asarray(values, np.float32)
         n = len(ts)
@@ -166,24 +244,9 @@ class SeriesRing:
             b0 = b1
         if b0 is not None:
             b1 = b0 if b1 is None else max(b0, b1)
-            if self.covered_from is None or self.covered_to is None:
-                self.covered_from, self.covered_to = b0, b1
-            elif (
-                b0 <= self.covered_to + slack
-                and b1 >= self.covered_from - slack
-            ):
-                self.covered_from = min(self.covered_from, b0)
-                self.covered_to = max(self.covered_to, b1)
-            elif b1 > self.covered_to:
-                # disjoint, newer: the old interval's head can never
-                # satisfy a fresh window again — adopt the new one
-                self.covered_from, self.covered_to = b0, b1
-            # disjoint, older: samples kept, authority claim unchanged
-        if dropped_to is not None and self.covered_from is not None:
-            # overwrite dropped resident samples: authority starts at
-            # the oldest RETAINED sample. (Never clamp merely to the
-            # oldest sample — a covered range may be provably empty.)
-            self.covered_from = max(self.covered_from, float(dropped_to))
+            self._cover(b0, b1, slack)
+        if dropped_to is not None and self._cov:
+            self._clamp_coverage(float(dropped_to))
         return n
 
     def _append_ordered(self, ts: np.ndarray, vs: np.ndarray):
@@ -262,3 +325,11 @@ class SeriesRing:
         lo = 0 if t0 is None else int(np.searchsorted(t, t0, side="left"))
         hi = len(t) if t1 is None else int(np.searchsorted(t, t1, side="right"))
         return t[lo:hi].copy(), v[lo:hi].copy()
+
+    def count_window(self, t0: float | None, t1: float | None) -> int:
+        """How many samples ``t0 <= t <= t1`` holds — no column copy
+        (the refinement planner's coverage probe, ISSUE 10)."""
+        t, _ = self._segments()
+        lo = 0 if t0 is None else int(np.searchsorted(t, t0, side="left"))
+        hi = len(t) if t1 is None else int(np.searchsorted(t, t1, side="right"))
+        return hi - lo

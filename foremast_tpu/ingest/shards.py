@@ -43,7 +43,32 @@ DEFAULT_BUDGET_BYTES = 268_435_456  # 256 MB
 DEFAULT_SHARDS = 8
 DEFAULT_STALE_SECONDS = 300.0
 
-_COUNT_KEYS = ("hits", "misses", "stale", "uncovered", "samples", "evictions")
+_COUNT_KEYS = (
+    "hits", "partial", "misses", "stale", "uncovered", "samples",
+    "evictions",
+)
+
+
+def _serving_span(ring, t0, t1, now, step, stale_seconds):
+    """THE serve rule, shared by query/hist_query/coverage (one
+    definition or the refinement planner's view of servability drifts
+    from what the read paths actually serve): the best span covering
+    the window start, IF it is fresh enough for the window head —
+    (span, head, covering) where span is None when nothing serves and
+    covering is the raw covering interval regardless (so callers can
+    split uncovered from stale without re-walking the span list).
+    Caller holds the shard lock."""
+    iv = ring.covering(t0, step)
+    head = now if t1 is None else min(t1, now)
+    if iv is not None and iv[1] >= head - stale_seconds and not (
+        # a window starting past the span head has ZERO overlap with
+        # what the ring can vouch for — an "empty hit" there would
+        # hide samples the pull path has
+        t0 is not None
+        and iv[1] < t0 - step
+    ):
+        return iv, head, iv
+    return None, head, iv
 
 
 class RingShard:
@@ -112,33 +137,111 @@ class RingShard:
         stale_seconds: float,
     ) -> tuple[str, np.ndarray, np.ndarray]:
         """(status, times, values); status "hit" | "miss" (not resident)
-        | "uncovered" (the window reaches outside the ring's contiguous
-        authoritative interval — including the gap between two disjoint
-        fetched windows) | "stale" (coverage head too far behind the
-        window head: pusher dead or backfill aged out)."""
+        | "uncovered" (no single authoritative span reaches the window
+        start — including the gap between two disjoint fetched windows)
+        | "stale" (the serving span's head too far behind the window
+        head: pusher dead or backfill aged out). A window is only ever
+        served out of ONE coverage span (ring.SeriesRing.covering), so
+        disjoint backfills never imply the gap between them was empty."""
         with self._lock:
             ring = self._series.get(key)
             if ring is None:
                 self._counts["misses"] += 1
                 return ("miss",) + _empty()
             self._series.move_to_end(key)  # queries refresh LRU recency
-            if ring.covered_from is None or ring.covered_to is None or (
-                t0 is not None and ring.covered_from > t0 + step
-            ):
+            iv, _head, cov = _serving_span(
+                ring, t0, t1, now, step, stale_seconds
+            )
+            if iv is not None:
+                self._counts["hits"] += 1
+                return ("hit",) + ring.window(t0, t1)
+            if cov is None:
                 self._counts["uncovered"] += 1
                 return ("uncovered",) + _empty()
-            head = now if t1 is None else min(t1, now)
-            if ring.covered_to < head - stale_seconds or (
-                # a window starting past the coverage head has ZERO
-                # overlap with what the ring can vouch for — an "empty
-                # hit" there would hide samples the pull path has
-                t0 is not None
-                and ring.covered_to < t0 - step
+            self._counts["stale"] += 1
+            return ("stale",) + _empty()
+
+    def hist_query(
+        self,
+        key: str,
+        t0: float | None,
+        t1: float | None,
+        now: float,
+        step: float,
+        stale_seconds: float,
+        admit_floor: float,
+    ) -> tuple:
+        """Historical-range read with short-history admission (ISSUE 10
+        tentpole): (status, times, values, (cov_from, cov_to) | None).
+
+        "full" is exactly `query`'s hit — one span covers the window.
+        "partial" serves the LIVE span's slice of the window when the
+        span cannot reach back to `t0` but holds at least `admit_floor`
+        seconds of fresh coverage: a newcomer's 1-2 pushed days become
+        a verdict-capable short-history fit instead of a miss that the
+        fallback (which has no more data for a true newcomer either)
+        or pure-push UNKNOWN would be. The partial slice is clamped to
+        the span — never a silently truncated view of a covered range.
+
+        Only SERVED outcomes (full/partial) bump the fetch counters:
+        every unservable hist read falls straight through to `fetch()`,
+        which counts the same lookup — counting here too would double
+        every fallback-path miss in foremast_ingest_fetches and the
+        hit_ratio denominator."""
+        with self._lock:
+            ring = self._series.get(key)
+            if ring is None:
+                return ("miss",) + _empty() + (None,)
+            self._series.move_to_end(key)
+            iv, head, cov = _serving_span(
+                ring, t0, t1, now, step, stale_seconds
+            )
+            if iv is not None:
+                self._counts["hits"] += 1
+                return ("full",) + ring.window(t0, t1) + (iv,)
+            hd = ring.head_interval
+            if (
+                admit_floor > 0
+                and hd is not None
+                and hd[1] >= head - stale_seconds
+                and (t0 is None or hd[0] > t0 + step)
+                and min(head, hd[1]) - hd[0] >= admit_floor
             ):
-                self._counts["stale"] += 1
-                return ("stale",) + _empty()
-            self._counts["hits"] += 1
-            return ("hit",) + ring.window(t0, t1)
+                self._counts["partial"] += 1
+                return ("partial",) + ring.window(hd[0], t1) + (hd,)
+            if cov is None:
+                return ("uncovered",) + _empty() + (None,)
+            return ("stale",) + _empty() + (None,)
+
+    def coverage(
+        self,
+        key: str,
+        t0: float | None,
+        t1: float | None,
+        now: float,
+        step: float,
+        stale_seconds: float,
+    ) -> tuple:
+        """(state, points_in_window, (cov_from, cov_to)) without column
+        copies and without touching LRU order or the serve counters —
+        the refinement planner's pacing probe (worker._refine_provisional
+        runs it per provisional fit per idle tick). state "full" |
+        "partial" (live span short of t0) | None (not resident / dead
+        pusher)."""
+        with self._lock:
+            ring = self._series.get(key)
+            if ring is None:
+                return None, 0, None
+            iv, head, _cov = _serving_span(
+                ring, t0, t1, now, step, stale_seconds
+            )
+            if iv is not None:
+                return "full", ring.count_window(t0, t1), iv
+            hd = ring.head_interval
+            if hd is not None and hd[1] >= head - stale_seconds:
+                lo = hd[0] if t0 is None else max(t0, hd[0])
+                return "partial", ring.count_window(lo, t1), hd
+            return None, 0, None
 
     def evict_unowned(self, owns) -> int:
         """Drop every resident series the predicate disowns — the mesh
@@ -155,15 +258,26 @@ class RingShard:
 
     def snapshot_state(self) -> list[tuple]:
         """Consistent copy of every resident series for the snapshot
-        writer: (key, times, values, covered_from, covered_to), columns
-        copied under the shard lock so a concurrent push can never
-        interleave half a mutation into the on-disk state."""
+        writer: (key, times, values, covered_from, covered_to, extras)
+        — the head coverage span plus any OLDER disjoint spans (a
+        restored ring must keep serving historical backfills, ISSUE 10
+        satellite). Columns copied under the shard lock so a concurrent
+        push can never interleave half a mutation into the on-disk
+        state."""
         with self._lock:
             out = []
             for key, ring in self._series.items():
                 t, v = ring.window(None, None)  # ordered copies
+                ivs = ring.intervals()
                 out.append(
-                    (key, t, v, ring.covered_from, ring.covered_to)
+                    (
+                        key,
+                        t,
+                        v,
+                        ring.covered_from,
+                        ring.covered_to,
+                        ivs[:-1],  # all but the head span
+                    )
                 )
             return out
 
@@ -290,6 +404,34 @@ class RingStore:
             key, t0, t1, now, step, self.stale_seconds
         )
 
+    def hist_query(
+        self,
+        key: str,
+        t0: float | None,
+        t1: float | None,
+        now: float,
+        step: float = 60.0,
+        admit_floor: float = 0.0,
+    ) -> tuple:
+        """Historical-range read with short-history admission — see
+        `RingShard.hist_query`."""
+        return self._shard(key).hist_query(
+            key, t0, t1, now, step, self.stale_seconds, admit_floor
+        )
+
+    def coverage(
+        self,
+        key: str,
+        t0: float | None,
+        t1: float | None,
+        now: float,
+        step: float = 60.0,
+    ) -> tuple:
+        """Counter-free coverage probe — see `RingShard.coverage`."""
+        return self._shard(key).coverage(
+            key, t0, t1, now, step, self.stale_seconds
+        )
+
     def evict_unowned(self, owns) -> int:
         """Drop resident series `owns(key)` rejects (mesh rebalance);
         returns how many were evicted across all shards."""
@@ -304,7 +446,8 @@ class RingStore:
         out["shards"] = len(self._shards)
         out["budget_bytes"] = self.budget_bytes
         looked = (
-            out["hits"] + out["misses"] + out["stale"] + out["uncovered"]
+            out["hits"] + out["partial"] + out["misses"] + out["stale"]
+            + out["uncovered"]
         )
         out["hit_ratio"] = round(out["hits"] / looked, 4) if looked else None
         with self._lock:

@@ -370,13 +370,24 @@ class RingSnapshotter:
                 }
                 keys = []
                 cov = np.empty((len(state), 2), np.float64)
-                for j, (key, t, v, cf, ct) in enumerate(state):
+                extras: dict[str, list] = {}
+                for j, (key, t, v, cf, ct, ivs) in enumerate(state):
                     keys.append(key)
                     arrays[f"t{j}"] = t
                     arrays[f"v{j}"] = v
                     cov[j, 0] = np.nan if cf is None else cf
                     cov[j, 1] = np.nan if ct is None else ct
+                    if ivs:
+                        extras[str(j)] = [list(iv) for iv in ivs]
                 arrays["cov"] = cov
+                if extras:
+                    # older disjoint coverage spans (historical
+                    # backfills living next to the live push stream) —
+                    # absent on pre-multi-interval snapshots, so the
+                    # format stays version-1 compatible both ways
+                    arrays["cove"] = np.frombuffer(
+                        json.dumps(extras).encode(), np.uint8
+                    )
                 arrays["keys"] = np.frombuffer(
                     json.dumps(keys).encode(), np.uint8
                 )
@@ -498,6 +509,12 @@ class RingSnapshotter:
                     return 0, 0
                 keys = json.loads(bytes(z["keys"]).decode())
                 cov = np.asarray(z["cov"], np.float64)
+                extras: dict = {}
+                if "cove" in z.files:
+                    try:
+                        extras = json.loads(bytes(z["cove"]).decode())
+                    except Exception:  # noqa: BLE001 — optional block
+                        extras = {}
                 data = {}
                 for j in range(len(keys)):
                     tn, vn = f"t{j}", f"v{j}"
@@ -532,6 +549,27 @@ class RingSnapshotter:
             if ct is not None and ct < cutoff:
                 self._discard("stale")
                 continue
+            # older disjoint coverage spans re-assert through the push
+            # path as empty authoritative batches, so a restored ring
+            # keeps serving historical backfills without re-fetching
+            # (ISSUE 10: the recovery tick's cold fits stay zero-HTTP).
+            # Asserted BEFORE the sample push: when the restored ring is
+            # smaller than the one snapshotted (max_points retuned down),
+            # the sample push drops the oldest samples and its overwrite
+            # clamp must clamp these spans too — re-asserting them after
+            # would claim authority over ranges whose samples were just
+            # discarded, serving truncated "full" histories instead of
+            # degrading to the pull path
+            for iv in extras.get(str(j), ()):
+                try:
+                    f0, f1 = float(iv[0]), float(iv[1])
+                except (TypeError, ValueError, IndexError):
+                    continue
+                if f1 < cutoff:
+                    continue  # aged out like any stale span
+                self.store.push(
+                    key, (), (), start=f0, end=f1, record_lag=False
+                )
             self.store.push(
                 key, t, v, start=cf, end=ct, record_lag=False
             )

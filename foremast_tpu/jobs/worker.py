@@ -16,6 +16,7 @@ claims), but each worker saturates a chip instead of a 100m-CPU sliver.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 import uuid
 from typing import Callable
@@ -162,10 +163,9 @@ class BrainWorker:
         # until endTime need not re-fetch ~10k-point histories each time.
         # Only ranges whose `end` is safely in the past are cached (see
         # _fetch_hist_cached); sized independently of MAX_CACHE_SIZE —
-        # entries are ~120 KB series, not model params.
+        # entries are ~120 KB series, not model params. Constructed at
+        # the ring-first decision below, where its size is chosen.
         from foremast_tpu.models.cache import ModelCache
-
-        self._hist_cache = ModelCache(HIST_CACHE_ENTRIES)
         # Fitted-forecast cache (the reference's MAX_CACHE_SIZE model
         # cache, `foremast-brain/README.md:30`): terminal forecaster state
         # per (algorithm, app|alias|historical-URL), so a re-check tick on
@@ -263,6 +263,50 @@ class BrainWorker:
         self._fetch_pool = None
         self._prefetch_pool = None
         self._last_pipeline: dict | None = None
+        # Ring-first cold path (ISSUE 10 tentpole): when the source can
+        # serve historical ranges from resident ring columns
+        # (RingSource.hist_columns — duck-typed like ingest_debug_state;
+        # deliberately NOT resolved through a pod-mode LeaderSource's
+        # .inner, whose fetches are ordered collectives), cold fits read
+        # the ring directly and the worker's own `_hist_cache` is
+        # BYPASSED for ring-covered ranges — it would double-buffer
+        # ~120 KB histories the ring already owns. The cache shrinks to
+        # a sliver serving only fallback-path (HTTP) reads; the decision
+        # is exposed on /debug/state (`cold_start.hist_bypass`).
+        self._ring_hist = getattr(source, "hist_columns", None)
+        self._hist_bypass = self._ring_hist is not None
+        self._hist_cache = ModelCache(
+            max(8, HIST_CACHE_ENTRIES // 16)
+            if self._hist_bypass
+            else HIST_CACHE_ENTRIES
+        )
+        # pure-push: a ring source with no fallback never does HTTP —
+        # its unservable reads come back empty and are labeled
+        # "unserved", not "http" (operators read the http count as
+        # proof a pull path exists)
+        self._cold_unserved = (
+            self._hist_bypass
+            and getattr(source, "fallback", object()) is None
+        )
+        # Short-history admission + background refinement (ISSUE 10):
+        # provisional fits ledger + per-tick upgrade budget. Refinement
+        # INVALIDATES a provisional fit when its ring coverage grew
+        # enough; the next claim refits it from the ring through the
+        # production slow path (band parity by construction).
+        from foremast_tpu.jobs.refine import (
+            RefineBook,
+            refine_docs_per_tick_from_env,
+        )
+
+        self.refine_docs_per_tick = refine_docs_per_tick_from_env()
+        self._refine_book = RefineBook()
+        # cold-path historical-read accounting (fetch-pool threads write
+        # here, the varz scrape thread reads — lock-guarded)
+        self._cold_lock = threading.Lock()
+        self._cold_counts = {
+            "ring_full": 0, "ring_partial": 0, "http": 0, "cache": 0,
+            "unserved": 0,
+        }
         self.metrics = metrics
         # Span tracer (observe/spans.py): tick() opens a root span and
         # every stage — claim, fetch, fit, arena, score, decide, write —
@@ -387,6 +431,10 @@ class BrainWorker:
         # their own state, so an empty-hist task would collapse the
         # joint fit to zero points
         may_skip_hist = not self._mv or len(aliases) == 1
+        # aliases whose history came back as a PARTIAL ring slice this
+        # fetch (short-history admission) — noted in the refine book
+        # after the loop so the fit they produce is tracked provisional
+        partials: list[tuple] = []
         try:
             for (
                 alias,
@@ -434,7 +482,11 @@ class BrainWorker:
                                     hist_step=gap[0], hist_last_t=gap[1]
                                 )
                         else:
-                            ht, hv = self._fetch_hist_cached(hist_url, now)
+                            ht, hv, prov = self._fetch_hist(hist_url, now)
+                            if prov:
+                                partials.append(
+                                    (fullkey, key, hist_url, len(ht))
+                                )
                             if len(ht) and self._gap_sensitive:
                                 from foremast_tpu.engine.judge import infer_step
 
@@ -476,10 +528,85 @@ class BrainWorker:
                 return RELEASED
             log.warning("preprocess failed for %s: %s", doc.id, e)
             return None
+        if partials:
+            if may_skip_hist:
+                # univariate fits: one provisional record per fit key
+                for fullkey, key, url, n in partials:
+                    self._refine_book.note_uni(fullkey, key, url, n)
+            else:
+                # joint doc: one record for the doc (its joint cache
+                # keys resolve through the admission cache — or by app
+                # when the doc never warmed — at invalidation time)
+                self._refine_book.note_joint(
+                    doc.id,
+                    doc.app_name,
+                    tuple(u for _, _, u, _ in partials),
+                    sum(n for _, _, _, n in partials),
+                )
         return tasks
 
+    def _count_cold(self, source: str) -> None:
+        """One historical-range read on the cold-fit path, by source
+        (ring_full / ring_partial / http / cache). Fetch-pool threads
+        land here, hence the lock; the metric family mirrors the
+        lock-guarded dict so /debug/state and Prometheus agree."""
+        with self._cold_lock:
+            self._cold_counts[source] += 1
+        m = getattr(self.metrics, "cold_hist", None) if self.metrics else None
+        if m is not None:
+            m.labels(source=source).inc()
+
+    def _cold_snapshot(self) -> dict:
+        with self._cold_lock:
+            return dict(self._cold_counts)
+
+    def _fetch_hist(self, url: str, now: float):
+        """Historical window for a cold fit: ring columns first, HTTP
+        fallback second (ISSUE 10 tentpole). Returns (times, values,
+        provisional) — provisional True when the window is a PARTIAL
+        ring slice under short-history admission whose coverage can
+        still grow inside the requested range (the caller notes it in
+        the refine book).
+
+        Ring reads bypass `_hist_cache` entirely: the ring IS the
+        resident history (one slice copy, no JSON reassembly, no
+        double-buffering), and the bf16-delta fit upload packs straight
+        off the returned columns. Only the fallback path — ranges the
+        ring cannot serve — still memoizes, and a fallback fetch
+        through `RingSource.fetch` backfills the ring write-through, so
+        the NEXT cold fit of the same series (second doc of the same
+        app, or the restart after a PR-7 snapshot) reads resident."""
+        if self._ring_hist is not None:
+            res = self._ring_hist(url, now)
+            if res is not None:
+                status, ht, hv, cov, window = res
+                if status == "full":
+                    self._count_cold("ring_full")
+                    return ht, hv, False
+                self._count_cold("ring_partial")
+                t1 = window[1]
+                # provisional iff in-window data can still arrive: the
+                # window head is not yet covered. A slice whose head IS
+                # covered is terminal — marking it provisional would
+                # re-note every finalized refit back into the book and
+                # double-count the refinement metrics. (Backward
+                # bulk-loads into an already-closed window are the one
+                # untracked growth; they self-correct on natural
+                # churn.)
+                return ht, hv, t1 is None or cov[1] < t1
+        series, hit = self._fetch_hist_cached(url, now)
+        if hit:
+            self._count_cold("cache")
+        else:
+            self._count_cold(
+                "unserved" if self._cold_unserved else "http"
+            )
+        return series[0], series[1], False
+
     def _fetch_hist_cached(self, url: str, now: float):
-        """Fetch a settled historical window, memoized by URL.
+        """Fetch a settled historical window, memoized by URL; returns
+        (series, cache_hit) — the hit flag keeps `_count_cold`'s
+        cache/fetch split exact under concurrent fetch-pool threads.
 
         Only called for provably immutable ranges (the caller checks the
         range's end against `now` - HIST_SETTLED_SECONDS; the watcher
@@ -491,10 +618,17 @@ class BrainWorker:
         deterministic in tests."""
         cached = self._hist_cache.get(url)
         if cached is not None:
-            return cached
+            return cached, True
         series = self.source.fetch(url)
-        self._hist_cache.put(url, series)
-        return series
+        # pure-push: an unservable range comes back EMPTY, not fetched —
+        # memoizing it would make every later read of the same settled
+        # URL count "cache" (a served history, per the family help text)
+        # while the doc sits UNKNOWN; leave it uncached so repeats keep
+        # counting "unserved" (the re-probe is a resident ring lookup,
+        # not HTTP)
+        if not (self._cold_unserved and len(series[0]) == 0):
+            self._hist_cache.put(url, series)
+        return series, False
 
     # -- postprocess: verdicts -> document status -----------------------
 
@@ -721,7 +855,12 @@ class BrainWorker:
 
         Journaled caches: the univariate fit cache and (for seasonal
         algorithms) its gap anchors, plus — when the judge dispatches
-        joint models — the joint entry cache and its warm metadata.
+        joint models — the joint entry cache and its warm metadata, and
+        the provisional-fit refine book (ISSUE 10: the journals restore
+        a short-history FIT warm, so the restored doc takes the fast
+        path and nothing would ever re-note it — without its own
+        persistence the fit would stay parked at the admitted history
+        forever instead of refining to the full window).
         NOT journaled: the history cache (re-fetchable), the per-doc
         meta cache (derived from immutable configs), and the device
         arena (it rehydrates row-by-row from the restored fit cache,
@@ -732,7 +871,11 @@ class BrainWorker:
         from foremast_tpu.models.cache import FitJournal
 
         _os.makedirs(directory, exist_ok=True)
-        pairs = [("fits", self._fit_cache), ("gaps", self._gap_meta)]
+        pairs = [
+            ("fits", self._fit_cache),
+            ("gaps", self._gap_meta),
+            ("refine", self._refine_book),
+        ]
         if self._mvj is not None:
             pairs += [
                 ("joint", self._mvj.cache),
@@ -770,6 +913,128 @@ class BrainWorker:
                 self._snapshotter.maybe_snapshot()
         except Exception:  # noqa: BLE001 — durability must not kill ticks
             log.exception("durability housekeeping failed")
+
+    # -- background refinement of provisional fits (ISSUE 10) ------------
+
+    def _count_refine(self, result: str) -> None:
+        m = (
+            getattr(self.metrics, "refine_docs", None)
+            if self.metrics
+            else None
+        )
+        if m is not None:
+            m.labels(result=result).inc()
+
+    def _refine_provisional(self, now: float) -> int:
+        """Upgrade provisional fits whose ring coverage grew (idle and
+        all-warm steady ticks only — a busy slow-path tick already has
+        cold fits to pay for). Bounded to `refine_docs_per_tick`
+        records per pass; each upgrade is an INVALIDATION — the next
+        claim refits the doc from the (larger) ring window through the
+        production slow path, so a refined fit is byte-identical to a
+        from-scratch fit on the same columns. Returns #invalidated."""
+        book = self._refine_book
+        if not len(book) or self._ring_hist is None:
+            return 0
+        probe = getattr(self.source, "hist_coverage", None)
+        if probe is None:
+            return 0
+        upgraded = 0
+        for bkey, rec in book.take(self.refine_docs_per_tick):
+            states = [probe(u, now) for u in rec["urls"]]
+            if any(s is None for s in states):
+                # unresolvable URL: no series identity to ever pace
+                book.drop(bkey, "dropped")
+                continue
+            if any(s[0] is None for s in states):
+                # no serving span RIGHT NOW (pusher pause past the
+                # staleness cutoff, mid-rebalance eviction): pacing
+                # pauses but the record STAYS — the short-history fit is
+                # still warm in the fit cache, so no cold claim will
+                # ever re-note it; dropping here would park it at its
+                # admitted history forever once the pusher resumes.
+                # take() already rotated the record to the back.
+                continue
+            n_now = sum(s[1] for s in states)
+            closed = all(
+                s[0] == "full"
+                or (s[3][1] is not None and s[2][1] >= s[3][1])
+                for s in states
+            )
+            if closed:
+                # the window is fully covered (or its head is past —
+                # nothing more can arrive inside it): pay a TERMINAL
+                # refit only when the resident data actually grew past
+                # the admitted fit; either way the record settles.
+                # "finalized" counts only actual terminal refits —
+                # a record whose data never grew settles without one
+                if n_now > rec["points"]:
+                    self._invalidate_provisional(bkey, rec)
+                    upgraded += 1
+                    book.drop(bkey, "finalized")
+                    self._count_refine("finalized")
+                else:
+                    book.drop(bkey, "settled")
+                    self._count_refine("settled")
+            elif book.due(rec["points"], n_now):
+                self._invalidate_provisional(bkey, rec)
+                book.refit(bkey, n_now)
+                self._count_refine("refit")
+                upgraded += 1
+        gauge = (
+            getattr(self.metrics, "provisional", None)
+            if self.metrics
+            else None
+        )
+        if gauge is not None:
+            gauge.set(len(book))
+        if upgraded:
+            log.info(
+                "refinement: invalidated %d provisional fit(s) for "
+                "refit from the ring (%d still pending)",
+                upgraded, len(book),
+            )
+        return upgraded
+
+    def _invalidate_provisional(self, bkey: tuple, rec: dict) -> None:
+        """Drop a provisional fit's cached state so the next claim
+        refits from the ring. Version bumps make the fast-path
+        admission caches revalidate and demote the doc to the slow
+        path for exactly one refit tick."""
+        if rec["kind"] == "uni":
+            self._fit_cache.pop(rec["fullkey"])
+            if self._gap_sensitive:
+                self._gap_meta.pop(rec["gap_key"])
+            return
+        # joint: resolve the cache keys through the admission cache
+        jad = self._jadmit.pop(rec["doc_id"], None)
+        if self._mvj is None:
+            return
+        if jad is not None:
+            jinfo = jad[1]
+            self._mvj.cache.pop(jinfo[3])
+            self._mvj.joint_meta.pop(jinfo[5])
+            return
+        # never fast-path-admitted (columnar off, or refinement fired
+        # before the doc's second claim): the slow path's LSTM cache
+        # key carries no history content (multivariate._key), so its
+        # short-history fit would be served FOREVER unless popped —
+        # invalidate by app. Joint cache keys are (mode, app, ...),
+        # meta keys ("jmeta", mode, app, ...); over-matching sibling
+        # docs of the same app costs them one extra refit, never a
+        # wrong verdict.
+        app = rec.get("app")
+        if app is None:
+            return
+        self._mvj.cache.pop_where(
+            lambda k: isinstance(k, tuple) and len(k) > 1 and k[1] == app
+        )
+        self._mvj.joint_meta.pop_where(
+            lambda k: isinstance(k, tuple)
+            and len(k) > 2
+            and k[0] == "jmeta"
+            and k[2] == app
+        )
 
     # -- degraded store writes (ISSUE 9) ---------------------------------
 
@@ -1074,13 +1339,21 @@ class BrainWorker:
 
         for (mode, f), items in groups.items():
             if mode == "lstm":
-                # AE models are per window-bucket (the cache key's tc):
-                # admission pinned every item's bucket to its meta, so
-                # sub-group by it
-                by_tc: dict = {}
-                for it in items:
-                    by_tc.setdefault(it[2][6][0], []).append(it)
-                subgroups = list(by_tc.items())
+                # ONE dispatch per (lstm, F) group, padded to the
+                # group's widest fitted window bucket (VERDICT r5 #10:
+                # per-bucket sub-dispatches serialized refinement
+                # sweeps on 2,048-window programs). Exact by
+                # construction: the AE scan carries state through
+                # masked steps unchanged and the decoder's outputs at
+                # step i never depend on later steps, and the MVN
+                # d^2 is causal — so SUFFIX padding (each item keeps
+                # its own n/mask) cannot change any real point's flag.
+                # Admission still pins each item's bucket to its fitted
+                # meta (drift demotes to the slow path above); only the
+                # dispatch shape is merged, univariate-style.
+                subgroups = [
+                    (max(it[2][6][0] for it in items), items)
+                ]
             else:
                 subgroups = [
                     (
@@ -1578,7 +1851,8 @@ class BrainWorker:
             # idle cycles still did the claim round-trip (real store I/O)
             # and must be visible on the tick histogram; an idle WORKER
             # is not an idle RING (receiver threads keep pushing), so
-            # snapshot cadence runs here too
+            # snapshot cadence and provisional-fit refinement run here
+            self._refine_provisional(now)
             self._maybe_persist()
             if self.metrics:
                 self.metrics.tick_seconds.observe(time.perf_counter() - t0)
@@ -1591,6 +1865,10 @@ class BrainWorker:
         if self._uni is not None:
             n_fast, docs = self._fast_tick(docs, now)
             if not docs:
+                # all-warm steady tick: the cheap moment to upgrade
+                # provisional fits — invalidations land their refits on
+                # the NEXT tick's slow path, in bounded batches
+                self._refine_provisional(now)
                 if self.metrics:
                     if hasattr(self.metrics, "observe_arena"):
                         self.metrics.observe_arena(
@@ -1885,6 +2163,21 @@ class BrainWorker:
                 "fit_capacity": self.config.max_cache_size,
                 "hist_entries": len(self._hist_cache),
                 "admission_entries": len(self._admit),
+            },
+            # ring-first cold path (ISSUE 10): whether the worker's
+            # host-side history cache is bypassed in favor of resident
+            # ring columns (and shrunk — the freed RAM decision made
+            # observable), where cold-fit histories actually came from,
+            # and the provisional-fit refinement ledger
+            "cold_start": {
+                "hist_bypass": self._hist_bypass,
+                "hist_cache_cap": self._hist_cache.max_size,
+                "hist_reads": self._cold_snapshot(),
+                "admit_floor_seconds": getattr(
+                    self.source, "admit_floor", None
+                ),
+                "refine_docs_per_tick": self.refine_docs_per_tick,
+                "refine": self._refine_book.debug_state(),
             },
             "arena": arena,
             # joint-model device arena (TreeArena rows: bivariate fits,

@@ -35,7 +35,14 @@ class BivariateFit:
 def fit_bivariate(
     x: jax.Array, y: jax.Array, mask: jax.Array, min_points: int = 10
 ) -> BivariateFit:
-    """Fit a 2-D Gaussian to paired histories. x/y/mask: [B, T]."""
+    """Fit a 2-D Gaussian to paired histories. x/y/mask: [B, T].
+
+    Short-history entry point (ISSUE 10 admission): the fit is moment-
+    based, so any history clearing `min_points` yields a VALID,
+    verdict-capable Gaussian — a newcomer admitted on 1-2 days of ring
+    coverage fits exactly like a 7-day history, just with wider moment
+    uncertainty; below the floor `valid=False` degrades the job to
+    UNKNOWN, never to a fragile fit."""
     mx = masked_mean(x, mask)
     my = masked_mean(y, mask)
     m = mask.astype(x.dtype)

@@ -183,6 +183,29 @@ class ModelCache:
         if self.journal is not None:
             self.journal([(key, None)], deleted=True)
 
+    def pop_where(self, pred) -> int:
+        """Drop every entry (resident and restored-overlay) whose key
+        satisfies `pred`; returns how many were dropped. One lock
+        acquisition, one version bump, journaled as deletions — the
+        refinement planner uses this to invalidate joint fits by app
+        when it has no exact cache key to pop."""
+        with self._lock:
+            doomed = [k for k in self._d if pred(k)]
+            for k in doomed:
+                del self._d[k]
+            if self._restored is not None:
+                staged = [k for k in self._restored if pred(k)]
+                for k in staged:
+                    del self._restored[k]
+                if not self._restored:
+                    self._restored = None
+                doomed += staged
+            if doomed:
+                self.version += 1
+        if self.journal is not None and doomed:
+            self.journal([(k, None) for k in doomed], deleted=True)
+        return len(doomed)
+
     def clear(self) -> None:
         with self._lock:
             self.version += 1

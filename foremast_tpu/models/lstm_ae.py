@@ -174,6 +174,13 @@ def fit_many(
     the trained model's in-sample reconstruction-error moments.
     score_many's bound is err_mean + threshold * err_std — the same
     mean + threshold*sigma semantics every other detector uses.
+
+    Short-history admission (ISSUE 10) feeds this the same way a full
+    history does, but the caller MUST hold the PR-7 min-history gate
+    (`multivariate._judge_lstm`: >= 2 training windows of the job's own
+    bucket) — a single-window "distribution" degenerates its cutoff
+    calibration and flags clean noise. Jobs under the gate stay
+    UNKNOWN until refinement grows their coverage past it.
     """
     if cfg is None:
         cfg = LSTMAEConfig(features=x.shape[-1])

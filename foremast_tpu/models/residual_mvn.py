@@ -96,7 +96,11 @@ def fit_residual_mvn(
     is dropped. Without this, a daily-configured engine (m=1440) would
     either disable the MVN outright on sub-2-day histories (empty warm
     region -> valid=False) or score against a season memorized from one
-    partial cycle."""
+    partial cycle. That m=1 degradation is also the short-history entry
+    point for cold-start admission (ISSUE 10): a newcomer's 1-2 pushed
+    days fit a valid Holt-residual Gaussian immediately, and background
+    refinement refits at the full season once coverage clears two
+    cycles."""
     b, f, th = hist.shape
     a, bt, g = HW_PARAMS
     m_eff = int(season_length) if th >= 2 * int(season_length) else 1

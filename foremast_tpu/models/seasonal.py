@@ -108,7 +108,12 @@ def fit_seasonal(
     horizon then extrapolates; such SERIES get the global-mean model
     (same two-layer rule as `fit_holt_winters`: a static early-out for
     all-short batches plus a per-series select for short real histories
-    riding a long padded bucket).
+    riding a long padded bucket). This two-layer rule is also the
+    short-history entry point for cold-start admission (ISSUE 10): a
+    newcomer admitted on 1-2 days of ring coverage under a daily
+    season fits the honest mean model with real historical-std bands —
+    verdict-capable immediately — and picks up the seasonal cycle when
+    background refinement refits it past two periods.
     """
     from foremast_tpu.ops.forecasters import (
         _guard_unidentifiable,

@@ -249,6 +249,11 @@ class WorkerMetrics:
             state-arena traffic: a rising miss/eviction rate under a
             stable fleet means claim churn is re-paying state scatters
             (the cost VERDICT r3 flagged as silent)
+        foremast_cold_hist_reads_total{source} — cold-fit history
+            serving source; `http` climbing on a ring-covered fleet
+            means the ring lost authority over historical ranges
+        foremast_refine_docs_total{result} / foremast_provisional_fits
+            — background refinement of short-history admissions
 
     The reference exposes only model outputs; the engine's own throughput
     is this framework's headline property, so it is first-class here.
@@ -317,6 +322,31 @@ class WorkerMetrics:
             "foremast_worker_pipeline_write_queue_peak",
             "latest slow-path tick: peak depth of the verdict "
             "write-back queue",
+            registry=reg,
+        )
+        # ring-first cold path (ISSUE 10): where each cold fit's
+        # historical range was served from, refinement outcomes, and
+        # the provisional-fit backlog — the Prometheus twins of the
+        # /debug/state `cold_start` section
+        self.cold_hist = Counter(
+            "foremast_cold_hist_reads_total",
+            "historical-range reads on the cold-fit path, by serving "
+            "source (ring_full / ring_partial / http / cache / "
+            "unserved)",
+            ["source"],
+            registry=reg,
+        )
+        self.refine_docs = Counter(
+            "foremast_refine_docs_total",
+            "background-refinement outcomes for provisional "
+            "short-history fits (refit / finalized / settled)",
+            ["result"],
+            registry=reg,
+        )
+        self.provisional = Gauge(
+            "foremast_provisional_fits",
+            "provisional (short-history) fits awaiting background "
+            "refinement",
             registry=reg,
         )
 

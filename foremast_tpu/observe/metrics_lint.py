@@ -40,6 +40,11 @@ ALLOWED_LABELS: dict[str, frozenset[str]] = {
     "foremast_worker_pipeline_idle_seconds": frozenset(),
     "foremast_worker_pipeline_overlap_ratio": frozenset(),
     "foremast_worker_pipeline_write_queue_peak": frozenset(),
+    # ring-first cold start + background refinement (ISSUE 10,
+    # observe/gauges.py WorkerMetrics)
+    "foremast_cold_hist_reads": frozenset({"source"}),
+    "foremast_refine_docs": frozenset({"result"}),
+    "foremast_provisional_fits": frozenset(),
     "foremast_service_requests": frozenset({"route", "code"}),
     "foremast_controller_transitions": frozenset({"phase"}),
     "foremastbrain_gauge_families_dropped": frozenset(),
@@ -102,6 +107,18 @@ FAMILY_DOCS: dict[str, str] = {
     ),
     "foremast_worker_pipeline_write_queue_peak": (
         "latest slow-path tick: peak verdict write-back queue depth"
+    ),
+    "foremast_cold_hist_reads": (
+        "historical-range reads on the cold-fit path, by serving "
+        "source (ring_full/ring_partial/http/cache/unserved)"
+    ),
+    "foremast_refine_docs": (
+        "background-refinement outcomes for provisional short-history "
+        "fits (refit/finalized/settled)"
+    ),
+    "foremast_provisional_fits": (
+        "provisional (short-history) fits awaiting background "
+        "refinement"
     ),
     "foremast_service_requests": (
         "gateway requests by route pattern and status code"

@@ -169,6 +169,25 @@ def test_ingest_bench_small_smoke(capsys):
     assert line["value"] and line["value"] > 1.0
 
 
+def test_cold_bench_small_smoke(capsys):
+    """`make bench-cold --small` smoke (ISSUE 10): ring-resident cold
+    fits (zero HTTP, byte-identical statuses vs the pull path — both
+    asserted inside run()), a zero-HTTP churn tick, short-history
+    newcomer admission (no UNKNOWNs), and refinement draining to
+    band-parity with from-scratch fits."""
+    import benchmarks.cold_bench as cold_bench
+
+    cold_bench.main(["--small"])
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["config"] == "c-cold-ring-tick"
+    assert line["zero_http_cold"] is True
+    assert line["zero_http_churn"] is True
+    assert line["newcomer_unknown"] == 0
+    assert line["band_parity"] is True
+    assert line["refine_counts"]["pending"] == 0
+    assert line["cold_speedup"] > 1.0
+
+
 def test_scaleout_bench_small_smoke(capsys):
     """`make bench-scaleout --small` smoke (ISSUE 6): 1 then 2 REAL
     worker processes over the HTTP store — exactly-once judgment and

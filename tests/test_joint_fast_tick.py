@@ -258,3 +258,55 @@ def test_worker_metrics_fast_docs_counter():
     assert got.get("bivariate") == 1.0
     assert got.get("lstm") == 1.0
     assert got.get("univariate", 0) >= 1.0
+
+
+def test_lstm_mixed_window_buckets_merge_into_one_dispatch():
+    """VERDICT r5 #10 satellite: lstm docs fitted at DIFFERENT window
+    buckets score in ONE merged dispatch (padded to the widest bucket)
+    on the fast path — and the merged program's flags match the object
+    path exactly, spikes included."""
+    from benchmarks.quality import draw_comoving
+
+    hist_len, long_cur = 1280, 300  # buckets 32 (CUR_LEN) and 512
+    verdicts_a = {}
+    a, a_store, a_src, _ = _mk_worker(
+        True, hook=lambda d, vs: verdicts_a.setdefault(d.id, []).append(vs),
+        joint_frac=0.34,
+    )
+    b, b_store, b_src, _ = _mk_worker(False, joint_frac=0.34)
+
+    # regenerate lstm service 3 with a long history + long current so
+    # its fitted bucket is 512 while service 1 stays at 32
+    t_now = int(NOW)
+    ht = t_now - 86_400 * 7 + 60 * np.arange(HIST_LEN, dtype=np.int64)
+    ht3 = ht[-1] - 60 * np.arange(hist_len, dtype=np.int64)[::-1]
+    ct3 = ht[-1] + 60 + 60 * np.arange(long_cur, dtype=np.int64)
+    r = np.random.default_rng(99)
+    hist3 = draw_comoving(r, 1, 4, hist_len, 0)[0]
+    cur3 = draw_comoving(r, 1, 4, long_cur, hist_len)[0]
+    for src in (a_src, b_src):
+        for m in range(4):
+            src.data[f"http://prom/cur?q=m{m}:app3&step=60"] = (
+                ct3, cur3[m].copy()
+            )
+            src.data[
+                f"http://prom/hist?q=m{m}:app3&end={ht[-1] + 60}&step=60"
+            ] = (ht3, hist3[m].copy())
+
+    assert a.tick(now=NOW + 150) == SERVICES
+    assert b.tick(now=NOW + 150) == SERVICES
+    assert _statuses(a_store) == _statuses(b_store)
+
+    # spike the SHORT-bucket lstm doc: its flags must decode correctly
+    # out of the merged (wider) dispatch
+    for src in (a_src, b_src):
+        _spike_joint(src, "1", 4)
+    assert a.tick(now=NOW + 200) == SERVICES
+    assert b.tick(now=NOW + 200) == SERVICES
+    sa = _statuses(a_store)
+    assert sa == _statuses(b_store)
+    assert sa["job-1"][0] == STATUS_COMPLETED_UNHEALTH
+    assert sa["job-3"][0] == STATUS_PREPROCESS_COMPLETED
+    # both lstm docs rode the columnar path (merged dispatch)
+    assert a._fast_kinds["lstm"] == 2
+    assert b._fast_kinds["lstm"] == 0

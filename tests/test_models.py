@@ -168,6 +168,31 @@ def test_model_cache_lru_eviction():
     assert len(c) == 2
 
 
+def test_model_cache_pop_where():
+    """Predicate pop drops matching resident AND restored-overlay
+    entries (journaled as deletions), leaves the rest, and reports the
+    count — the refinement planner's app-scoped joint invalidation."""
+    c = ModelCache(max_size=8)
+    c.put(("lstm", "appx", ("a", "b"), 2), {"w": 1})
+    c.put(("bivariate", "appx", ("a", "b"), ("h",)), {"w": 2})
+    c.put(("lstm", "other", ("a",), 1), {"w": 3})
+    c.restore_lazy({("lstm", "appx", ("c",), 1): {"w": 4}})
+    deleted = []
+    c.journal = lambda items, **kw: deleted.extend(k for k, _ in items)
+    n = c.pop_where(
+        lambda k: isinstance(k, tuple) and len(k) > 1 and k[1] == "appx"
+    )
+    assert n == 3
+    assert c.peek(("lstm", "other", ("a",), 1)) is not None
+    assert c.peek(("lstm", "appx", ("a", "b"), 2)) is None
+    assert c.restored_pending() == 0
+    assert len(deleted) == 3
+    # no matches: no version bump, no journal traffic
+    v = c.version
+    assert c.pop_where(lambda k: False) == 0
+    assert c.version == v and len(deleted) == 3
+
+
 def test_model_cache_checkpoint_roundtrip(tmp_path):
     c = ModelCache()
     c.put("svc1/latency", {"w": jnp.arange(3, dtype=jnp.float32)})
@@ -359,3 +384,35 @@ def test_seasonal_changepoints_localize_level_shift():
     assert err_plain > 2 * err_cp  # the global-slope fit mis-centers
     assert abs(float(fc.trend.mean())) < 2e-4  # post-shift regime is flat
     assert float(fc.scale.mean()) < 0.1  # band ~ noise, not the step
+
+
+def test_bivariate_short_history_is_verdict_capable():
+    """Short-history entry point (ISSUE 10 admission): a paired
+    history clearing `min_points` — a newcomer's 1-2 pushed days, not
+    7 — fits a VALID, verdict-capable Gaussian; below the floor the
+    fit is invalid and flags nothing (UNKNOWN upstream)."""
+    from foremast_tpu.models.bivariate import detect_bivariate, fit_bivariate
+
+    rng = np.random.default_rng(5)
+    t_short = 24  # two "days" at an hourly step — far under a 7-day fit
+    x = rng.normal(1.0, 0.1, (2, t_short)).astype(np.float32)
+    y = (x + rng.normal(0.0, 0.03, x.shape)).astype(np.float32)
+    mask = np.ones_like(x, bool)
+    fit = fit_bivariate(jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask))
+    assert np.asarray(fit.valid).all()
+    cx = np.full((2, 6), 1.0, np.float32)
+    cy = cx.copy()
+    cy[:, 3] += 5.0  # gross joint break
+    flags = np.asarray(
+        detect_bivariate(fit, jnp.asarray(cx), jnp.asarray(cy),
+                         jnp.asarray(np.ones_like(cx, bool)), 4.0)
+    )
+    assert flags[:, 3].all()
+
+    # below min_points: invalid, nothing flagged
+    tiny_mask = np.zeros_like(mask)
+    tiny_mask[:, :6] = True
+    tiny = fit_bivariate(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(tiny_mask)
+    )
+    assert not np.asarray(tiny.valid).any()

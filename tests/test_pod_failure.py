@@ -497,6 +497,17 @@ class _DyingRing:
                 raise _Die()
         return self.inner.fetch(url)
 
+    # the ring-first cold path is part of the wrapped surface (a
+    # production worker sees RingSource directly)
+    def hist_columns(self, url, now=None):
+        return self.inner.hist_columns(url, now)
+
+    def hist_coverage(self, url, now=None):
+        return self.inner.hist_coverage(url, now)
+
+    def ingest_debug_state(self):
+        return self.inner.ingest_debug_state()
+
 
 def _durable_worker(store, snap_dir, worker_id, data_now, fallback, *,
                     mesh=None, max_stuck=0.0):
@@ -620,6 +631,79 @@ def test_worker_crash_mid_tick_restarts_warm(tmp_path):
         )
     finally:
         store.update, store.update_many = orig_update, orig_many
+        w1.close()
+        w2.close()
+        snap1.close()
+        snap2.close()
+
+
+def test_restored_ring_serves_recovery_cold_fits_zero_fallback(tmp_path):
+    """Durability × cold-start interplay (ISSUE 10 satellite): even
+    when the fit journals are LOST across a SIGKILL (only the ring
+    snapshot/log survives), the restarted worker's recovery tick
+    re-fits every document COLD — and those cold fits read the
+    restored ring's resident columns, zero fallback HTTP fetches."""
+    import os as _os
+
+    from benchmarks.scaleout_bench import SynthSource, build_fleet
+    from foremast_tpu.jobs.models import STATUS_PREPROCESS_COMPLETED
+    from foremast_tpu.jobs.store import InMemoryStore
+
+    SERVICES_D = 6
+    snap_dir = str(tmp_path / "durable-cold")
+    store = InMemoryStore()
+    build_fleet(store, SERVICES_D, 2, HIST_LEN, CUR_LEN, int(NOW))
+
+    data_now = [NOW + 150.0]
+    fb1 = _CountingSource(SynthSource())
+    w1, snap1, dying1 = _durable_worker(
+        store, snap_dir, "w-coldfit", data_now, fb1
+    )
+    assert w1.tick(now=data_now[0]) == SERVICES_D  # cold: backfills ring
+    snap1.snapshot()
+    # CRASH mid-tick (claim persisted, no verdict)
+    dying1.armed = True
+    data_now[0] = NOW + 160
+    import pytest as _pytest
+
+    with _pytest.raises(_Die):
+        w1.tick(now=data_now[0])
+
+    # the fit journals are LOST (disk swap, operator wipe, version
+    # bump): only the ring state survives
+    for name in _os.listdir(snap_dir):
+        if name.startswith("fit-"):
+            _os.unlink(_os.path.join(snap_dir, name))
+
+    data_now2 = [NOW + 400.0]
+    fb2 = _CountingSource(SynthSource())
+    w2, snap2, _ = _durable_worker(
+        store, snap_dir, "w-coldfit", data_now2, fb2
+    )
+    try:
+        dur = w2.debug_state()["durability"]
+        assert dur["ring"]["restored_series"] > 0
+        assert all(
+            j["restored_entries"] == 0
+            for j in dur["fit_journals"].values()
+        )
+        time.sleep(1.1)  # stuck-claim stamp granularity (wall clock)
+        n = w2.tick(now=data_now2[0])
+        assert n == SERVICES_D
+        # every doc re-fit COLD (no fits survived) ...
+        assert w2._last_tick["fast"] == 0
+        # ... and every cold fit read the restored ring: zero fallback
+        assert fb2.calls == 0, (
+            f"recovery cold fits fell back {fb2.calls} times"
+        )
+        reads = w2.debug_state()["cold_start"]["hist_reads"]
+        assert reads["ring_full"] >= SERVICES_D
+        assert reads["http"] == 0 and reads["cache"] == 0
+        assert all(
+            d.status == STATUS_PREPROCESS_COMPLETED
+            for d in store._docs.values()
+        )
+    finally:
         w1.close()
         w2.close()
         snap1.close()

@@ -583,3 +583,42 @@ def test_worker_debug_state_durability_section(tmp_path):
     assert state["ring"]["directory"] == str(tmp_path)
     worker.close()
     snap.close()
+
+
+def test_restore_into_smaller_ring_clamps_older_spans(tmp_path):
+    """Retuning the ring smaller (FOREMAST_INGEST_MAX_POINTS) across a
+    restart must not leave restored older coverage spans claiming
+    authority over ranges whose samples the smaller ring just dropped:
+    the spans re-assert BEFORE the sample push so the overwrite clamp
+    applies to them too, and a cold fit's hist read for the lost range
+    degrades to the pull path instead of serving a silently truncated
+    "full" history (ring.py: degrade, never a wrong answer)."""
+    base = int(NOW)
+    s1 = _store(shards=1)
+    snap1 = RingSnapshotter(s1, str(tmp_path), clock=lambda: NOW)
+    # an old historical-backfill span, disjoint from the live stream
+    h0, h1 = base - 50_000, base - 48_200
+    old_t = np.arange(h0, h1, 60, np.int64)  # 30 samples
+    s1.push("m", old_t, np.ones(len(old_t), np.float32),
+            start=float(h0), end=float(h1))
+    live_t = np.arange(base - 64 * 60, base, 60, np.int64)  # 64 samples
+    s1.push("m", live_t, np.ones(len(live_t), np.float32))
+    assert len(s1._shards[0]._series["m"].intervals()) == 2
+    snap1.snapshot()
+    snap1.close()
+
+    # restart into a ring whose max_points holds only the live stream:
+    # the restore push drops every historical sample
+    s2 = RingStore(shards=1, stale_seconds=300.0, max_points=64)
+    snap2 = RingSnapshotter(s2, str(tmp_path), clock=lambda: NOW + 30)
+    res = snap2.restore()
+    assert res["restored_series"] == 1
+    snap2.close()
+    # the historical span may not survive its samples: a hist read for
+    # that range must degrade (uncovered -> pull path), never serve
+    # "full" off columns that no longer hold the samples
+    state = s2.hist_query("m", float(h0), float(h1), now=NOW + 30)[0]
+    assert state != "full", state
+    # the live span still serves resident
+    state = s2.query("m", float(base - 64 * 60), None, now=NOW + 30)[0]
+    assert state == "hit", state
