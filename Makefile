@@ -67,6 +67,13 @@ bench-restart:
 bench-chaos:
 	$(PY) -m benchmarks.chaos_bench
 
+# elastic mesh (ISSUE 11): 2 -> 4 -> 2 workers under continuous load
+# with in-run asserts: zero lost/duplicated verdicts, planned handoff
+# inside 2 ticks with ZERO cold refits + ZERO fallback fetches, and a
+# blackholed-transfer phase degrading to cold refit (never a wedge)
+bench-elastic:
+	$(PY) -m benchmarks.elastic_bench
+
 native:
 	$(MAKE) -C native
 
@@ -105,4 +112,4 @@ clean:
 	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 
-.PHONY: test test-fast ci bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest bench-scaleout bench-cold bench-restart bench-chaos native deploy-render check metrics-lint env-docs metrics-docs lockgraph docker-build clean
+.PHONY: test test-fast ci bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest bench-scaleout bench-cold bench-restart bench-chaos bench-elastic native deploy-render check metrics-lint env-docs metrics-docs lockgraph docker-build clean

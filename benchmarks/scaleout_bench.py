@@ -221,12 +221,23 @@ class StoreServer:
     # -- mesh ownership, computed from the records IN the store --------
 
     def _claim_filter(self, worker_id: str):
-        from foremast_tpu.mesh import HashRing, doc_route_key, live_members
+        from foremast_tpu.mesh import (
+            CLAIM_STATES,
+            HashRing,
+            doc_route_key,
+            live_members,
+        )
 
-        members = live_members(self.store)
+        # the CLAIM ring only (mesh/routing.py two-ring ownership): a
+        # fenced `joining` member must not claim a doc the server side
+        # still routes to the current owner, or the joiner judges COLD
+        # mid-handoff — exactly the refit the fence exists to prevent
+        members = [
+            m for m in live_members(self.store) if m.state in CLAIM_STATES
+        ]
         if not members:
             return None
-        key = tuple((m.worker_id, m.capacity) for m in members)
+        key = tuple((m.worker_id, m.capacity, m.state) for m in members)
         with self._lock:
             cached = self._owner_cache
             owners = cached[1] if cached and cached[0] == key else None
@@ -253,11 +264,15 @@ class StoreServer:
         """app -> owner under the CURRENT live membership (parent-side:
         orphan-set computation before a kill)."""
         from foremast_tpu.mesh import HashRing, doc_route_key, live_members
-        from foremast_tpu.mesh.membership import MESH_APP
+        from foremast_tpu.mesh.membership import CLAIM_STATES, MESH_APP
 
         members = live_members(self.store)
         ring = HashRing(
-            {m.worker_id: m.capacity for m in members},
+            {
+                m.worker_id: m.capacity
+                for m in members
+                if m.state in CLAIM_STATES
+            },
             replicas=self.replicas,
         )
         out = {}

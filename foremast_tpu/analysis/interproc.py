@@ -72,11 +72,19 @@ def dotted(node: ast.AST) -> str | None:
 
 
 def annotation_name(node: ast.AST | None) -> str | None:
-    """A (possibly string-quoted) annotation as a dotted name."""
+    """A (possibly string-quoted) annotation as a dotted name. PEP 604
+    optionals (`X | None`, the codebase's idiom for optional typed
+    params) unwrap to the class side — an optional dependency still
+    types the attribute it is assigned to."""
     if node is None:
         return None
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
         return node.value
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        left = annotation_name(node.left)
+        if left and left != "None":
+            return left
+        return annotation_name(node.right)
     return dotted(node)
 
 
@@ -337,8 +345,11 @@ class Program:
 
     def resolve_class_name(self, name: str, module: Module) -> str | None:
         """A (possibly dotted / imported) name to a ClassInfo key."""
-        # string annotations arrive quoted
+        # string annotations arrive quoted, possibly as `"X | None"`
         name = name.strip("'\"")
+        if "|" in name:
+            parts = [p.strip() for p in name.split("|")]
+            name = next((p for p in parts if p and p != "None"), name)
         target = self.imports.get(module.relpath, {}).get(name, name)
         simple = target.rsplit(".", 1)[-1]
         keys = self._by_name.get(simple, [])

@@ -63,6 +63,18 @@ def is_transient_error(e: BaseException) -> bool:
 
     if isinstance(e, _transient_exceptions()):
         return True
+    # urllib wraps socket-level transport failures (connection refused/
+    # reset, DNS, timeouts) in URLError with the original OSError as
+    # `.reason` — classify by that cause, or a refused peer would read
+    # as a programming error and crash the caller's loop. HTTPError (a
+    # URLError subclass) carries a string reason and falls through to
+    # the status check below.
+    import urllib.error
+
+    if isinstance(e, urllib.error.URLError) and isinstance(
+        getattr(e, "reason", None), OSError
+    ):
+        return True
     # requests.HTTPError carries .response; urllib's HTTPError has .code
     status = getattr(getattr(e, "response", None), "status_code", None)
     if status is None:

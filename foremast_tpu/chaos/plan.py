@@ -2,6 +2,7 @@
 
 A `FaultPlan` is a list of `FaultRule`s, each scoped to one dependency
 EDGE (``prometheus`` / ``store`` / ``kube`` / ``receiver`` / ``pusher``
+/ ``transfer`` — the peer→peer planned-handoff stream, mesh/handoff.py
 — plus whatever a harness invents) and optionally to a time window
 relative to plan activation. Clients hold an `EdgeChaos` view and call
 ``perturb(op)`` at their single request choke point; with no plan

@@ -243,7 +243,7 @@ def _enable_compile_cache() -> None:
 
 def _mount_ingest(
     inner, gauge_port: int, router=None, snapshot_dir=None,
-    chaos=None, degrade=None,
+    chaos=None, degrade=None, handoff=None,
 ):
     """FOREMAST_INGEST=1: wrap the pull source in the push-plane
     RingSource (docs/operations.md "Ingest plane") — warm fetches become
@@ -292,6 +292,11 @@ def _mount_ingest(
         "" if inner is None else " [inert: fallback configured]",
         refine_docs_per_tick_from_env(),
     )
+    if handoff is not None:
+        # the handoff plane streams/applies THIS ring's series; the
+        # manager exists before the ring (it needs the chaos edge and
+        # the router's route label), so bind it here
+        handoff.ring_store = ring
     port = _env_int("FOREMAST_INGEST_PORT", 9009)
     srv = None
     if port or router is not None:
@@ -299,6 +304,7 @@ def _mount_ingest(
             port, ring, book=source.book, router=router,
             chaos=chaos,
             degrade_stats=degrade.stats if degrade is not None else None,
+            handoff=handoff,
         )
     if gauge_port:
         from prometheus_client import REGISTRY
@@ -632,6 +638,27 @@ def cmd_worker(args: argparse.Namespace) -> int:
                     os.environ.get("FOREMAST_MESH_ROUTE_LABEL", "") or "app"
                 ),
             )
+        # planned handoff (ISSUE 11): rebalance on planned scale events
+        # becomes a state TRANSFER — the joiner fences until the current
+        # owners stream it its partition, SIGTERM drains instead of
+        # abandoning state (docs/operations.md "Elastic scaling")
+        handoff = None
+        # ingest gates the plane: without a receiver there is no
+        # transfer endpoint anywhere in the fleet — a fenced joiner
+        # would idle out its whole deadline with nothing to receive,
+        # a pure regression over PR-6 immediate claiming
+        if (
+            mesh_on
+            and ingest_on
+            and os.environ.get("FOREMAST_HANDOFF", "1") == "1"
+        ):
+            from foremast_tpu.mesh import HandoffManager
+
+            handoff = HandoffManager(
+                route_label=router.route_label,
+                chaos=_edge("transfer"),
+                breaker=degrade.breakers.get("transfer"),
+            )
         single_source = PrometheusSource(
             chaos=_edge("prometheus"),
             breaker=degrade.breakers.get("prometheus"),
@@ -643,6 +670,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
                     single_source, args.gauge_port, router=router,
                     snapshot_dir=snap_dir,
                     chaos=_edge("receiver"), degrade=degrade,
+                    handoff=handoff,
                 )
             )
         if mesh_on:
@@ -660,7 +688,10 @@ def cmd_worker(args: argparse.Namespace) -> int:
                     adv_host or _socket.gethostname(),
                     adv_port or ingest_srv.server_address[1],
                 )
-            mesh_node = MeshNode(membership, router, ring_store=single_ring)
+            mesh_node = MeshNode(
+                membership, router, ring_store=single_ring,
+                handoff=handoff,
+            )
             mesh_node.start()
         worker = BrainWorker(
             store,
@@ -742,11 +773,37 @@ def cmd_worker(args: argparse.Namespace) -> int:
     if args.warmup:
         worker.warmup()
 
+    stop_fn = stop_event.is_set
+    if mesh_node is not None and mesh_node.handoff is not None:
+        # planned shutdown (ISSUE 11): on the stop signal, stream this
+        # partition to the post-drain owners on a side thread while
+        # the loop KEEPS TICKING — a draining member claims and judges
+        # its partition to the end, so no verdict waits out a slow or
+        # blackholed transfer fenced behind this member's claim-ring
+        # seat. The loop exits once the stream lands (or fails:
+        # survivors cold-refit via the PR-6 path); the finally block's
+        # drain() then only leaves.
+        drain_thread_box: list = [None]
+
+        def stop_fn() -> bool:
+            if not stop_event.is_set():
+                return False
+            t = drain_thread_box[0]
+            if t is None:
+                t = threading.Thread(
+                    target=mesh_node.stream_drain,
+                    name="handoff-drain",
+                    daemon=True,
+                )
+                drain_thread_box[0] = t
+                t.start()
+            return not t.is_alive()
+
     loop_failed = False
     try:
         worker.run(
             poll_seconds=args.poll,
-            stop=stop_event.is_set,
+            stop=stop_fn,
             after_tick=after_tick,
         )
     except BaseException:
@@ -768,13 +825,24 @@ def cmd_worker(args: argparse.Namespace) -> int:
                 "worker pool shutdown failed: %s", e
             )
         if mesh_node is not None:
-            # leave FIRST: peers drop this member (and start claiming
-            # its partition) without waiting out the lease
+            # planned shutdown: DRAIN when the handoff plane is wired —
+            # the partition's ring series + fits streamed to the
+            # post-drain owners under the tick loop (stop_fn above), so
+            # drain() here normally just leaves and the survivors take
+            # over warm (docs/operations.md "Elastic scaling");
+            # otherwise leave FIRST so peers drop this member (and
+            # start claiming its partition) without waiting out the
+            # lease. Either way a failure degrades (survivors
+            # cold-refit via stuck-claim takeover), never masks the
+            # loop's own error.
             try:
-                mesh_node.close()
+                if mesh_node.handoff is not None and not loop_failed:
+                    mesh_node.drain()
+                else:
+                    mesh_node.close()
             except Exception as e:  # noqa: BLE001 — cleanup must not mask
                 logging.getLogger("foremast_tpu.cli").warning(
-                    "mesh leave failed: %s", e
+                    "mesh drain/leave failed: %s", e
                 )
         if ingest_srv is not None:
             # bounded drain: in-flight pushes finish (or are abandoned

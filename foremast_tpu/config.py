@@ -668,7 +668,8 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "\"Failure modes & degradation\"): inline JSON or `@path` to a "
         "JSON file — seeded rules injecting latency / error-rate / "
         "blackhole / clock-skew faults into each dependency edge "
-        "(prometheus, store, kube, receiver, pusher, clock). UNSET in "
+        "(prometheus, store, kube, receiver, pusher, transfer, clock). "
+        "UNSET in "
         "production: every injection seam is then a pass-through "
         "attribute check. Test/soak tooling only",
     ),
@@ -789,6 +790,89 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "host (or host:port) peers and pushers should use to reach "
         "this worker's ingest receiver; default advertises the local "
         "hostname with the receiver's actual bound port",
+    ),
+    EnvKnob(
+        "FOREMAST_HANDOFF",
+        "1",
+        "bool",
+        "default `1` (mesh + ingest mode): planned membership changes "
+        "move state instead of refitting it (docs/operations.md "
+        "\"Elastic scaling\") — a joining worker registers FENCED and "
+        "receives its partition's ring series + fit entries from the "
+        "current owners before claiming; a draining worker streams its "
+        "state to the post-drain owners before leaving; SIGTERM on a "
+        "mesh worker drains instead of just leaving. `0` restores the "
+        "PR-6 behavior (every partition move cold-refits)",
+    ),
+    EnvKnob(
+        "FOREMAST_HANDOFF_DEADLINE_SECONDS",
+        "30",
+        "float",
+        "planned-handoff fence bound: a joining worker waits at most "
+        "this long for the current owners' transfer `done` markers "
+        "before activating anyway — a torn, blackholed, or crashed "
+        "transfer degrades the moved state to cold refits (counted on "
+        "`foremast_handoff_transfers`), never a parked joiner. Also "
+        "bounds (2x) how long transferred-in series are protected from "
+        "the rebalance eviction pass",
+    ),
+    EnvKnob(
+        "FOREMAST_AUTOSCALE_HIGH_OCCUPANCY",
+        "0.8",
+        "float",
+        "autoscale driver (mesh/autoscale.py): tick occupancy (busy "
+        "seconds per wall second) at or above this is a scale-up "
+        "breach signal",
+    ),
+    EnvKnob(
+        "FOREMAST_AUTOSCALE_LOW_OCCUPANCY",
+        "0.3",
+        "float",
+        "autoscale driver: occupancy at or below this (with every "
+        "other signal quiet) is a scale-down breach signal",
+    ),
+    EnvKnob(
+        "FOREMAST_AUTOSCALE_HIGH_RING_PRESSURE",
+        "0.85",
+        "float",
+        "autoscale driver: resident ring bytes over "
+        "FOREMAST_INGEST_BUDGET_BYTES at or above this fraction is a "
+        "scale-up breach signal (eviction pressure turns warm fetches "
+        "back into fallback fetches)",
+    ),
+    EnvKnob(
+        "FOREMAST_AUTOSCALE_HIGH_WRITE_QUEUE",
+        "8",
+        "int",
+        "autoscale driver: a slow-path write-queue peak at or above "
+        "this is a scale-up breach signal",
+    ),
+    EnvKnob(
+        "FOREMAST_AUTOSCALE_BREACH_TICKS",
+        "3",
+        "int",
+        "autoscale driver hysteresis: a signal must breach for this "
+        "many CONSECUTIVE observations before a verdict fires",
+    ),
+    EnvKnob(
+        "FOREMAST_AUTOSCALE_COOLDOWN_SECONDS",
+        "120",
+        "float",
+        "autoscale driver hysteresis: no verdict within this window of "
+        "the previous one — the rebalance transient a scale event "
+        "itself causes must not trigger the next one",
+    ),
+    EnvKnob(
+        "FOREMAST_AUTOSCALE_MIN_WORKERS",
+        "1",
+        "int",
+        "autoscale driver: scale-down floor",
+    ),
+    EnvKnob(
+        "FOREMAST_AUTOSCALE_MAX_WORKERS",
+        "64",
+        "int",
+        "autoscale driver: scale-up ceiling",
     ),
     EnvKnob(
         "FOREMAST_MAX_GAUGE_FAMILIES",

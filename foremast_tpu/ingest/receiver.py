@@ -48,6 +48,9 @@ from foremast_tpu.ingest.wire import WireError, parse_push
 log = logging.getLogger("foremast_tpu.ingest")
 
 WRITE_PATH = "/api/v1/write"
+# peer→peer planned-handoff endpoint (mesh/handoff.py): crc-framed
+# transfer batches from a draining member or a joiner's current owners
+TRANSFER_PATH = "/api/v1/transfer"
 DEFAULT_MAX_BODY_BYTES = 8 * 1024 * 1024
 # concurrent push handlers allowed before the receiver sheds with
 # 429 + Retry-After (FOREMAST_INGEST_MAX_INFLIGHT; 0 = unbounded)
@@ -127,6 +130,7 @@ def start_ingest_server(
     max_inflight: int | None = None,
     chaos=None,
     degrade_stats=None,
+    handoff=None,
 ):
     """Serve the push plane; returns (server, thread). Port 0 binds an
     ephemeral port (tests) — read it back from server.server_address.
@@ -148,7 +152,14 @@ def start_ingest_server(
     of a handler-thread pileup. `chaos` (chaos.EdgeChaos) injects
     latency/errors at the handler seam — faults are ANSWERED as their
     HTTP status, never raised into the server loop. `degrade_stats`
-    (chaos.DegradeStats) counts sheds."""
+    (chaos.DegradeStats) counts sheds.
+
+    `handoff` (mesh.handoff.HandoffManager, duck-typed): mounts the
+    peer→peer transfer endpoint ``POST /api/v1/transfer`` — planned
+    scale events stream ring series + fit entries here (404 when no
+    handoff plane is wired). The body cap and the inflight shed apply
+    to transfers exactly as to pushes: senders chunk batches under the
+    cap and treat 429 as transient."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     if max_body_bytes is None:
@@ -195,8 +206,11 @@ def start_ingest_server(
 
         def _post(self):
             path = self.path.split("?", 1)[0]
-            if path != WRITE_PATH:
+            if path not in (WRITE_PATH, TRANSFER_PATH):
                 self._send(404, b'{"reason": "not found"}')
+                return
+            if path == TRANSFER_PATH and handoff is None:
+                self._send(404, b'{"reason": "no handoff plane"}')
                 return
             # shed BEFORE reading the body: under overload the cheapest
             # possible answer, and the pusher's buffer (not our heap)
@@ -236,6 +250,17 @@ def start_ingest_server(
                 raw = self.rfile.read(length)
             except OSError:
                 return  # pusher died mid-body; nothing to answer
+            if path == TRANSFER_PATH:
+                # crc-framed peer transfer: the handoff plane applies
+                # it (damage degrades per record, never a crash) and
+                # reports what landed
+                try:
+                    code, body = handoff.apply_transfer(raw)
+                except Exception as e:  # noqa: BLE001 — answer, don't die
+                    log.exception("handoff transfer application failed")
+                    code, body = 500, {"reason": str(e)}
+                self._send(code, json.dumps(body).encode())
+                return
             try:
                 payload = json.loads(raw or b"{}")
                 entries = parse_push(payload)

@@ -96,32 +96,40 @@ def append_record(fh, payload: bytes) -> int:
     return len(header) + len(payload)
 
 
+def read_record_stream(fh):
+    """Yield (payload, None) per intact record from an open binary
+    stream, then (None, "torn_log") once if the tail is torn — short
+    header/payload, bad magic, crc mismatch. Everything BEFORE the
+    first bad frame is served; nothing after it is trusted (a corrupt
+    length field would desync every later frame). Shared by the
+    append-log replay below and the mesh handoff's transfer decoder
+    (mesh/handoff.py) — one definition of "healthy prefix"."""
+    while True:
+        header = fh.read(_LOG_HEADER.size)
+        if not header:
+            return  # clean EOF
+        if len(header) < _LOG_HEADER.size:
+            yield None, "torn_log"
+            return
+        magic, length, crc = _LOG_HEADER.unpack(header)
+        if magic != _LOG_MAGIC:
+            yield None, "torn_log"
+            return
+        payload = fh.read(length)
+        if len(payload) < length or zlib.crc32(payload) != crc:
+            yield None, "torn_log"
+            return
+        yield payload, None
+
+
 def read_records(path: str):
-    """Yield (payload, None) per intact record, then (None, reason) once
-    if the tail is torn — short header/payload, bad magic, crc mismatch.
-    Everything BEFORE the first bad frame is served; nothing after it is
-    trusted (a corrupt length field would desync every later frame)."""
+    """`read_record_stream` over a file path (missing file = no records)."""
     try:
         fh = open(path, "rb")
     except OSError:
         return
     with fh:
-        while True:
-            header = fh.read(_LOG_HEADER.size)
-            if not header:
-                return  # clean EOF
-            if len(header) < _LOG_HEADER.size:
-                yield None, "torn_log"
-                return
-            magic, length, crc = _LOG_HEADER.unpack(header)
-            if magic != _LOG_MAGIC:
-                yield None, "torn_log"
-                return
-            payload = fh.read(length)
-            if len(payload) < length or zlib.crc32(payload) != crc:
-                yield None, "torn_log"
-                return
-            yield payload, None
+        yield from read_record_stream(fh)
 
 
 def rotated_logs(base_path: str) -> list[str]:

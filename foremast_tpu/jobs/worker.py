@@ -318,6 +318,12 @@ class BrainWorker:
         # the claim only takes documents in this worker's partition
         # (claim-CAS stays the safety net against stale ring views).
         self.mesh = mesh
+        # Planned handoff (mesh/handoff.py): a mesh node carrying a
+        # handoff manager streams/receives fit-cache entries on planned
+        # scale events — register this worker's caches with it so a
+        # moved partition arrives with its fits, not just its samples.
+        if mesh is not None and getattr(mesh, "handoff", None) is not None:
+            self.attach_handoff(mesh.handoff)
         self._last_tick = {"at": 0.0, "docs": 0, "fast": 0, "seconds": 0.0}
         # Durable data plane (ISSUE 7): write-through fit journals
         # (enable_fit_persistence) + the ring snapshotter the CLI
@@ -895,6 +901,26 @@ class BrainWorker:
             )
         return restored
 
+    def attach_handoff(self, handoff) -> None:
+        """Register this worker's fit caches with the mesh handoff
+        plane (ISSUE 11) — the same cache set `enable_fit_persistence`
+        journals, because "what must survive a restart" and "what must
+        move with a partition" are the same state: the univariate fit
+        cache, the seasonal gap anchors, the provisional-fit refine
+        book, and (for joint judges) the joint entry cache + its warm
+        metadata. The device arena is NOT transferred for the same
+        reason it is not snapshotted — it rehydrates row-by-row from
+        the transferred fits on the new owner's first claim."""
+        pairs = {
+            "fits": self._fit_cache,
+            "gaps": self._gap_meta,
+            "refine": self._refine_book,
+        }
+        if self._mvj is not None:
+            pairs["joint"] = self._mvj.cache
+            pairs["jmeta"] = self._mvj.joint_meta
+        handoff.register_caches(pairs)
+
     def attach_ring_snapshotter(self, snapshotter) -> None:
         """Expose an ingest.snapshot.RingSnapshotter on /debug/state
         and fold its cadence into the tick loop (maybe_snapshot runs in
@@ -935,6 +961,14 @@ class BrainWorker:
         from-scratch fit on the same columns. Returns #invalidated."""
         book = self._refine_book
         if not len(book) or self._ring_hist is None:
+            return 0
+        if self.mesh is not None and getattr(self.mesh, "draining", False):
+            # drain-aware tick (ISSUE 11): a refinement invalidation
+            # right now would pop fits this worker is about to STREAM
+            # to the new owners — the receiver would inherit a hole it
+            # must cold-refit. The records move with the handoff (the
+            # refine book is a registered cache), so the new owner
+            # resumes the pacing instead.
             return 0
         probe = getattr(self.source, "hist_coverage", None)
         if probe is None:

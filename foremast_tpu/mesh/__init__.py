@@ -13,16 +13,31 @@ Four planes (docs/operations.md "Worker mesh"):
     pushes for series a worker does not own are accepted AND answered
     with the owner's advertised address (`mesh/routing.py`), so
     pushers converge within one push cycle;
-  * rebalance — a dead member's lease expires, the ring heals with
-    minimal movement, orphaned claims age out through the existing
-    stuck-claim CAS takeover, and newly-owned cold series backfill
-    through the fallback path.
+  * rebalance — UNPLANNED: a dead member's lease expires, the ring
+    heals with minimal movement, orphaned claims age out through the
+    existing stuck-claim CAS takeover, and newly-owned cold series
+    backfill through the fallback path. PLANNED (`mesh/handoff.py`):
+    a joining or draining member's state is STREAMED to the new
+    owners — lifecycle states `joining`/`draining` fence claims while
+    the transfer is in flight, so a scale event costs zero fallback
+    fetches and zero cold refits instead of a fleet-wide refit wall;
+  * autoscaling — `mesh/autoscale.py` turns the exported saturation
+    signals (tick occupancy, write-queue peak, ring budget pressure)
+    into hysteretic join/leave decisions.
 """
 
+from foremast_tpu.mesh.autoscale import AutoscaleConfig, AutoscaleDriver
+from foremast_tpu.mesh.handoff import HandoffManager
 from foremast_tpu.mesh.membership import (
+    CLAIM_STATES,
+    MEMBER_STATES,
     MESH_APP,
+    STATE_ACTIVE,
+    STATE_DRAINING,
+    STATE_JOINING,
     STATUS_MESH_LEFT,
     STATUS_MESH_MEMBER,
+    TARGET_STATES,
     MemberRecord,
     Membership,
     live_members,
@@ -38,9 +53,18 @@ from foremast_tpu.mesh.routing import (
 )
 
 __all__ = [
+    "CLAIM_STATES",
+    "MEMBER_STATES",
     "MESH_APP",
+    "STATE_ACTIVE",
+    "STATE_DRAINING",
+    "STATE_JOINING",
     "STATUS_MESH_LEFT",
     "STATUS_MESH_MEMBER",
+    "TARGET_STATES",
+    "AutoscaleConfig",
+    "AutoscaleDriver",
+    "HandoffManager",
     "HashRing",
     "MemberRecord",
     "Membership",

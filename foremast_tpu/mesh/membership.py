@@ -54,6 +54,38 @@ STATUS_MESH_MEMBER = "mesh_member"
 # filtered out of membership regardless of lease freshness
 STATUS_MESH_LEFT = "mesh_left"
 
+# -- member lifecycle states (ISSUE 11: planned elasticity) -------------
+#
+# `active`   the steady state: the member claims its partition and is a
+#            handoff target for planned moves.
+# `draining` a planned scale-down in flight: the member still CLAIMS and
+#            judges its partition (nothing un-judged is abandoned), but
+#            ownership-to-be excludes it — receivers hint pushers at the
+#            post-drain owners and the member streams its ring shards +
+#            fit entries to them before flipping to `mesh_left`.
+# `joining`  a planned scale-up in flight: the member is visible (its
+#            lease counts, its record advertises the transfer endpoint)
+#            but FENCED from claims until the current owners finish
+#            streaming it the partition it is about to take — the fence
+#            is what makes a partition move a warm state TRANSFER
+#            instead of a cold refit race.
+#
+# A record from a build that predates states (or a state this build
+# does not know) reads as `active`: old readers keep claiming/routing
+# to new members exactly as before, which degrades planned handoff to
+# the PR-6 cold-refit rebalance, never to wrong ownership.
+STATE_ACTIVE = "active"
+STATE_DRAINING = "draining"
+STATE_JOINING = "joining"
+MEMBER_STATES = (STATE_ACTIVE, STATE_DRAINING, STATE_JOINING)
+# which states sit in which ring (mesh/routing.py two-ring ownership):
+# the CLAIM ring answers "who judges this doc RIGHT NOW" (a draining
+# member keeps judging until it leaves; a joining member is fenced),
+# the TARGET ring answers "who owns this key once the planned change
+# completes" (hints, handoff destinations, eviction retention).
+CLAIM_STATES = frozenset({STATE_ACTIVE, STATE_DRAINING})
+TARGET_STATES = frozenset({STATE_ACTIVE, STATE_JOINING})
+
 DEFAULT_LEASE_SECONDS = 15.0
 
 # A fast reader tolerates skew < lease × (1 - 1/3 renewal cadence);
@@ -75,6 +107,7 @@ class MemberRecord:
     capacity: int = 1  # hash-ring share weight
     lease_seconds: float = DEFAULT_LEASE_SECONDS
     renewed_at: float = 0.0  # member's clock, unix seconds
+    state: str = STATE_ACTIVE  # lifecycle state (see MEMBER_STATES)
 
     def expired(self, now: float) -> bool:
         return now - self.renewed_at > self.lease_seconds
@@ -88,6 +121,7 @@ class MemberRecord:
                 "capacity": self.capacity,
                 "leaseSeconds": self.lease_seconds,
                 "renewedAt": self.renewed_at,
+                "state": self.state,
             }
         )
 
@@ -95,6 +129,12 @@ class MemberRecord:
     def from_payload(raw: str) -> "MemberRecord | None":
         try:
             d = json.loads(raw)
+            state = str(d.get("state", STATE_ACTIVE))
+            if state not in MEMBER_STATES:
+                # forward compatibility: an unknown lifecycle state from
+                # a newer build reads as plain membership — old readers
+                # keep claiming/routing to it (see the states note above)
+                state = STATE_ACTIVE
             return MemberRecord(
                 worker_id=str(d["workerId"]),
                 ingest_address=str(d.get("ingestAddress", "")),
@@ -104,6 +144,7 @@ class MemberRecord:
                     d.get("leaseSeconds", DEFAULT_LEASE_SECONDS)
                 ),
                 renewed_at=float(d.get("renewedAt", 0.0)),
+                state=state,
             )
         except (ValueError, TypeError, KeyError):
             return None  # a corrupt record is a dead record, not a crash
@@ -143,6 +184,7 @@ class Membership:
         observe_port: int = 0,
         capacity: int = 1,
         clock=time.time,
+        state: str = STATE_ACTIVE,
     ):
         self.store = store
         self.worker_id = worker_id
@@ -151,6 +193,7 @@ class Membership:
         self.observe_port = int(observe_port)
         self.capacity = max(1, int(capacity))
         self._clock = clock
+        self.state = state
         self._doc: Document | None = None
         self._last_renew = 0.0
 
@@ -162,7 +205,20 @@ class Membership:
             capacity=self.capacity,
             lease_seconds=self.lease_seconds,
             renewed_at=now,
+            state=self.state,
         )
+
+    def set_state(self, state: str) -> None:
+        """Flip this member's lifecycle state and publish it at once (a
+        forced renew): peers must see `draining`/`joining` promptly —
+        the fence and the hint routing both hang off it."""
+        if state not in MEMBER_STATES:
+            raise ValueError(f"unknown member state {state!r}")
+        if state == self.state:
+            return
+        self.state = state
+        self.renew(force=True)
+        log.info("mesh state: %s -> %s", self.worker_id, state)
 
     def join(self) -> MemberRecord:
         now = self._clock()

@@ -1,34 +1,44 @@
 """`MeshNode` — one worker's seat in the mesh, wired into the tick.
 
-Composes the three mesh planes (membership heartbeat, ownership
-router, optional local ring shard) behind the tiny surface the worker
-loop consumes:
+Composes the mesh planes (membership heartbeat, ownership router,
+optional local ring shard, optional planned-handoff manager) behind the
+tiny surface the worker loop consumes:
 
   * ``claim_filter(doc)`` — the predicate `JobStore.claim` applies
     BEFORE flipping a doc in-progress, so a worker only ever claims
     its partition (claim-CAS stays the double-judgment safety net for
     stale views);
-  * ``on_tick(now)`` — lease renew (rate-limited) + ring refresh; on a
-    membership change, series this worker no longer owns are evicted
-    from its ring shard so the freed budget serves the partition it
-    actually holds (newly-owned cold series backfill through the
-    existing fallback path — rebalance needs no data transfer);
+  * ``on_tick()`` — lease renew (rate-limited) + ring refresh; drives
+    the handoff plane (stream to joiners, activate a fenced join); on
+    a membership change, series this worker neither serves now nor is
+    about to own are evicted from its ring shard so the freed budget
+    serves the partition it actually holds;
+  * ``drain()`` — the planned scale-down: flip to ``draining``, stream
+    owned ring series + fits to the post-drain owners, then leave
+    (docs/operations.md "Elastic scaling");
   * ``debug_state()`` — the worker `/debug/state` ``mesh`` section;
   * ``close()`` — leave the mesh (peers drop this worker immediately
     instead of waiting out the lease).
 
-`MeshCollector` exports the same counters as `foremast_mesh_*`
-families (docs/observability.md), materialized at scrape time like the
-ingest plane's collector — nothing on the tick path touches
-prometheus_client.
+`MeshCollector` exports the same counters as `foremast_mesh_*` /
+`foremast_handoff_*` families (docs/observability.md), materialized at
+scrape time like the ingest plane's collector — nothing on the tick
+path touches prometheus_client.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 import time
 
-from foremast_tpu.mesh.membership import Membership
+from foremast_tpu.mesh.membership import (
+    MEMBER_STATES,
+    STATE_ACTIVE,
+    STATE_DRAINING,
+    STATE_JOINING,
+    Membership,
+)
 from foremast_tpu.mesh.routing import MeshRouter
 
 log = logging.getLogger("foremast_tpu.mesh")
@@ -41,22 +51,76 @@ class MeshNode:
         router: MeshRouter,
         ring_store=None,  # ingest.shards.RingStore (optional)
         clock=time.time,
+        handoff: "HandoffManager | None" = None,
+        join_fenced: bool | None = None,
     ):
+        """`handoff` mounts the planned-handoff plane; `join_fenced`
+        (default: handoff wired) makes `start()` register as a fenced
+        ``joining`` member when active peers exist, so the current
+        owners stream this worker its partition before it claims."""
         self.membership = membership
         self.router = router
         self.ring_store = ring_store
         self._clock = clock
+        self.handoff = handoff
+        self.join_fenced = (
+            (handoff is not None) if join_fenced is None else bool(join_fenced)
+        )
         # claim-filter traffic: owned vs skipped docs seen by claims
         self.claim_counts = {"owned": 0, "skipped": 0}
         self._started = False
+        self._drain_out: dict | None = None  # stream_drain ran (result)
+        self._serve_thread: threading.Thread | None = None
 
     @property
     def worker_id(self) -> str:
         return self.membership.worker_id
 
+    @property
+    def state(self) -> str:
+        return self.membership.state
+
+    @property
+    def draining(self) -> bool:
+        return self.membership.state == STATE_DRAINING
+
+    @property
+    def joining(self) -> bool:
+        return self.membership.state == STATE_JOINING
+
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> None:
+        if self.join_fenced and self.handoff is not None:
+            # fence only when there is someone to hand off FROM: a solo
+            # first member (or a fleet of simultaneous bootstrappers)
+            # must come up claiming, not waiting on a deadline. A
+            # worker RE-TAKING a still-live seat (the PR-7 SIGKILL
+            # restart: persisted identity, lease not yet expired, ring
+            # never moved) must not fence either — joining would evict
+            # it from the claim ring and hand its partition to peers
+            # COLD, exactly the refit wall the warm restart exists to
+            # avoid.
+            records = self.membership.live_members()
+            self_alive = any(
+                m.worker_id == self.worker_id for m in records
+            )
+            peers = [
+                m
+                for m in records
+                if m.state == STATE_ACTIVE
+                and m.worker_id != self.worker_id
+            ]
+            if peers and not self_alive:
+                self.membership.state = STATE_JOINING
+                self.handoff.begin_join({m.worker_id for m in peers})
+                log.info(
+                    "mesh join (fenced): %s waits for handoff from %s "
+                    "(deadline %.1fs)",
+                    self.worker_id,
+                    sorted(m.worker_id for m in peers),
+                    self.handoff.deadline_seconds,
+                )
         self.membership.join()
         self.router.refresh(force=True)
         self._started = True
@@ -65,6 +129,60 @@ class MeshNode:
         if self._started:
             self.membership.leave()
             self._started = False
+
+    def stream_drain(self) -> dict:
+        """The streaming half of a planned scale-down (ISSUE 11):
+        publish ``draining`` (peers hint pushers at the post-drain
+        owners and protect transferred state from eviction) and stream
+        every owned ring series + fit to its new owner — WITHOUT
+        leaving, so the caller can keep ticking while the transfer is
+        in flight: a draining member stays on the claim ring, claiming
+        and judging its partition to the end, and no verdict is lost
+        or delayed behind a slow target (cli runs this on a side
+        thread under the loop). Idempotent — a second call returns the
+        first call's outcomes without re-streaming. A failed transfer
+        degrades to the PR-6 cold-refit rebalance (counted), never a
+        wedge. Returns per-target send outcomes."""
+        if not self._started:
+            return {}
+        if self._drain_out is not None:
+            return self._drain_out
+        self.membership.set_state(STATE_DRAINING)
+        self.router.refresh(force=True)
+        out: dict = {"targets": {}, "state": "drained"}
+        if self.handoff is not None:
+            # joiners are targets too: the target ring may hand part of
+            # this partition straight to a still-fenced joiner, and a
+            # draining member's tick no longer serves joiners — skipping
+            # them here would silently drop that slice to a cold refit
+            # exactly when scale-down and scale-up overlap
+            targets = [
+                m
+                for m in self.router.members()
+                if m.state in (STATE_ACTIVE, STATE_JOINING)
+                and m.worker_id != self.worker_id
+                and m.ingest_address
+            ]
+            sent = self.handoff.send_all(
+                targets, self.router, self.worker_id
+            )
+            out["targets"] = {
+                tid: "ok" if ok else "failed" for tid, ok in sent.items()
+            }
+        self._drain_out = out
+        return out
+
+    def drain(self) -> dict:
+        """Planned scale-down: `stream_drain()` (skipped if the caller
+        already ran it under the tick loop), then leave. Returns the
+        per-target send outcomes."""
+        if not self._started:
+            return self._drain_out or {}
+        out = self.stream_drain()
+        self.membership.leave()
+        self._started = False
+        log.info("mesh drain complete: %s (%s)", self.worker_id, out)
+        return out
 
     # -- tick hooks -----------------------------------------------------
 
@@ -99,13 +217,88 @@ class MeshNode:
                 "%s); keeping the last ring view", e,
             )
             return
+        if self.handoff is not None:
+            self._drive_handoff()
         if changed and self.ring_store is not None:
-            dropped = self.ring_store.evict_unowned(self.router.owns_series)
+            dropped = self.ring_store.evict_unowned(self._retains)
             if dropped:
                 log.info(
                     "mesh rebalance: evicted %d series no longer owned "
                     "by %s", dropped, self.worker_id,
                 )
+
+    def _retains(self, key: str) -> bool:
+        """The eviction-retention predicate: keep a series owned on the
+        claim ring, on the target ring (a planned change is about to
+        hand it to us), or just transferred here (`evict_unowned` must
+        never race a shard mid-flight — the transfer may land before
+        this router has even SEEN the planned state that justifies it)."""
+        if self.handoff is None:
+            return self.router.owns_series(key)
+        return self.router.retains_series(key) or self.handoff.is_protected(
+            key
+        )
+
+    def _drive_handoff(self) -> None:
+        """Per-tick handoff plane work: active members stream state to
+        newly-visible joiners; a fenced joiner activates once every
+        live active member's `done` marker arrived (or the deadline
+        passed — degradation to cold refit, never a deadlock)."""
+        handoff = self.handoff
+        members = self.router.members()
+        handoff.note_members(members)
+        handoff.purge_protected()
+        if self.membership.state == STATE_ACTIVE:
+            t = self._serve_thread
+            if t is not None and not t.is_alive():
+                self._serve_thread = None
+                t = None
+            if t is None:
+                pending = handoff.pending_joiners(members, self.worker_id)
+                if pending:
+                    # served even on failure: the joiner's deadline owns
+                    # the degradation, a resend against a blackholed
+                    # receiver would wedge behind the timeout. One
+                    # send_all for every joiner visible this tick (the
+                    # moving state is enumerated once, not per joiner),
+                    # on a SIDE THREAD: the stream — full-partition
+                    # enumeration plus batched POSTs with retries —
+                    # must not stall this member's claiming/judging,
+                    # symmetric with the cli drain path. At most one
+                    # stream in flight; joiners appearing meanwhile
+                    # wait for the next tick.
+                    for rec in pending:
+                        handoff.mark_served(rec.worker_id)
+                    t = threading.Thread(
+                        target=handoff.send_all,
+                        args=(pending, self.router, self.worker_id),
+                        name="handoff-serve",
+                        daemon=True,
+                    )
+                    self._serve_thread = t
+                    t.start()
+        elif self.membership.state == STATE_JOINING:
+            live_active = {
+                m.worker_id for m in members if m.state == STATE_ACTIVE
+            }
+            if handoff.join_ready(live_active):
+                self.membership.set_state(STATE_ACTIVE)
+                self.router.refresh(force=True)
+                log.info(
+                    "mesh join complete: %s active after %.2fs handoff "
+                    "wait", self.worker_id,
+                    handoff.join_wait_seconds or 0.0,
+                )
+
+    def wait_handoff_streams(self, timeout: float | None = None) -> bool:
+        """Block until the in-flight joiner stream (if any) finished —
+        a test/bench synchronization hook; the production tick never
+        waits on it. Returns whether the stream is done."""
+        t = self._serve_thread
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
 
     def claim_filter(self, doc) -> bool:
         owned = self.router.owns_doc(doc)
@@ -118,10 +311,12 @@ class MeshNode:
         members = self.router.members()
         return {
             "worker_id": self.worker_id,
+            "state": self.membership.state,
             "live_members": len(members),
             "members": [
                 {
                     "worker_id": m.worker_id,
+                    "state": m.state,
                     "ingest_address": m.ingest_address,
                     "observe_port": m.observe_port,
                     "capacity": m.capacity,
@@ -138,6 +333,11 @@ class MeshNode:
             "redirect_hints": self.router.counters["redirect_hints"],
             "foreign_series": self.router.counters["foreign_series"],
             "claim_docs": dict(self.claim_counts),
+            "handoff": (
+                self.handoff.debug_state()
+                if self.handoff is not None
+                else None
+            ),
         }
 
 
@@ -154,11 +354,20 @@ class MeshCollector:
         )
 
         node = self._node
-        yield GaugeMetricFamily(
+        members = GaugeMetricFamily(
             "foremast_mesh_members",
-            "live mesh members (fresh leases, including this worker)",
-            value=len(node.router.members()),
+            "live mesh members (fresh leases, including this worker), "
+            "by lifecycle state (active=claiming, draining=planned "
+            "scale-down streaming its state out, joining=fenced until "
+            "handoff completes)",
+            labels=["state"],
         )
+        by_state = dict.fromkeys(MEMBER_STATES, 0)
+        for m in node.router.members():
+            by_state[m.state] = by_state.get(m.state, 0) + 1
+        for state in MEMBER_STATES:
+            members.add_metric([state], by_state[state])
+        yield members
         yield CounterMetricFamily(
             "foremast_mesh_rebalances",
             "hash-ring swaps after membership changes",
@@ -179,3 +388,46 @@ class MeshCollector:
         for result, n in node.claim_counts.items():
             claims.add_metric([result], n)
         yield claims
+
+        # planned-handoff plane (zeros when no handoff manager is
+        # wired — a stable exposition so dashboards need no existence
+        # checks)
+        from foremast_tpu.mesh.handoff import RECEIVE_RESULTS, SEND_RESULTS
+
+        counters = (
+            node.handoff.counters_snapshot()
+            if node.handoff is not None
+            else None
+        )
+        state = CounterMetricFamily(
+            "foremast_handoff_state",
+            "ring series and fit-cache entries moved by planned "
+            "handoff, by payload kind and direction",
+            labels=["kind", "direction"],
+        )
+        for kind in ("series", "fits"):
+            for direction in ("sent", "received"):
+                state.add_metric(
+                    [kind, direction],
+                    counters[f"{kind}_{direction}"] if counters else 0,
+                )
+        yield state
+        transfers = CounterMetricFamily(
+            "foremast_handoff_transfers",
+            "planned-handoff transfer outcomes by role (send=this "
+            "member streaming out, receive=transfer batches applied "
+            "here); failed/torn/rejected transfers degrade the moved "
+            "state to a cold refit, never a wedge",
+            labels=["role", "result"],
+        )
+        for result in SEND_RESULTS:
+            transfers.add_metric(
+                ["send", result],
+                counters["send"][result] if counters else 0,
+            )
+        for result in RECEIVE_RESULTS:
+            transfers.add_metric(
+                ["receive", result],
+                counters["receive"][result] if counters else 0,
+            )
+        yield transfers

@@ -30,7 +30,12 @@ import threading
 import time
 
 from foremast_tpu.ingest.wire import canonical_series
-from foremast_tpu.mesh.membership import MemberRecord, Membership
+from foremast_tpu.mesh.membership import (
+    CLAIM_STATES,
+    TARGET_STATES,
+    MemberRecord,
+    Membership,
+)
 from foremast_tpu.mesh.partition import HashRing
 
 log = logging.getLogger("foremast_tpu.mesh")
@@ -73,7 +78,20 @@ def series_route_key(key: str, route_label: str = DEFAULT_ROUTE_LABEL) -> str:
 
 class MeshRouter:
     """Membership-backed ownership oracle. Thread-safe: the receiver's
-    handler threads and the worker's tick thread both consult it."""
+    handler threads and the worker's tick thread both consult it.
+
+    Two rings since ISSUE 11 (planned elasticity):
+
+      * the CLAIM ring (states active + draining) answers "who judges
+        this document RIGHT NOW" — a draining member keeps judging its
+        partition until it leaves, a joining member is fenced out;
+      * the TARGET ring (states active + joining) answers "who owns
+        this key once the in-flight planned change completes" — it
+        routes redirect hints (pushers converge onto the new owner
+        DURING the transfer window) and picks handoff destinations.
+
+    A fleet with no planned change in flight has identical rings, and
+    every pre-states code path keeps its exact behavior."""
 
     def __init__(
         self,
@@ -90,6 +108,7 @@ class MeshRouter:
         self._clock = clock
         self._lock = threading.Lock()
         self._ring = HashRing((), replicas=self.replicas)
+        self._target_ring = HashRing((), replicas=self.replicas)
         self._members: dict[str, MemberRecord] = {}
         self._last_refresh = 0.0
         # rebalances = ring swaps after the first build; redirect_hints /
@@ -105,8 +124,9 @@ class MeshRouter:
         return self.membership.worker_id
 
     def refresh(self, force: bool = False) -> bool:
-        """Re-list membership (rate-limited) and swap the ring when the
-        live set changed. Returns True on a membership change."""
+        """Re-list membership (rate-limited) and swap the rings when the
+        live set (or any member's state/capacity) changed. Returns True
+        on a membership change."""
         now = self._clock()
         with self._lock:
             if not force and now - self._last_refresh < self.refresh_seconds:
@@ -117,6 +137,7 @@ class MeshRouter:
         with self._lock:
             if set(members) == set(self._members) and all(
                 members[k].capacity == self._members[k].capacity
+                and members[k].state == self._members[k].state
                 for k in members
             ):
                 self._members = members  # refreshed addresses/leases
@@ -124,7 +145,19 @@ class MeshRouter:
             old = set(self._members)
             self._members = members
             self._ring = HashRing(
-                {m.worker_id: m.capacity for m in members.values()},
+                {
+                    m.worker_id: m.capacity
+                    for m in members.values()
+                    if m.state in CLAIM_STATES
+                },
+                replicas=self.replicas,
+            )
+            self._target_ring = HashRing(
+                {
+                    m.worker_id: m.capacity
+                    for m in members.values()
+                    if m.state in TARGET_STATES
+                },
                 replicas=self.replicas,
             )
         if not first:
@@ -183,12 +216,54 @@ class MeshRouter:
             series_route_key(key, self.route_label), self.self_id
         )
 
+    def retains_series(self, key: str) -> bool:
+        """Whether this member's ring shard should KEEP a resident
+        series: owned under the claim ring (serving it now) OR under
+        the target ring (about to own it — a just-transferred series
+        must survive the eviction pass that runs while the planned
+        change is still in flight). With no change in flight the rings
+        agree and this is exactly `owns_series`."""
+        rk = series_route_key(key, self.route_label)
+        with self._lock:
+            claim, target = self._ring, self._target_ring
+        if len(claim) == 0 and len(target) == 0:
+            return True
+        return (len(claim) == 0 or claim.owns(rk, self.self_id)) or (
+            len(target) > 0 and target.owns(rk, self.self_id)
+        )
+
+    def target_owner_of_route(self, route_key: str) -> str | None:
+        """The TARGET-ring owner of a route key (an app, or a whole
+        canonical series key for label-less series)."""
+        with self._lock:
+            return self._target_ring.owner(route_key)
+
+    def transfer_target(self, route_key: str) -> str | None:
+        """Where a planned change moves this route key: the target-ring
+        owner, IFF this member owns the key on the claim ring right now
+        and the target ring hands it to someone else. None = the key is
+        not this member's to move (or is not moving)."""
+        with self._lock:
+            claim, target = self._ring, self._target_ring
+        if len(claim) == 0 or len(target) == 0:
+            return None
+        if not claim.owns(route_key, self.self_id):
+            return None
+        owner = target.owner(route_key)
+        return None if owner in (None, self.self_id) else owner
+
     def redirect_hint(self, key: str) -> str | None:
         """The owning member's advertised ingest address for a series
         this worker does NOT own (None when owned, owner unknown, or
-        the owner advertises no receiver). Counts receiver traffic."""
+        the owner advertises no receiver). Ownership here is the
+        TARGET ring: during a planned join/drain the pushers should
+        converge onto the post-change owner while the transfer is
+        still in flight, so the new owner's ring is fresh the moment
+        it starts claiming. Counts receiver traffic."""
         with self._lock:
-            ring = self._ring
+            ring = self._target_ring
+            if len(ring) == 0:
+                ring = self._ring  # degenerate: every member draining
         if len(ring) == 0:
             return None
         owner = ring.owner(series_route_key(key, self.route_label))
@@ -235,11 +310,25 @@ class RoutingPusher:
     at the front of the next cycle, up to `buffer_bytes` — beyond it
     the OLDEST buffered series drop, counted on
     ``counters["dropped_series"]``, because an unbounded buffer against
-    a receiver that never comes back is just a slower OOM. Learned
-    routes for the failed batch are forgotten either way, so the next
-    cycle falls back to a seed address and re-converges on the healed
-    ring.
+    a receiver that never comes back is just a slower OOM.
+
+    Learned routes survive ONE failed cycle per address (ISSUE 11
+    satellite): a single transient failure at a freshly-hinted receiver
+    — exactly what a just-joined member under a pusher thundering herd
+    looks like — must not throw the hint away and bounce the series
+    back through a seed. Only `FORGET_AFTER_FAILURES` consecutive
+    failed cycles on the same address mark it dead: routes still
+    pointing at it are forgotten (address-scoped — a route re-learned
+    onto another member meanwhile is never clobbered) and, when the
+    dead address was the current fallback seed, the fallback ROTATES to
+    the next seed — after a planned scale-down the departed member's
+    address may BE a seed, and pinning the fallback to ``addresses[0]``
+    forever would blackhole re-convergence.
     """
+
+    # consecutive failed cycles on one address before its routes are
+    # forgotten and the fallback seed rotates past it
+    FORGET_AFTER_FAILURES = 2
 
     def __init__(
         self,
@@ -268,6 +357,11 @@ class RoutingPusher:
 
         self._rng = rng or random.Random()
         self._route: dict[str, str] = {}  # series key -> "host:port"
+        # routeless series fall back to addresses[_seed_idx % n]; the
+        # index rotates past seeds observed dead (see class docstring)
+        self._seed_idx = 0
+        # address -> consecutive failed cycles (reset on any success)
+        self._addr_fails: dict[str, int] = {}
         # (approx bytes, key, entry) pending re-send, oldest first
         self._buffer: list[tuple[int, str, dict]] = []
         self._buffer_nbytes = 0
@@ -362,10 +456,11 @@ class RoutingPusher:
         are (key, times, values, start|None); returns {"accepted",
         "redirects", "errors", "buffered", "dropped", "by_address"}."""
         by_addr: dict[str, list[tuple[str, dict]]] = {}
+        fallback = self.addresses[self._seed_idx % len(self.addresses)]
         backlog, self._buffer, self._buffer_nbytes = self._buffer, [], 0
         self.counters["resent_series"] += len(backlog)
         for _, key, entry in backlog:
-            addr = self._route.get(key, self.addresses[0])
+            addr = self._route.get(key, fallback)
             by_addr.setdefault(addr, []).append((key, entry))
         for key, ts, vs, start in series:
             entry = {
@@ -375,7 +470,7 @@ class RoutingPusher:
             }
             if start is not None:
                 entry["start"] = float(start)
-            addr = self._route.get(key, self.addresses[0])
+            addr = self._route.get(key, fallback)
             by_addr.setdefault(addr, []).append((key, entry))
         accepted = 0
         redirected = 0
@@ -394,10 +489,26 @@ class RoutingPusher:
                 continue
             if body is None:
                 errors += 1
-                for key, _ in keyed:
-                    self._route.pop(key, None)
+                strikes = self._addr_fails.get(addr, 0) + 1
+                if len(self._addr_fails) > 256:
+                    self._addr_fails.clear()  # crude bound; repopulates
+                self._addr_fails[addr] = strikes
+                if strikes >= self.FORGET_AFTER_FAILURES:
+                    # persistently dead (not a one-cycle restart and
+                    # not a just-joined member shedding one burst):
+                    # forget routes STILL pointing at it — a route a
+                    # new member's hint re-learned meanwhile must not
+                    # be clobbered on its way out the door
+                    for key, _ in keyed:
+                        if self._route.get(key) == addr:
+                            self._route.pop(key, None)
+                    if addr == fallback:
+                        # a dead fallback seed (a drained member) must
+                        # not absorb re-convergence traffic forever
+                        self._seed_idx += 1
                 self._buffer_failed(keyed)
                 continue
+            self._addr_fails.pop(addr, None)
             accepted += int(body.get("accepted_samples", 0))
             for key, owner_addr in (body.get("redirects") or {}).items():
                 self._route[key] = owner_addr

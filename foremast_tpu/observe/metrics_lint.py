@@ -56,10 +56,13 @@ ALLOWED_LABELS: dict[str, frozenset[str]] = {
     "foremast_ingest_bytes_resident": frozenset(),
     "foremast_ingest_receiver_lag_seconds": frozenset(),
     # worker mesh (foremast_tpu/mesh/node.py MeshCollector)
-    "foremast_mesh_members": frozenset(),
+    "foremast_mesh_members": frozenset({"state"}),
     "foremast_mesh_rebalances": frozenset(),
     "foremast_mesh_redirect_hints": frozenset(),
     "foremast_mesh_claim_docs": frozenset({"result"}),
+    # planned handoff (ISSUE 11, foremast_tpu/mesh/node.py MeshCollector)
+    "foremast_handoff_state": frozenset({"kind", "direction"}),
+    "foremast_handoff_transfers": frozenset({"role", "result"}),
     # chaos plane + degradation (foremast_tpu/chaos/collector.py)
     "foremast_chaos_injections": frozenset({"edge", "kind"}),
     "foremast_breaker_state": frozenset({"edge"}),
@@ -148,10 +151,19 @@ FAMILY_DOCS: dict[str, str] = {
         "now minus the newest sample timestamp of the latest push"
     ),
     "foremast_mesh_members": (
-        "live mesh members (fresh leases, including this worker)"
+        "live mesh members (fresh leases, including this worker), by "
+        "lifecycle state (active/draining/joining)"
     ),
     "foremast_mesh_rebalances": (
         "hash-ring swaps after membership changes"
+    ),
+    "foremast_handoff_state": (
+        "ring series and fit entries moved by planned handoff, by "
+        "payload kind and direction"
+    ),
+    "foremast_handoff_transfers": (
+        "planned-handoff transfer outcomes by role (send/receive); "
+        "failed/torn/rejected degrade to cold refits, never a wedge"
     ),
     "foremast_mesh_redirect_hints": (
         "receiver responses pointing a pusher at a series' owner"

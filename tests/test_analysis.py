@@ -1414,3 +1414,42 @@ def test_runner_cli_subprocess_gate():
         timeout=300,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_lock_order_resolves_pep604_optional_annotations():
+    """ISSUE 11: an optional typed dependency (`x: "X | None" = None`,
+    the idiom for optionally-mounted planes like the handoff manager)
+    still types the attribute it is assigned to — the nesting edge
+    through it must land in the static graph, not only in the runtime
+    witness."""
+    from foremast_tpu.analysis.lock_order import build_graph
+
+    g = build_graph(
+        _program(
+            {
+                "foremast_tpu/fix/opt.py": """
+                    import threading
+
+                    class Plane:
+                        def __init__(self):
+                            self._lock = threading.Lock()
+
+                        def probe(self):
+                            with self._lock:
+                                return True
+
+                    class Seat:
+                        def __init__(self, plane: "Plane | None" = None):
+                            self._lock = threading.Lock()
+                            self.plane = plane
+
+                        def work(self):
+                            with self._lock:
+                                if self.plane is not None:
+                                    self.plane.probe()
+                """,
+            }
+        )
+    )
+    edges = {(e["from"], e["to"]) for e in g["edges"]}
+    assert ("Seat._lock", "Plane._lock") in edges

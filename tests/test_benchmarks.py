@@ -267,6 +267,42 @@ def test_chaos_bench_small_smoke(capsys):
     assert by_phase["crash"]["parked_at_wedge"] > 0
 
 
+def test_elastic_bench_small_smoke(capsys):
+    """`make bench-elastic --small` smoke (ISSUE 11): 2 -> 4 -> 2
+    workers under continuous load with every acceptance assert in-run
+    (the bench FAILS on a lost/duplicated verdict, an UNKNOWN
+    regression, a handoff past 2 ticks, a cold refit or fallback fetch
+    on a planned move, or a blackholed transfer that wedges instead of
+    degrading to cold refit). The summary line echoes the bars; `make
+    ci` runs this via test-fast."""
+    import benchmarks.elastic_bench as elastic_bench
+
+    elastic_bench.main(["--small"])
+    lines = [
+        json.loads(line)
+        for line in capsys.readouterr().out.strip().splitlines()
+    ]
+    summary = lines[-1]
+    assert summary["config"] == "c-elastic"
+    assert summary["phases"] == [
+        "load", "scale_up", "scale_down", "fault",
+    ]
+    assert summary["no_lost_or_duplicated_verdicts"] is True
+    assert summary["no_unknown_regression"] is True
+    assert summary["planned_moves_zero_cold_refits"] is True
+    assert summary["planned_moves_zero_fallback_fetches"] is True
+    assert summary["handoff_within_2_ticks"] is True
+    assert summary["fault_degraded_to_cold_refit"] is True
+    assert summary["lock_witness_clean"] is True
+    by_phase = {ln["phase"]: ln for ln in lines}
+    assert by_phase["scale_up"]["moved_series"] > 0
+    assert by_phase["scale_up"]["moved_fits"] > 0
+    assert by_phase["scale_up"]["joiner_docs"] > 0
+    assert by_phase["scale_down"]["survivor_cold_refits"] == 0
+    assert by_phase["fault"]["failed_sends"] >= 1
+    assert by_phase["fault"]["w5_cold_refits"] > 0
+
+
 def test_plane_bench_small_smoke():
     """Watch-plane scale benchmark (VERDICT r5 #7) at CI shapes: the
     informer resync and the controller poll tick must run and stay

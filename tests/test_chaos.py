@@ -709,3 +709,140 @@ def test_write_behind_claim_time_stamping_closes_takeover_window():
     buf.add(["doc"], now=claim_at)  # stamped at claim, not at failure
     t[0] = 11.0  # 11s after the CLAIM: takeover owns the doc now
     assert buf.drain() == []  # dropped, never replayed
+
+
+# ---------------------------------------------------------------------------
+# the peer→peer `transfer` edge (ISSUE 11): planned handoff under chaos
+# ---------------------------------------------------------------------------
+
+
+def test_blackholed_transfer_degrades_to_cold_refit_not_deadlock():
+    """A blackholed/faulted transfer edge must abandon the handoff
+    (counted) and let the fenced joiner activate at its deadline — the
+    moved partition cold-refits through the PR-6 rebalance path. The
+    one forbidden outcome is a wedge: a sender tick stuck behind the
+    transfer, or a joiner parked forever."""
+    from foremast_tpu.mesh import HandoffManager
+    from foremast_tpu.mesh.membership import MemberRecord
+
+    plan = FaultPlan(
+        rules=({"edge": "transfer", "error_rate": 1.0, "kind": "timeout"},),
+        seed=77,
+    ).activate(now=0.0)
+    degrade = Degradation(chaos_plan=plan)
+    t = [1000.0]
+    slept = []
+    h = HandoffManager(
+        deadline_seconds=30.0, retries=1, backoff_seconds=0.1,
+        chaos=plan.edge("transfer"),
+        breaker=degrade.breakers.get("transfer"),
+        clock=lambda: t[0], sleep=slept.append,
+    )
+
+    class _OneFit:
+        def persistable_snapshot(self):
+            return {("ma", 0, "appA|m0|http://x"): {"mu": 1.0}}
+
+    class _Router:
+        def transfer_target(self, route_key):
+            return "w-j"
+
+    h.register_caches({"fits": _OneFit()})
+    ok = h.send_to(
+        MemberRecord(worker_id="w-j", ingest_address="127.0.0.1:1"),
+        _Router(), "w-s",
+    )
+    assert ok is False  # abandoned, not wedged
+    c = h.counters_snapshot()
+    assert c["send"]["failed"] == 1 and c["send"]["ok"] == 0
+    assert plan.injections_snapshot().get(("transfer", "timeout"), 0) >= 1
+    assert slept  # jittered backoff between the injected faults
+    # the joiner side: fenced on this sender, activates at the deadline
+    h2 = HandoffManager(deadline_seconds=30.0, clock=lambda: t[0])
+    h2.begin_join({"w-s"})
+    assert h2.join_ready({"w-s"}) is False
+    t[0] = 1031.0
+    assert h2.join_ready({"w-s"}) is True
+    # ChaosCollector carries the new edge with no registration needed
+    from prometheus_client import CollectorRegistry
+
+    from foremast_tpu.observe.metrics_lint import lint_registry
+
+    reg = CollectorRegistry()
+    reg.register(ChaosCollector(degrade))
+    assert lint_registry(reg) == []
+    assert reg.get_sample_value(
+        "foremast_chaos_injections_total",
+        {"edge": "transfer", "kind": "timeout"},
+    ) >= 1.0
+
+
+def test_transfer_breaker_fails_fast_once_open():
+    """Repeated transfer failures open the per-edge breaker: later
+    sends short-circuit instead of burning the full timeout × retries
+    on every joiner — and a later successful probe re-closes it."""
+    from foremast_tpu.mesh import HandoffManager
+    from foremast_tpu.mesh.membership import MemberRecord
+
+    br = CircuitBreaker("transfer", failure_threshold=2, open_seconds=60.0)
+    h = HandoffManager(
+        deadline_seconds=5.0, retries=0, backoff_seconds=0.0,
+        breaker=br, sleep=lambda s: None,
+    )
+
+    class _OneFit:
+        def persistable_snapshot(self):
+            return {("ma", 0, "appA|m0|http://x"): {"mu": 1.0}}
+
+    class _Router:
+        def transfer_target(self, route_key):
+            return "w-j"
+
+    h.register_caches({"fits": _OneFit()})
+    rec = MemberRecord(worker_id="w-j", ingest_address="127.0.0.1:1")
+    calls = [0]
+
+    def refused(address, body):
+        br.allow()
+        calls[0] += 1
+        try:
+            raise ConnectionRefusedError("no receiver")
+        except Exception:
+            br.record_failure()
+            raise
+
+    h._post = refused
+    assert h.send_to(rec, _Router(), "w-s") is False
+    assert h.send_to(rec, _Router(), "w-s") is False
+    assert br.state == "open"
+    before = calls[0]
+    # breaker open: the next send never reaches the wire
+    assert h.send_to(rec, _Router(), "w-s") is False
+    assert calls[0] == before
+    assert h.counters_snapshot()["send"]["failed"] == 3
+
+
+def test_transient_classification_unwraps_urlerror():
+    """urllib wraps socket-level transport failures (connection
+    refused/reset, DNS, timeouts) in URLError — a real unreachable
+    handoff peer must classify TRANSIENT (retry, then degrade) rather
+    than crash the sender's tick loop; HTTPError keeps its status
+    semantics and a non-socket URLError stays a permanent error."""
+    import socket
+    import urllib.error
+
+    from foremast_tpu.chaos.degrade import is_transient_error
+
+    assert is_transient_error(
+        urllib.error.URLError(ConnectionRefusedError(111, "refused"))
+    )
+    assert is_transient_error(
+        urllib.error.URLError(socket.gaierror(-2, "unknown name"))
+    )
+    assert is_transient_error(
+        urllib.error.HTTPError("http://x", 503, "unavailable", {}, None)
+    )
+    assert not is_transient_error(
+        urllib.error.HTTPError("http://x", 400, "bad request", {}, None)
+    )
+    assert not is_transient_error(urllib.error.URLError("not an OSError"))

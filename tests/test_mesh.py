@@ -23,6 +23,7 @@ import json
 import urllib.request
 
 import numpy as np
+import pytest
 
 from foremast_tpu.jobs.models import Document
 from foremast_tpu.jobs.store import InMemoryStore
@@ -37,6 +38,29 @@ from foremast_tpu.mesh import (
     live_members,
     series_route_key,
 )
+
+
+
+@pytest.fixture(scope="module", autouse=True)
+def lock_witness():
+    """ISSUE 11: the runtime lock witness rides this module — the
+    handoff suite exercises the transfer plane's lock nesting (handoff
+    manager lock under receiver handler threads racing the tick-side
+    sender) and at teardown every OBSERVED acquisition edge must exist
+    in the committed static lock graph (`make lockgraph` on a miss)."""
+    from foremast_tpu.analysis import witness
+
+    wit = witness.install()
+    yield wit
+    graph = witness.load_graph()
+    witness.uninstall()
+    assert graph is not None, "analysis_lockgraph.json missing from repo root"
+    missing = wit.unobserved_edges(graph)
+    assert not missing, (
+        "runtime lock-acquisition edges missing from the static graph "
+        f"(run `make lockgraph` and review): {missing}"
+    )
+
 
 # ---------------------------------------------------------------------------
 # partition: the hash ring
@@ -685,3 +709,956 @@ def test_observe_server_auto_increments_busy_port():
             srv.shutdown()
     finally:
         blocker.close()
+
+
+# ---------------------------------------------------------------------------
+# planned elasticity (ISSUE 11): lifecycle states, two rings, handoff
+# ---------------------------------------------------------------------------
+
+
+def test_member_state_roundtrip_and_forward_compat():
+    """`state` rides the member record; a record from a build that
+    predates states (or carries a state this build does not know) reads
+    as `active` — old readers keep claiming/routing to new members,
+    degrading planned handoff to cold refit, never to wrong ownership."""
+    from foremast_tpu.mesh import STATE_DRAINING
+    from foremast_tpu.mesh.membership import MemberRecord
+
+    rec = MemberRecord(
+        worker_id="w-d", renewed_at=5.0, state=STATE_DRAINING
+    )
+    back = MemberRecord.from_payload(rec.to_payload())
+    assert back.state == STATE_DRAINING
+    # pre-states payload (no "state" field at all)
+    legacy = json.loads(rec.to_payload())
+    del legacy["state"]
+    assert MemberRecord.from_payload(json.dumps(legacy)).state == "active"
+    # a NEWER build's unknown state
+    future = json.loads(rec.to_payload())
+    future["state"] = "hibernating"
+    assert MemberRecord.from_payload(json.dumps(future)).state == "active"
+
+
+def _mesh_trio_with_states(store, states):
+    """Three members with the given lifecycle states, all views fresh."""
+    from foremast_tpu.mesh import MeshRouter, Membership
+
+    t = [0.0]
+    nodes = {}
+    for wid, state in states.items():
+        mem = Membership(
+            store, wid, lease_seconds=30.0, clock=_clock(t), state=state
+        )
+        mem.join()
+        nodes[wid] = MeshRouter(mem, refresh_seconds=0.0, clock=_clock(t))
+    for router in nodes.values():
+        router.refresh(force=True)
+    return nodes, t
+
+
+def test_two_rings_fence_joiner_and_retire_drainer():
+    """The CLAIM ring (active+draining) answers 'who judges NOW'; the
+    TARGET ring (active+joining) answers 'who owns after the change'.
+    A joiner is fenced from claims but receives hints/moves; a drainer
+    keeps judging but hints/moves point past it."""
+    from foremast_tpu.mesh import STATE_ACTIVE, STATE_DRAINING, STATE_JOINING
+
+    store = InMemoryStore()
+    routers, _ = _mesh_trio_with_states(
+        store,
+        {"w-a": STATE_ACTIVE, "w-j": STATE_JOINING, "w-d": STATE_DRAINING},
+    )
+    ra = routers["w-a"]
+    docs = [Document(id=f"j{i}", app_name=f"app{i}") for i in range(400)]
+    claim_owners = {d.id: ra._ring.owner(doc_route_key(d)) for d in docs}
+    target_owners = {
+        d.id: ra._target_ring.owner(doc_route_key(d)) for d in docs
+    }
+    # the joiner judges NOTHING yet; the drainer judges to the end
+    assert "w-j" not in claim_owners.values()
+    assert "w-d" in claim_owners.values()
+    # the post-change world has no drainer and a claiming joiner
+    assert "w-d" not in target_owners.values()
+    assert "w-j" in target_owners.values()
+    # transfer_target: only keys this member holds NOW that the change
+    # moves elsewhere, and never to itself
+    moved = {
+        d.app_name: ra.transfer_target(doc_route_key(d))
+        for d in docs
+        if ra.transfer_target(doc_route_key(d)) is not None
+    }
+    assert set(moved.values()) <= {"w-j"}  # w-a only hands to the joiner
+    for app in moved:
+        assert claim_owners[f"j{app[3:]}"] == "w-a"
+
+
+def test_redirect_hint_routes_to_target_ring_owner():
+    """During a planned change pushers are hinted at the POST-change
+    owner, so the new member's ring is warm the moment it claims."""
+    from foremast_tpu.mesh import STATE_ACTIVE, STATE_JOINING
+
+    store = InMemoryStore()
+    routers, _ = _mesh_trio_with_states(
+        store, {"w-a": STATE_ACTIVE, "w-j": STATE_JOINING}
+    )
+    # give the joiner an advertised receiver
+    ra = routers["w-a"]
+    rj = routers["w-j"]
+    rj.membership.ingest_address = "127.0.0.1:7777"
+    rj.membership.renew(force=True)
+    ra.refresh(force=True)
+    hinted = 0
+    for i in range(200):
+        key = f'm{{app="app{i}"}}'
+        hint = ra.redirect_hint(key)
+        if hint is not None:
+            assert hint == "127.0.0.1:7777"
+            # the claim ring says w-a still owns it (joiner fenced)
+            assert ra._target_ring.owner(f"app{i}") == "w-j"
+            hinted += 1
+    assert hinted > 0  # the joiner's share of the keyspace gets hints
+
+
+def _framed(*recs):
+    import io
+    import pickle
+
+    from foremast_tpu.ingest.snapshot import append_record
+
+    buf = io.BytesIO()
+    for r in recs:
+        append_record(buf, pickle.dumps(r, protocol=pickle.HIGHEST_PROTOCOL))
+    return buf.getvalue()
+
+
+def _handoff_worker(store, wid, t, state="active", deadline=20.0):
+    """One full elastic seat: ring + fit cache + handoff + receiver +
+    MeshNode, everything on the injected clock `t`."""
+    from foremast_tpu.ingest import RingStore, start_ingest_server
+    from foremast_tpu.mesh import HandoffManager, MeshRouter, Membership
+    from foremast_tpu.models.cache import ModelCache
+
+    ring = RingStore(budget_bytes=1 << 20, shards=2)
+    handoff = HandoffManager(
+        ring_store=ring, deadline_seconds=deadline, clock=_clock(t),
+        sleep=lambda s: None,
+    )
+    fits = ModelCache(256)
+    handoff.register_caches({"fits": fits})
+    mem = Membership(
+        store, wid, lease_seconds=60.0, clock=_clock(t), state=state
+    )
+    router = MeshRouter(mem, refresh_seconds=0.0, clock=_clock(t))
+    srv, _ = start_ingest_server(0, ring, host="127.0.0.1", handoff=handoff)
+    mem.ingest_address = "127.0.0.1:%d" % srv.server_address[1]
+    node = MeshNode(mem, router, ring_store=ring, handoff=handoff,
+                    clock=_clock(t))
+    node.fits = fits
+    node.srv = srv
+    node.ring = ring
+    return node
+
+
+def _seed_state(node, apps, t0=6000):
+    """Resident series + a fit per app on `node`'s seat."""
+    for app in apps:
+        ts = np.arange(t0, t0 + 60 * 32, 60, np.int64)
+        node.ring.push(
+            f'm{{app="{app}"}}', ts, np.ones(32, np.float32),
+            start=float(t0 - 600), record_lag=False,
+        )
+        node.fits.put(("ma", 0, f"{app}|m0|http://x"), {"app": app})
+
+
+def test_join_fenced_handoff_moves_state():
+    """Scale-up end to end over the real receiver endpoint: the joiner
+    registers fenced, the active owner streams it the moving ring
+    series + fits, the joiner activates with the state RESIDENT — the
+    planned move costs zero cold refits by construction."""
+    from foremast_tpu.mesh import STATE_ACTIVE, STATE_JOINING
+
+    store = InMemoryStore()
+    t = [100.0]
+    w1 = _handoff_worker(store, "w1", t)
+    try:
+        w1.start()
+        w1.on_tick()
+        assert w1.state == STATE_ACTIVE
+        apps = [f"app{i}" for i in range(24)]
+        _seed_state(w1, apps)
+
+        w2 = _handoff_worker(store, "w2", t)
+        try:
+            w2.start()
+            assert w2.state == STATE_JOINING
+            # fenced: w2 claims nothing while joining
+            assert not any(
+                w2.claim_filter(Document(id=f"j{a}", app_name=a))
+                for a in apps
+            )
+            w1.on_tick()  # w1 notices the joiner and streams (async)
+            assert w1.wait_handoff_streams(10)
+            w2.on_tick()  # w2 sees w1's done marker and activates
+            assert w2.state == STATE_ACTIVE
+            sent = w1.handoff.counters_snapshot()
+            got = w2.handoff.counters_snapshot()
+            assert sent["send"]["ok"] == 1 and sent["send"]["failed"] == 0
+            assert got["receive"]["ok"] >= 1
+            # every app the new ring hands to w2 arrived with its state
+            w1.router.refresh(force=True)
+            moved = [a for a in apps if w2.router.owns_series(f'm{{app="{a}"}}')]
+            assert moved, "the joiner owns nothing (grow the app count)"
+            assert sent["series_sent"] == len(moved)
+            assert sent["fits_sent"] == len(moved)
+            for a in moved:
+                key = f'm{{app="{a}"}}'
+                assert w2.ring.query(key, 6000, 6000 + 60 * 31,
+                                     now=t[0] + 6000 + 60 * 32)[0] == "hit"
+                assert w2.fits.peek(("ma", 0, f"{a}|m0|http://x")) is not None
+            # and w1 kept what it still owns
+            kept = [a for a in apps if a not in moved]
+            for a in kept[:5]:
+                assert w1.fits.peek(("ma", 0, f"{a}|m0|http://x")) is not None
+        finally:
+            w2.srv.shutdown()
+    finally:
+        w1.srv.shutdown()
+
+
+def test_drain_streams_state_then_leaves():
+    """Planned scale-down: drain() publishes `draining`, streams every
+    owned series + fit to the post-drain owners, then leaves — the
+    survivors inherit a partition whose state is already resident."""
+    from foremast_tpu.mesh import STATE_ACTIVE
+
+    store = InMemoryStore()
+    t = [100.0]
+    w1 = _handoff_worker(store, "w1", t)
+    w2 = _handoff_worker(store, "w2", t)
+    try:
+        w1.start()
+        w2.start()  # fences behind w1; the (empty) handoff completes it
+        w1.on_tick()
+        assert w1.wait_handoff_streams(10)
+        w2.on_tick()
+        w1.router.refresh(force=True)
+        assert w1.state == STATE_ACTIVE and w2.state == STATE_ACTIVE
+        apps = [f"app{i}" for i in range(24)]
+        w2_apps = [a for a in apps if w2.router.owns_series(f'm{{app="{a}"}}')]
+        assert w2_apps, "w2 owns nothing (grow the app count)"
+        _seed_state(w2, w2_apps)
+
+        out = w2.drain()
+        assert out["targets"] == {"w1": "ok"}
+        # w2 is gone; w1's next refresh heals and it owns everything
+        assert w1.router.refresh(force=True) is True
+        for a in w2_apps:
+            key = f'm{{app="{a}"}}'
+            assert w1.router.owns_series(key)
+            assert w1.ring.query(key, 6000, 6000 + 60 * 31,
+                                 now=t[0] + 6000 + 60 * 32)[0] == "hit"
+            assert w1.fits.peek(("ma", 0, f"{a}|m0|http://x")) is not None
+        recv = w1.handoff.counters_snapshot()
+        assert recv["series_received"] == len(w2_apps)
+        assert recv["fits_received"] == len(w2_apps)
+    finally:
+        w1.srv.shutdown()
+        w2.srv.shutdown()
+
+
+def test_drain_enumerates_state_once_for_all_targets():
+    """A drain with N survivors takes ONE pass over the resident ring
+    (consistent per-shard copies are not free on the shutdown path) and
+    buckets records by target — not one full enumeration per target."""
+    from foremast_tpu.mesh import STATE_ACTIVE
+
+    store = InMemoryStore()
+    t = [100.0]
+    workers = {w: _handoff_worker(store, w, t) for w in ("w1", "w2", "w3")}
+    try:
+        for w in workers.values():
+            w.start()
+        for _ in range(3):
+            for w in workers.values():
+                w.on_tick()
+                assert w.wait_handoff_streams(10)
+        assert all(w.state == STATE_ACTIVE for w in workers.values())
+        w3 = workers["w3"]
+        apps = [f"app{i}" for i in range(32)]
+        w3_apps = [a for a in apps if w3.router.owns_series(f'm{{app="{a}"}}')]
+        assert w3_apps, "w3 owns nothing (grow the app count)"
+        _seed_state(w3, w3_apps)
+
+        calls = [0]
+        orig = w3.ring.shard_state
+
+        def counting_shard_state(i):
+            calls[0] += 1
+            return orig(i)
+
+        w3.ring.shard_state = counting_shard_state
+        out = w3.drain()
+        assert set(out["targets"]) == {"w1", "w2"}
+        assert all(r == "ok" for r in out["targets"].values())
+        assert calls[0] == w3.ring.shard_count  # one pass, not per-target
+        # and the bucketing still lands every series on its new owner
+        for w in ("w1", "w2"):
+            workers[w].router.refresh(force=True)
+        for a in w3_apps:
+            key = f'm{{app="{a}"}}'
+            owner = next(
+                workers[w]
+                for w in ("w1", "w2")
+                if workers[w].router.owns_series(key)
+            )
+            assert owner.ring.query(key, 6000, 6000 + 60 * 31,
+                                    now=t[0] + 6000 + 60 * 32)[0] == "hit"
+    finally:
+        for w in workers.values():
+            w.srv.shutdown()
+
+
+def test_stream_drain_keeps_the_seat_until_drain_leaves():
+    """The cli streams the drain on a side thread while the loop keeps
+    ticking: `stream_drain()` publishes `draining` and moves the state
+    but the member KEEPS its claim-ring seat (it judges its partition
+    to the end); the later `drain()` only leaves — it must not stream
+    a second time."""
+    from foremast_tpu.mesh import STATE_ACTIVE, STATE_DRAINING
+
+    store = InMemoryStore()
+    t = [100.0]
+    w1 = _handoff_worker(store, "w1", t)
+    w2 = _handoff_worker(store, "w2", t)
+    try:
+        w1.start()
+        w2.start()
+        w1.on_tick()
+        assert w1.wait_handoff_streams(10)
+        w2.on_tick()
+        w1.router.refresh(force=True)
+        assert w1.state == STATE_ACTIVE and w2.state == STATE_ACTIVE
+        apps = [f"app{i}" for i in range(24)]
+        w2_apps = [a for a in apps if w2.router.owns_series(f'm{{app="{a}"}}')]
+        assert w2_apps, "w2 owns nothing (grow the app count)"
+        _seed_state(w2, w2_apps)
+
+        out = w2.stream_drain()
+        assert out["targets"] == {"w1": "ok"}
+        # state moved, but the drainer still holds its claim-ring seat:
+        # peers see it (draining) and it still claims its partition
+        assert w2.state == STATE_DRAINING
+        w1.router.refresh(force=True)
+        peers = {m.worker_id: m.state for m in w1.router.members()}
+        assert peers.get("w2") == STATE_DRAINING
+        assert all(
+            w2.claim_filter(Document(id=f"j{a}", app_name=a))
+            for a in w2_apps
+        )
+        recv_after_stream = w1.handoff.counters_snapshot()
+
+        out2 = w2.drain()  # the finally-block half: leave, no re-stream
+        assert out2 == out
+        assert w1.router.refresh(force=True) is True
+        assert [m.worker_id for m in w1.router.members()] == ["w1"]
+        recv_after_drain = w1.handoff.counters_snapshot()
+        assert recv_after_drain == recv_after_stream
+        assert w2.handoff.counters_snapshot()["send"]["ok"] == 1
+    finally:
+        w1.srv.shutdown()
+        w2.srv.shutdown()
+
+
+def test_drain_streams_joiner_slice_too():
+    """Scale-down overlapping scale-up: the target ring may hand part
+    of the draining member's partition straight to a still-fenced
+    joiner, and a draining member's tick no longer serves joiners —
+    the drain stream itself must target the joiner, or that slice
+    silently drops to a cold refit."""
+    from foremast_tpu.mesh import STATE_ACTIVE, STATE_JOINING
+
+    store = InMemoryStore()
+    t = [100.0]
+    w1 = _handoff_worker(store, "w1", t)
+    w2 = _handoff_worker(store, "w2", t)
+    w3 = None
+    try:
+        w1.start()
+        w2.start()
+        w1.on_tick()
+        assert w1.wait_handoff_streams(10)
+        w2.on_tick()
+        assert w2.state == STATE_ACTIVE
+        apps = [f"app{i}" for i in range(32)]
+        w2_apps = [a for a in apps if w2.router.owns_series(f'm{{app="{a}"}}')]
+        assert w2_apps, "w2 owns nothing (grow the app count)"
+        _seed_state(w2, w2_apps)
+
+        # w3 registers fenced at the same moment w2 drains
+        w3 = _handoff_worker(store, "w3", t)
+        w3.start()
+        assert w3.state == STATE_JOINING
+        w2.router.refresh(force=True)
+        out = w2.drain()
+        assert set(out["targets"]) == {"w1", "w3"}
+        assert all(r == "ok" for r in out["targets"].values())
+        # every one of w2's series is resident on its target-ring owner
+        for w in (w1, w3):
+            w.router.refresh(force=True)
+        to_w3 = [
+            a
+            for a in w2_apps
+            if w3.router.target_owner_of_route(a) == "w3"
+        ]
+        assert to_w3, "no slice moved w2 -> w3 (grow the app count)"
+        for a in to_w3:
+            key = f'm{{app="{a}"}}'
+            assert w3.ring.query(key, 6000, 6000 + 60 * 31,
+                                 now=t[0] + 6000 + 60 * 32)[0] == "hit"
+        # the drainer's done marker counts toward w3's fence, and w3
+        # activates owning its slice WARM once w1's stream lands too
+        w1.on_tick()
+        assert w1.wait_handoff_streams(10)
+        w3.on_tick()
+        assert w3.state == STATE_ACTIVE
+    finally:
+        w1.srv.shutdown()
+        w2.srv.shutdown()
+        if w3 is not None:
+            w3.srv.shutdown()
+
+
+def test_autoscale_cooldown_absorbs_transient_streaks():
+    """Observations inside the cooldown window must not bank toward
+    the next verdict: a scale-up's own rebalance transient breaches
+    occupancy all through the cooldown, and a streak built from it
+    would fire the moment the window expires — a verdict re-earns
+    breach_ticks FRESH breaches after cooldown."""
+    from foremast_tpu.mesh import AutoscaleConfig, AutoscaleDriver
+
+    t = [0.0]
+    d = AutoscaleDriver(
+        AutoscaleConfig(breach_ticks=3, cooldown_seconds=60.0),
+        clock=lambda: t[0],
+    )
+    assert d.observe(0.95, members=2) == "hold"
+    assert d.observe(0.95, members=2) == "hold"
+    assert d.observe(0.95, members=2) == "scale_up"
+    # the handoff transient inflates occupancy for the whole cooldown
+    for _ in range(10):
+        t[0] += 5.0
+        assert d.observe(0.95, members=3) == "hold"
+    t[0] = 61.0  # cooldown expired
+    assert d.observe(0.95, members=3) == "hold"  # streak 1, not 11
+    assert d.observe(0.95, members=3) == "hold"
+    assert d.observe(0.95, members=3) == "scale_up"  # genuinely sustained
+
+
+def test_handoff_rejected_send_counted_once():
+    """A hard-4xx transfer (version-mismatched receiver) is ONE
+    outcome: `send{rejected}` — the abandon path must not also count
+    it `send{failed}`, or dashboards summing outcomes see two
+    transfers where one happened."""
+    import urllib.error
+
+    from foremast_tpu.mesh import HandoffManager
+    from foremast_tpu.mesh.membership import MemberRecord
+
+    h = HandoffManager(sleep=lambda s: None)
+
+    def rejecting_post(address, body):
+        raise urllib.error.HTTPError(
+            f"http://{address}", 400, "version mismatch", {}, None
+        )
+
+    h._post = rejecting_post
+    ok = h.send_to(
+        MemberRecord(worker_id="w-j", ingest_address="old:1"), None, "w-s"
+    )
+    assert ok is False
+    c = h.counters_snapshot()
+    assert c["send"]["rejected"] == 1
+    assert c["send"]["failed"] == 0 and c["send"]["ok"] == 0
+
+
+def test_restart_retaking_live_seat_does_not_fence():
+    """A SIGKILLed worker re-taking its persisted mesh seat (PR-7 warm
+    restart: lease still live, ring never moved) must come up ACTIVE —
+    fencing would evict it from the claim ring and hand its partition
+    to peers COLD, exactly the refit wall the warm restart avoids."""
+    from foremast_tpu.mesh import STATE_ACTIVE
+
+    store = InMemoryStore()
+    t = [100.0]
+    w1 = _handoff_worker(store, "w1", t)
+    w2 = _handoff_worker(store, "w2", t)
+    try:
+        w1.start()
+        w2.start()
+        # w2 "dies" (no leave — the lease stays live) and restarts with
+        # the same persisted identity
+        w2b = _handoff_worker(store, "w2", t)
+        try:
+            w2b.start()
+            assert w2b.state == STATE_ACTIVE  # no fence, no refit wall
+            assert not w2b.handoff.join_pending()
+        finally:
+            w2b.srv.shutdown()
+    finally:
+        w1.srv.shutdown()
+        w2.srv.shutdown()
+
+
+def test_bootstrap_solo_member_never_fences():
+    """The first member of a fresh fleet has nobody to hand off from —
+    it must come up claiming, not parked on a deadline."""
+    from foremast_tpu.mesh import STATE_ACTIVE
+
+    store = InMemoryStore()
+    t = [100.0]
+    w1 = _handoff_worker(store, "w1", t)
+    try:
+        w1.start()
+        assert w1.state == STATE_ACTIVE
+        assert not w1.handoff.join_pending()
+    finally:
+        w1.srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# handoff torn-state matrix (ISSUE 11 satellite): every damage shape
+# degrades per-record to cold refit, with counters — never a crash
+# ---------------------------------------------------------------------------
+
+
+def _receiver_manager():
+    from foremast_tpu.ingest import RingStore
+    from foremast_tpu.mesh import HandoffManager
+    from foremast_tpu.models.cache import ModelCache
+
+    ring = RingStore(budget_bytes=1 << 20, shards=1)
+    h = HandoffManager(ring_store=ring, deadline_seconds=10.0)
+    fits = ModelCache(64)
+    h.register_caches({"fits": fits})
+    return h, ring, fits
+
+
+def _series_rec(app, t0=6000, n=16):
+    ts = np.arange(t0, t0 + 60 * n, 60, np.int64)
+    return (
+        "series", f'm{{app="{app}"}}', ts, np.ones(n, np.float32),
+        [[float(t0 - 600), float(t0 + 60 * (n - 1))]],
+    )
+
+
+def _fit_rec(app):
+    return ("fit", "fits", ("ma", 0, f"{app}|m0|http://x"), {"app": app})
+
+
+def test_handoff_truncated_stream_keeps_healthy_prefix():
+    """A transfer torn mid-stream (sender died, connection cut) applies
+    everything before the tear — PR-7 per-record semantics — counts it
+    `torn`, and the rest cold-refits."""
+    from foremast_tpu.mesh.handoff import HANDOFF_VERSION
+
+    h, ring, fits = _receiver_manager()
+    body = _framed(
+        ("hello", HANDOFF_VERSION, "w-s"),
+        _series_rec("appA"),
+        _fit_rec("appA"),
+        _series_rec("appB"),
+        ("done", "w-s", 2, 1),
+    )
+    code, out = h.apply_transfer(body[:-10])  # tear inside the tail
+    assert code == 200
+    assert out["torn"] is True and out["done"] is False
+    assert out["applied_series"] >= 1
+    assert ring.query('m{app="appA"}', 6000, 6000 + 60 * 15,
+                      now=7000.0)[0] == "hit"
+    c = h.counters_snapshot()
+    assert c["receive"]["torn"] == 1
+    # the tear never marked the sender done: a fenced joiner would
+    # keep waiting (then deadline out), not trust half a transfer
+    assert "w-s" not in h.debug_state()["done_from"]
+
+
+def test_handoff_version_mismatch_rejected_whole_batch():
+    """A sender from a different build must not guess at our format:
+    the whole batch is rejected with the permanent 400 verdict and
+    NOTHING is applied."""
+    h, ring, fits = _receiver_manager()
+    code, out = h.apply_transfer(
+        _framed(("hello", 99, "w-s"), _series_rec("appA"))
+    )
+    assert code == 400
+    assert ring.stats()["series"] == 0
+    assert h.counters_snapshot()["receive"]["rejected"] == 1
+
+
+def test_handoff_garbage_and_empty_bodies_rejected():
+    h, ring, fits = _receiver_manager()
+    for raw in (b"", b"not-a-frame-at-all"):
+        code, _ = h.apply_transfer(raw)
+        assert code == 400
+    assert h.counters_snapshot()["receive"]["rejected"] == 2
+    assert ring.stats()["series"] == 0
+
+
+def test_handoff_undecodable_record_keeps_prefix():
+    """A frame whose crc passes but whose pickle is garbage (a sender
+    bug, not wire damage) degrades exactly like a tear: prefix kept."""
+    import io
+
+    from foremast_tpu.ingest.snapshot import append_record
+    from foremast_tpu.mesh.handoff import HANDOFF_VERSION
+
+    buf = io.BytesIO()
+    import pickle
+
+    for rec in (("hello", HANDOFF_VERSION, "w-s"), _series_rec("appA")):
+        append_record(
+            buf, pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+        )
+    append_record(buf, b"\x80\x04 this is not a pickle")
+    h, ring, fits = _receiver_manager()
+    code, out = h.apply_transfer(buf.getvalue())
+    assert code == 200 and out["torn"] is True
+    assert out["applied_series"] == 1
+
+
+def test_handoff_duplicate_delivery_replays_clean():
+    """Every record kind is idempotent (ring pushes merge last-write-
+    wins, fit puts overwrite equal state, done markers are a set): a
+    retried/duplicated batch changes nothing and is COUNTED."""
+    from foremast_tpu.mesh.handoff import HANDOFF_VERSION
+
+    h, ring, fits = _receiver_manager()
+    body = _framed(
+        ("hello", HANDOFF_VERSION, "w-s"),
+        _series_rec("appA"),
+        _fit_rec("appA"),
+        ("done", "w-s", 1, 1),
+    )
+    code1, out1 = h.apply_transfer(body)
+    stats1 = ring.stats()
+    code2, out2 = h.apply_transfer(body)
+    assert code1 == code2 == 200
+    assert out2["done"] is True
+    assert ring.stats()["series"] == stats1["series"] == 1
+    assert ring.query('m{app="appA"}', 6000, 6000 + 60 * 15,
+                      now=7000.0)[0] == "hit"
+    c = h.counters_snapshot()
+    assert c["receive"]["ok"] == 1 and c["receive"]["duplicate"] == 1
+    assert h.debug_state()["done_from"] == ["w-s"]
+
+
+def test_handoff_mid_transfer_receiver_death_degrades_sender():
+    """The receiver dying mid-transfer (some batches landed, then
+    connection refused) is a FAILED send: counted, abandoned after
+    retries — the receiver cold-refits what never arrived, and the
+    sender's tick is never wedged."""
+    from foremast_tpu.ingest import RingStore
+    from foremast_tpu.mesh import HandoffManager, STATE_JOINING
+    from foremast_tpu.mesh.membership import MemberRecord
+    from foremast_tpu.models.cache import ModelCache
+
+    store = InMemoryStore()
+    routers, _ = _mesh_trio_with_states(
+        store, {"w-s": "active", "w-j": STATE_JOINING}
+    )
+    ring = RingStore(budget_bytes=1 << 20, shards=1)
+    fits = ModelCache(64)
+    # tiny batch size: every record is its own POST
+    h = HandoffManager(
+        ring_store=ring, batch_bytes=64, retries=1,
+        sleep=lambda s: None,
+    )
+    h.register_caches({"fits": fits})
+    for i in range(8):
+        ts = np.arange(6000, 6000 + 60 * 16, 60, np.int64)
+        ring.push(f'm{{app="app{i}"}}', ts, np.ones(16, np.float32),
+                  record_lag=False)
+    receiver, _, _2 = _receiver_manager()
+    calls = [0]
+
+    def dying_post(address, body):
+        calls[0] += 1
+        if calls[0] > 2:
+            raise ConnectionRefusedError("receiver died mid-transfer")
+        receiver.apply_transfer(body)
+
+    h._post = dying_post
+    ok = h.send_to(
+        MemberRecord(worker_id="w-j", ingest_address="dead:1"),
+        routers["w-s"], "w-s",
+    )
+    assert ok is False
+    c = h.counters_snapshot()
+    assert c["send"]["failed"] == 1 and c["send"]["ok"] == 0
+    # the prefix LANDED on the receiver (per-record durability) and a
+    # duplicate replay of those records would still be clean
+    assert receiver.counters_snapshot()["series_received"] >= 1
+    # no done marker: the joiner's deadline owns the degradation
+    assert receiver.debug_state()["done_from"] == []
+
+
+def test_join_deadline_degrades_to_cold_refit_not_deadlock():
+    """A joiner whose senders never finish (blackholed / torn / dead
+    receiver) activates at the deadline — missing state cold-refits
+    through the normal rebalance path; the fence is never a wedge."""
+    from foremast_tpu.mesh import HandoffManager
+
+    t = [1000.0]
+    h = HandoffManager(deadline_seconds=30.0, clock=_clock(t))
+    h.begin_join({"w-a", "w-b"})
+    assert h.join_pending()
+    # w-a's done arrives, w-b's never does
+    code, _ = h.apply_transfer(
+        _framed(("hello", 1, "w-a"), ("done", "w-a", 0, 0))
+    )
+    assert code == 200
+    assert h.join_ready({"w-a", "w-b"}) is False
+    t[0] = 1029.0
+    assert h.join_ready({"w-a", "w-b"}) is False
+    t[0] = 1031.0  # deadline passed
+    assert h.join_ready({"w-a", "w-b"}) is True
+    assert not h.join_pending()
+
+
+def test_join_discounts_dead_senders():
+    """An expected sender that died or left mid-join is discounted —
+    waiting on a ghost would turn its crash into our deadlock."""
+    from foremast_tpu.mesh import HandoffManager
+
+    t = [1000.0]
+    h = HandoffManager(deadline_seconds=1e9, clock=_clock(t))
+    h.begin_join({"w-a", "w-b"})
+    h.apply_transfer(_framed(("hello", 1, "w-a"), ("done", "w-a", 0, 0)))
+    # w-b crashed: it is no longer live-active
+    assert h.join_ready({"w-a"}) is True
+
+
+def test_evict_unowned_never_races_a_transfer():
+    """Series applied by a transfer are protected from the rebalance
+    eviction pass until the claim ring catches up — TTL-bounded so an
+    abandoned change cannot pin foreign state forever."""
+    from foremast_tpu.ingest import RingStore
+    from foremast_tpu.mesh import HandoffManager
+
+    t = [1000.0]
+    ring = RingStore(budget_bytes=1 << 20, shards=1)
+    h = HandoffManager(
+        ring_store=ring, deadline_seconds=10.0, clock=_clock(t)
+    )
+    code, _ = h.apply_transfer(
+        _framed(("hello", 1, "w-s"), _series_rec("appX"))
+    )
+    assert code == 200
+    key = 'm{app="appX"}'
+    assert h.is_protected(key)
+    # an eviction pass that believes we own nothing must keep it
+    assert ring.evict_unowned(lambda k: h.is_protected(k)) == 0
+    assert ring.stats()["series"] == 1
+    # past the TTL (2x deadline) the protection lapses
+    t[0] = 1021.0
+    assert not h.is_protected(key)
+    assert ring.evict_unowned(lambda k: h.is_protected(k)) == 1
+
+
+# ---------------------------------------------------------------------------
+# RoutingPusher elasticity (ISSUE 11 satellite): hints from NEW members
+# survive transient failures; dead seeds rotate
+# ---------------------------------------------------------------------------
+
+
+def test_routing_pusher_new_member_hint_survives_one_failure():
+    """One-cycle convergence after scale-up, pinned: a hint pointing at
+    a just-joined member must survive that member failing ONE cycle (a
+    thundering herd at a receiver still warming up looks exactly like
+    that) — the old forget-on-first-failure path bounced the series
+    back through a seed and re-converged from scratch every time."""
+    pusher = RoutingPusher(
+        ["127.0.0.1:1"], retries=0, backoff_seconds=0.0,
+        sleep=lambda s: None,
+    )
+    new_addr = "127.0.0.1:2"
+    pusher._route['m{app="a"}'] = new_addr  # the scale-up hint
+    flaky = [1]
+
+    def post(address, entries):
+        assert address == new_addr, f"bounced to {address}"
+        if flaky[0]:
+            flaky[0] -= 1
+            raise OSError("connection refused (receiver warming up)")
+        return {
+            "accepted_samples": sum(len(e["times"]) for e in entries),
+            "redirects": {},
+        }
+
+    pusher._post = lambda a, e: post(a, e)
+    out1 = pusher.push_cycle([('m{app="a"}', [60], [1.0], None)])
+    assert out1["errors"] == 1 and out1["buffered"] == 1
+    # the route is STILL the new member's — one failure is not death
+    assert pusher._route['m{app="a"}'] == new_addr
+    out2 = pusher.push_cycle([])
+    assert out2["accepted"] == 1 and out2["errors"] == 0
+
+
+def test_routing_pusher_forgets_dead_address_and_rotates_seed():
+    """FORGET_AFTER_FAILURES consecutive failed cycles mark an address
+    dead: its routes are forgotten (address-scoped) and a dead fallback
+    seed rotates — after a planned drain the departed member's address
+    may BE a seed, and pinning fallback to it would blackhole
+    re-convergence."""
+    dead, live = "127.0.0.1:1", "127.0.0.1:2"
+    pusher = RoutingPusher(
+        [dead, live], retries=0, backoff_seconds=0.0, sleep=lambda s: None,
+    )
+    relearned = "127.0.0.1:3"
+
+    def post(address, entries):
+        if address == dead:
+            raise OSError("connection refused (drained member)")
+        return {
+            "accepted_samples": sum(len(e["times"]) for e in entries),
+            "redirects": {},
+        }
+
+    pusher._post = lambda a, e: post(a, e)
+    # a stale learned route at the (drained) address, and a fresh hint
+    # onto another member
+    pusher._route['m{app="x"}'] = dead
+    pusher._route['m{app="a"}'] = relearned
+    out1 = pusher.push_cycle(
+        [('m{app="x"}', [60], [1.0], None),
+         ('m{app="a"}', [60], [1.0], None)]
+    )  # strike 1 on the dead address; the relearned batch lands
+    assert out1["errors"] == 1 and out1["accepted"] == 1
+    assert pusher._route['m{app="x"}'] == dead  # one failure ≠ death
+    out2 = pusher.push_cycle([])  # backlog → dead again: strike 2
+    assert out2["errors"] == 1
+    # dead for real now: its routes forgotten — but ONLY its own (the
+    # route re-learned onto another member is never clobbered)
+    assert 'm{app="x"}' not in pusher._route
+    assert pusher._route['m{app="a"}'] == relearned
+    # the fallback seed rotated past the dead address: routeless series
+    # (and the backlog) land on the LIVE seed
+    out3 = pusher.push_cycle([('m{app="b"}', [60], [2.0], None)])
+    assert out3["errors"] == 0 and out3["accepted"] == 2
+
+
+def test_mesh_collector_states_and_handoff_families_lint_clean():
+    """`foremast_mesh_members{state}` + the two handoff families pass
+    the metrics contract, with stable zeros when no handoff is wired."""
+    from prometheus_client import CollectorRegistry
+
+    from foremast_tpu.mesh import STATE_JOINING
+    from foremast_tpu.mesh.node import MeshCollector
+    from foremast_tpu.observe.metrics_lint import lint_registry
+
+    store = InMemoryStore()
+    t = [100.0]
+    w1 = _handoff_worker(store, "w1", t)
+    try:
+        w1.start()
+        w1.on_tick()
+        # a fenced joiner appears in the member gauge by state
+        w2 = _handoff_worker(store, "w2", t)
+        try:
+            w2.start()
+            w1.router.refresh(force=True)
+            reg = CollectorRegistry()
+            reg.register(MeshCollector(w1))
+            assert lint_registry(reg) == []
+            assert reg.get_sample_value(
+                "foremast_mesh_members", {"state": "active"}
+            ) == 1.0
+            assert reg.get_sample_value(
+                "foremast_mesh_members", {"state": STATE_JOINING}
+            ) == 1.0
+            assert reg.get_sample_value(
+                "foremast_mesh_members", {"state": "draining"}
+            ) == 0.0
+            # handoff families exist with zero'd label sets pre-transfer
+            assert reg.get_sample_value(
+                "foremast_handoff_state_total",
+                {"kind": "series", "direction": "sent"},
+            ) == 0.0
+            assert reg.get_sample_value(
+                "foremast_handoff_transfers_total",
+                {"role": "send", "result": "failed"},
+            ) == 0.0
+        finally:
+            w2.srv.shutdown()
+    finally:
+        w1.srv.shutdown()
+
+
+def test_transfer_endpoint_404_without_handoff_plane():
+    """A receiver with no handoff manager answers the transfer path
+    with 404 — a pre-elasticity worker is a hard (permanent) verdict
+    for a sender, not a retry loop."""
+    from foremast_tpu.ingest import RingStore, start_ingest_server
+    from foremast_tpu.ingest.receiver import TRANSFER_PATH
+
+    ring = RingStore(shards=1)
+    srv, _ = start_ingest_server(0, ring, host="127.0.0.1")
+    try:
+        port = srv.server_address[1]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{TRANSFER_PATH}", data=b"x",
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=5)
+            raise AssertionError("transfer accepted with no handoff plane")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            e.close()
+    finally:
+        srv.shutdown()
+
+
+def test_mesh_debug_state_carries_lifecycle_and_handoff():
+    store = InMemoryStore()
+    t = [100.0]
+    w1 = _handoff_worker(store, "w1", t)
+    try:
+        w1.start()
+        w1.on_tick()
+        state = w1.debug_state()
+        assert state["state"] == "active"
+        assert state["members"][0]["state"] == "active"
+        assert state["handoff"]["join_pending"] is False
+        assert state["handoff"]["deadline_seconds"] == 20.0
+    finally:
+        w1.srv.shutdown()
+
+
+def test_simultaneous_joiners_restream_on_target_change():
+    """A second joiner appearing mid-join reshapes the first one's
+    target-ring share — already-served joiners are RE-queued for a
+    fresh (idempotent) stream, so the reshaped delta moves instead of
+    cold-refitting."""
+    from foremast_tpu.mesh import HandoffManager, STATE_ACTIVE, STATE_JOINING
+    from foremast_tpu.mesh.membership import MemberRecord
+
+    h = HandoffManager(deadline_seconds=10.0)
+
+    def rec(wid, state):
+        return MemberRecord(
+            worker_id=wid, state=state, ingest_address=f"{wid}:1"
+        )
+
+    view1 = [rec("w1", STATE_ACTIVE), rec("w3", STATE_JOINING)]
+    h.note_members(view1)
+    assert [m.worker_id for m in h.pending_joiners(view1, "w1")] == ["w3"]
+    h.mark_served("w3")
+    h.note_members(view1)  # unchanged view: stays served
+    assert h.pending_joiners(view1, "w1") == []
+    # w4 appears while w3 is STILL joining: w3's share moved — re-serve
+    view2 = view1 + [rec("w4", STATE_JOINING)]
+    h.note_members(view2)
+    assert [m.worker_id for m in h.pending_joiners(view2, "w1")] == [
+        "w3", "w4",
+    ]

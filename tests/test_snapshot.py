@@ -622,3 +622,30 @@ def test_restore_into_smaller_ring_clamps_older_spans(tmp_path):
     # the live span still serves resident
     state = s2.query("m", float(base - 64 * 60), None, now=NOW + 30)[0]
     assert state == "hit", state
+
+
+def test_read_record_stream_is_the_shared_frame_decoder():
+    """ISSUE 11: the crc-framed record decoder is ONE definition shared
+    by append-log replay and the mesh handoff transfer path — intact
+    records stream, the first bad frame ends the stream with a single
+    (None, "torn_log"), and nothing after it is trusted."""
+    import io
+
+    from foremast_tpu.ingest.snapshot import append_record, read_record_stream
+
+    buf = io.BytesIO()
+    for payload in (b"alpha", b"beta", b"gamma"):
+        append_record(buf, payload)
+    # clean stream
+    out = list(read_record_stream(io.BytesIO(buf.getvalue())))
+    assert out == [(b"alpha", None), (b"beta", None), (b"gamma", None)]
+    # torn tail: the healthy prefix survives, the tear is reported once
+    torn = list(read_record_stream(io.BytesIO(buf.getvalue()[:-3])))
+    assert torn[:2] == [(b"alpha", None), (b"beta", None)]
+    assert torn[-1] == (None, "torn_log")
+    # mid-stream corruption desyncs everything after it: only the
+    # prefix is served
+    raw = bytearray(buf.getvalue())
+    raw[len(raw) // 2] ^= 0xFF
+    got = list(read_record_stream(io.BytesIO(bytes(raw))))
+    assert (None, "torn_log") in got and len(got) <= 3
