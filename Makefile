@@ -67,6 +67,14 @@ bench-restart:
 bench-chaos:
 	$(PY) -m benchmarks.chaos_bench
 
+# reactive plane (ISSUE 12): event-driven detection latency — deploy
+# PATCH -> first verdict through the fake kube server's real watch
+# stream (<= 1 s bar), anomaly POST -> completed_unhealth through the
+# real ingest receiver at the 16k fleet (p99 <= 2 s bar, pinned in
+# BENCHMARKS.md), micro-vs-full tick-path status parity asserted in-run
+bench-latency:
+	$(PY) -m benchmarks.latency_bench
+
 # elastic mesh (ISSUE 11): 2 -> 4 -> 2 workers under continuous load
 # with in-run asserts: zero lost/duplicated verdicts, planned handoff
 # inside 2 ticks with ZERO cold refits + ZERO fallback fetches, and a

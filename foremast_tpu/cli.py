@@ -243,7 +243,7 @@ def _enable_compile_cache() -> None:
 
 def _mount_ingest(
     inner, gauge_port: int, router=None, snapshot_dir=None,
-    chaos=None, degrade=None, handoff=None,
+    chaos=None, degrade=None, handoff=None, dirty=None,
 ):
     """FOREMAST_INGEST=1: wrap the pull source in the push-plane
     RingSource (docs/operations.md "Ingest plane") — warm fetches become
@@ -305,6 +305,7 @@ def _mount_ingest(
             chaos=chaos,
             degrade_stats=degrade.stats if degrade is not None else None,
             handoff=handoff,
+            dirty=dirty,
         )
     if gauge_port:
         from prometheus_client import REGISTRY
@@ -526,6 +527,16 @@ def cmd_worker(args: argparse.Namespace) -> int:
     mesh_on = os.environ.get("FOREMAST_MESH", "0") == "1"
     mesh_node = None
     ingest_srv = None
+    # reactive plane (opt-in, ISSUE 12): FOREMAST_MICROTICK_SECONDS > 0
+    # turns pushed-sample arrivals into micro-ticks — the receiver
+    # marks each push's route key dirty and the worker judges just
+    # those documents between full sweeps (docs/operations.md
+    # "Event-driven detection"). Needs the ingest receiver: arrivals
+    # are what the receiver sees.
+    from foremast_tpu.reactive import microtick_seconds_from_env
+
+    micro_seconds = microtick_seconds_from_env()
+    dirty = None
     # durable data plane (opt-in): ring snapshots + append logs, fit
     # journals, and the persistent mesh identity all under one directory
     # (docs/operations.md "Restarts and upgrades")
@@ -538,6 +549,24 @@ def cmd_worker(args: argparse.Namespace) -> int:
             file=sys.stderr,
         )
         mesh_on = False
+    if micro_seconds > 0 and pod_mode:
+        # pod ticks are SPMD-broadcast collectives; a leader-local
+        # micro-tick would desync followers — wiring micro-ticks
+        # through the broadcast is future work
+        print(
+            "FOREMAST_MICROTICK_SECONDS ignored in pod mode "
+            "(micro-ticks are single-worker; pod ticks are broadcast "
+            "collectives)",
+            file=sys.stderr,
+        )
+        micro_seconds = 0.0
+    if micro_seconds > 0 and not ingest_on:
+        print(
+            "FOREMAST_MICROTICK_SECONDS needs FOREMAST_INGEST=1 (the "
+            "receiver is what marks arrivals); staying tick-paced",
+            file=sys.stderr,
+        )
+        micro_seconds = 0.0
     if snap_dir and pod_mode:
         # pod mode's determinism contract (identical caches on every
         # process, leader-only I/O) already has its own durability path
@@ -659,6 +688,30 @@ def cmd_worker(args: argparse.Namespace) -> int:
                 chaos=_edge("transfer"),
                 breaker=degrade.breakers.get("transfer"),
             )
+        if micro_seconds > 0:
+            # dirty routing respects partition ownership: with a mesh
+            # router wired, pushes for series another member owns are
+            # counted foreign and never marked (that member's own
+            # receiver marks them)
+            from foremast_tpu.reactive import DirtySet
+
+            dirty = DirtySet.from_env(
+                route_label=(
+                    router.route_label if router is not None else "app"
+                ),
+                owns=(
+                    router.owns_series if router is not None else None
+                ),
+            )
+            from foremast_tpu.reactive.dirty import microtick_docs_from_env
+
+            logging.getLogger("foremast_tpu.cli").info(
+                "reactive plane ON: micro-ticks every %.3f s, %d dirty "
+                "keys/tick, dirty-set cap %d "
+                "(docs/operations.md \"Event-driven detection\")",
+                micro_seconds, microtick_docs_from_env(),
+                dirty.max_keys,
+            )
         single_source = PrometheusSource(
             chaos=_edge("prometheus"),
             breaker=degrade.breakers.get("prometheus"),
@@ -670,7 +723,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
                     single_source, args.gauge_port, router=router,
                     snapshot_dir=snap_dir,
                     chaos=_edge("receiver"), degrade=degrade,
-                    handoff=handoff,
+                    handoff=handoff, dirty=dirty,
                 )
             )
         if mesh_on:
@@ -705,6 +758,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
             tracer=tracer,
             mesh=mesh_node,
             degrade=degrade,
+            dirty=dirty,
         )
         if snap_dir:
             # fit journals restore lazily (the first claim of each doc
@@ -751,6 +805,11 @@ def cmd_worker(args: argparse.Namespace) -> int:
         from prometheus_client import REGISTRY as _REG3
 
         _REG3.register(ChaosCollector(degrade))
+        if dirty is not None:
+            from foremast_tpu.reactive import ReactiveCollector
+            from prometheus_client import REGISTRY as _REG4
+
+            _REG4.register(ReactiveCollector(dirty))
 
     after_tick = None
     if ckpt_path:

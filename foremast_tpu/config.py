@@ -623,6 +623,51 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "fits at their admitted history",
     ),
     EnvKnob(
+        "FOREMAST_MICROTICK_SECONDS",
+        "0",
+        "float",
+        "reactive plane pacing (docs/operations.md \"Event-driven "
+        "detection\"): > 0 turns the worker's idle wait between full "
+        "ticks into the micro-tick drain window — every this-many "
+        "seconds the worker claims and judges JUST the documents whose "
+        "series the receiver marked dirty since the last drain, so a "
+        "pushed anomaly meets its verdict in ~this + judge time "
+        "instead of waiting out the poll; full ticks demote to sweeps "
+        "on the poll cadence. `0` (default) = tick-paced detection "
+        "(the pre-ISSUE-12 behavior). Requires FOREMAST_INGEST=1 "
+        "(the receiver is what marks arrivals)",
+    ),
+    EnvKnob(
+        "FOREMAST_MICROTICK_DOCS",
+        "256",
+        "int",
+        "dirty route keys drained per micro-tick: bounds one "
+        "micro-tick's claim scope (the claim itself stays bounded by "
+        "--claim-limit); keys past the budget wait for the next "
+        "micro-tick or sweep",
+    ),
+    EnvKnob(
+        "FOREMAST_MICROTICK_DIRTY_MAX",
+        "8192",
+        "int",
+        "dirty-set capacity (route keys): past it the OLDEST pending "
+        "arrival drops, counted on "
+        "foremast_microtick_dirty_events{event=\"dropped\"} — the full "
+        "sweep still judges those documents on its own cadence, so "
+        "overflow degrades latency attribution, never correctness "
+        "(bounded by construction, never a leak)",
+    ),
+    EnvKnob(
+        "FOREMAST_WATCH_STREAM",
+        "0",
+        "bool",
+        "`1` switches the watch plane's deployment informer to the "
+        "streaming `watch=true` long-poll (resourceVersion resume, "
+        "410-Gone re-list, stall detection): deployment events "
+        "dispatch on ARRIVAL and the 30 s resync demotes to a repair "
+        "sweep. `0` keeps the list+diff poll informer",
+    ),
+    EnvKnob(
         "FOREMAST_SNAPSHOT_DIR",
         None,
         "path",

@@ -32,6 +32,17 @@ abandon them to their daemon fate instead of wedging shutdown.
 metric families (docs/observability.md) via a custom collector —
 counters and gauges are materialized from `RingStore.stats()` at scrape
 time, so the hot push/fetch paths never touch prometheus_client.
+
+Reactive plane (ISSUE 12): with a `dirty` set wired
+(`reactive.DirtySet`), every accepted push marks its series' route key
+dirty so the worker's next micro-tick judges the affected documents.
+**Arrival-clock contract:** the dirty stamp is THIS process's wall
+clock taken at handler entry, BEFORE the body is read or parsed — the
+RECEIVER's arrival instant — never the pusher's sample timestamps.
+The push→verdict latency SLO (`foremast_verdict_latency_seconds`)
+therefore measures time spent inside this system and is immune to
+client clock skew: a pusher replaying old samples, or one with a fast
+clock, moves its sample stamps but not the SLO.
 """
 
 from __future__ import annotations
@@ -131,6 +142,7 @@ def start_ingest_server(
     chaos=None,
     degrade_stats=None,
     handoff=None,
+    dirty=None,
 ):
     """Serve the push plane; returns (server, thread). Port 0 binds an
     ephemeral port (tests) — read it back from server.server_address.
@@ -159,7 +171,15 @@ def start_ingest_server(
     scale events stream ring series + fit entries here (404 when no
     handoff plane is wired). The body cap and the inflight shed apply
     to transfers exactly as to pushes: senders chunk batches under the
-    cap and treat 429 as transient."""
+    cap and treat 429 as transient.
+
+    `dirty` (reactive.DirtySet, ISSUE 12): every entry a push APPLIES
+    samples for marks its route key dirty, stamped with the receiver's
+    arrival clock (see the module docstring's clock contract) — the
+    micro-tick trigger. Re-pushes mark too: a last-write-wins revision
+    of an existing timestamp is exactly the spike-correction case that
+    must re-judge. Only entries the ring wholly ignored (empty sample
+    arrays) mark nothing."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     if max_body_bytes is None:
@@ -205,6 +225,11 @@ def start_ingest_server(
                 self._post()
 
         def _post(self):
+            # the arrival instant, BEFORE the body is read or parsed:
+            # a near-cap batch on a slow link can spend seconds in
+            # read+parse, and that is in-system time the push→verdict
+            # SLO must charge for, not silently exclude
+            arrived_at = time.time()
             path = self.path.split("?", 1)[0]
             if path not in (WRITE_PATH, TRANSFER_PATH):
                 self._send(404, b'{"reason": "not found"}')
@@ -275,12 +300,19 @@ def start_ingest_server(
                 return
             accepted = 0
             redirects: dict[str, str] = {}
+            # ONE arrival instant for the whole batch, taken at handler
+            # entry (pre-read, pre-parse): the SLO clock starts when
+            # the samples reached us, not when each ring shard finished
+            # applying
             for key, ts, vs, start in entries:
                 if router is not None:
                     hint = router.redirect_hint(key)
                     if hint is not None:
                         redirects[key] = hint
-                accepted += store.push(key, ts, vs, start=start)
+                n_new = store.push(key, ts, vs, start=start)
+                accepted += n_new
+                if dirty is not None and n_new:
+                    dirty.mark_series(key, now=arrived_at)
             body = {"accepted_samples": accepted, "series": len(entries)}
             if redirects:
                 body["redirects"] = redirects

@@ -51,6 +51,7 @@ from foremast_tpu.jobs.models import (
     Document,
 )
 from foremast_tpu.jobs.store import JobStore, parse_time
+from foremast_tpu.mesh.routing import doc_route_key
 from foremast_tpu.metrics.promql import decode_config
 from foremast_tpu.metrics.source import MetricSource
 from foremast_tpu.observe.logs import ctx_log
@@ -137,6 +138,7 @@ class BrainWorker:
         tracer=None,  # observe.spans.Tracer (optional)
         mesh=None,  # mesh.node.MeshNode (optional fleet partitioning)
         degrade: Degradation | None = None,
+        dirty=None,  # reactive.DirtySet (optional: micro-tick plane)
     ):
         """`band_mode` controls how much of the model band each verdict
         carries back from the device: "last" (default — only the final
@@ -360,6 +362,27 @@ class BrainWorker:
         self._tick_claim_mono = time.monotonic()
         # one WARNING per degradation episode, not per buffered write
         self._write_degraded = False
+        # Reactive plane (ISSUE 12): the receiver-fed dirty-series set.
+        # When wired, `micro_tick()` drains it between full ticks —
+        # claiming JUST the dirty documents through the same _tick body
+        # (columnar fast path for warm docs, slow pipeline for cold) —
+        # and full ticks demote to sweeps that drain whatever arrivals
+        # the micro-ticks missed. `_pending_arrivals` is the in-flight
+        # tick's route-key → receiver-arrival-stamp map; every judged
+        # doc whose route key is pending observes the push→verdict
+        # latency histogram (foremast_verdict_latency_seconds).
+        self.dirty = dirty
+        from foremast_tpu.reactive.dirty import (
+            microtick_docs_from_env,
+            microtick_seconds_from_env,
+        )
+
+        self.microtick_seconds = microtick_seconds_from_env()
+        self.microtick_docs = microtick_docs_from_env()
+        self._pending_arrivals: dict[str, float] = {}
+        self._observed_keys: set[str] = set()
+        self._tick_path = "sweep"
+        self._last_micro = {"at": 0.0, "docs": 0, "seconds": 0.0, "runs": 0}
 
     # -- preprocess: document -> MetricTasks ----------------------------
 
@@ -1162,6 +1185,17 @@ class BrainWorker:
             doc.status = STATUS_PREPROCESS_COMPLETED
         self._store_update_many(docs)
         self._degrade.stats.count_docs(reason, len(docs))
+        # reactive: a released doc's pending arrival goes BACK to the
+        # dirty set with its ORIGINAL stamp — a brownout mid-micro-tick
+        # must not lose the arrival, and the eventual verdict must
+        # still measure from the push's receive instant (the latency
+        # the operator actually suffered)
+        if self._pending_arrivals and self.dirty is not None:
+            for doc in docs:
+                rk = doc_route_key(doc)
+                stamp = self._pending_arrivals.pop(rk, None)
+                if stamp is not None:
+                    self.dirty.mark(rk, stamp, requeue=True)
         log.warning(
             "released %d doc(s) un-judged (%s); they stay claimable "
             "for the next tick", len(docs), reason,
@@ -1667,6 +1701,7 @@ class BrainWorker:
             "worker.write_back", stage="write_back", docs=len(updated_all)
         ):
             self._store_update_many(updated_all)
+        self._observe_verdicts(updated_all)
         return len(ok_items) + n_joint + len(failed) + len(released), slow
 
     def _judge_uni_fast(self, ok_items, now: float) -> list:
@@ -1828,6 +1863,110 @@ class BrainWorker:
         return updated
 
 
+    # -- reactive plane: micro-ticks + verdict latency (ISSUE 12) --------
+
+    def micro_tick(self, now: float | None = None) -> int:
+        """Drain up to `FOREMAST_MICROTICK_DOCS` dirty route keys
+        through ONE claim-fetch-judge-write cycle restricted to their
+        documents. The body is `_tick` itself — warm docs ride the
+        columnar fast path (the sub-second case this plane exists
+        for), cold docs take the slow pipeline, every degradation
+        contract (write-behind, transient release, breakers) applies
+        unchanged — so a micro-tick-judged doc's status is
+        byte-identical to the same doc judged by a full tick, by
+        construction and pinned by test. Housekeeping (refinement,
+        snapshots) stays with the sweeps. Returns #docs processed."""
+        dirty = self.dirty
+        if dirty is None:
+            return 0
+        entries = dirty.take(self.microtick_docs)
+        if not entries:
+            return 0
+        if self.tracer is None:
+            return self._tick(now, micro=entries)
+        with self.tracer.span("worker.microtick", worker=self.worker_id):
+            return self._tick(now, micro=entries)
+
+    def _begin_pending(self, micro) -> None:
+        """Set up this tick's arrival-attribution state: a micro-tick
+        owns exactly the entries it took; a full sweep drains the WHOLE
+        dirty set (the catch-all — arrivals the micro-ticks missed,
+        dropped keys' documents, non-push docs attribute nothing)."""
+        self._tick_path = "micro" if micro is not None else "sweep"
+        if micro is not None:
+            self._pending_arrivals = dict(micro)
+        elif self.dirty is not None:
+            self._pending_arrivals = dict(self.dirty.take_all())
+        else:
+            self._pending_arrivals = {}
+        self._observed_keys = set()
+
+    def _requeue_pending(self) -> None:
+        """Give every un-attributed arrival back to the dirty set with
+        its original stamp (claim brownout: nothing was claimed, the
+        docs stay claimable, the arrivals must survive)."""
+        if self._pending_arrivals and self.dirty is not None:
+            for rk, stamp in self._pending_arrivals.items():
+                self.dirty.mark(rk, stamp, requeue=True)
+        self._pending_arrivals = {}
+
+    def _finish_pending(self) -> None:
+        """Close out arrival attribution: pending keys no judged doc
+        matched (terminal docs, apps claimed by a peer, sweep claims
+        past the limit) are DROPPED and counted — never re-queued,
+        because a key with no claimable doc would cycle forever."""
+        pending = self._pending_arrivals
+        if pending:
+            missed = sum(
+                1 for k in pending if k not in self._observed_keys
+            )
+            if missed and self.dirty is not None:
+                self.dirty.count("unattributed", missed)
+        self._pending_arrivals = {}
+        self._observed_keys = set()
+
+    def _observe_verdicts(self, docs) -> None:
+        """Per-verdict latency: every just-written doc whose route key
+        carries a pending arrival observes (now - receiver arrival
+        stamp) on `foremast_verdict_latency_seconds{path}` — the
+        push→verdict SLO. Called at the write-back points of both tick
+        paths; a write-behind-buffered verdict observes too (the
+        verdict exists; its persistence is the buffer's contract)."""
+        pending = self._pending_arrivals
+        if not pending or not docs:
+            return
+        hist = (
+            getattr(self.metrics, "verdict_latency", None)
+            if self.metrics
+            else None
+        )
+        observed = self._observed_keys
+        path = self._tick_path
+        now = time.time()
+        for doc in docs:
+            rk = doc_route_key(doc)
+            stamp = pending.get(rk)
+            if stamp is None:
+                continue
+            observed.add(rk)
+            if hist is not None:
+                hist.labels(path=path).observe(max(0.0, now - stamp))
+
+    def _micro_claim_filter(self, base):
+        """The micro-tick's claim restriction: only documents whose
+        route key is in this tick's pending set, composed with the
+        mesh partition filter (dirty routing respects partition
+        ownership — a stale dirty key for a moved app can never steal
+        a foreign doc; claim-CAS stays the net beneath both)."""
+        keys = self._pending_arrivals
+
+        def claim_filter(doc) -> bool:
+            if base is not None and not base(doc):
+                return False
+            return doc_route_key(doc) in keys
+
+        return claim_filter
+
     # -- main cycle ------------------------------------------------------
 
     def tick(self, now: float | None = None) -> int:
@@ -1840,7 +1979,7 @@ class BrainWorker:
         with self.tracer.span("worker.tick", worker=self.worker_id):
             return self._tick(now)
 
-    def _tick(self, now: float | None = None) -> int:
+    def _tick(self, now: float | None = None, micro=None) -> int:
         t0 = time.perf_counter()
         self._tick_deadline = self._degrade.deadline(t0)
         now = time.time() if now is None else now
@@ -1848,6 +1987,9 @@ class BrainWorker:
         # healed, and re-check docs buffered as preprocess_completed
         # must become claimable before this tick's claim
         self._flush_write_behind()
+        # reactive (ISSUE 12): a micro-tick owns the dirty entries it
+        # took; a full sweep drains the rest as its catch-all
+        self._begin_pending(micro)
         claim_kw = {}
         if self.mesh is not None:
             # idle ticks renew too — the lease must outlive quiet
@@ -1855,6 +1997,10 @@ class BrainWorker:
             # injectable clocks, not this tick's possibly-simulated now)
             self.mesh.on_tick()
             claim_kw["claim_filter"] = self.mesh.claim_filter
+        if micro is not None:
+            claim_kw["claim_filter"] = self._micro_claim_filter(
+                claim_kw.get("claim_filter")
+            )
         self._tick_claim_mono = time.monotonic()
         with span("worker.claim", stage="claim", limit=self.claim_limit):
             try:
@@ -1867,6 +2013,9 @@ class BrainWorker:
             except Exception as e:
                 # a store outage must degrade to an idle tick, not kill
                 # the worker loop: nothing was claimed, nothing is owed
+                # — and the pending arrivals go back to the dirty set
+                # un-spent (the docs stay claimable; the push→verdict
+                # clock keeps running from the original stamps)
                 if not is_transient_error(e):
                     raise
                 self._degrade.stats.count_event("store", "claim_error")
@@ -1874,6 +2023,7 @@ class BrainWorker:
                     "claim degraded to empty tick (store transient "
                     "error: %s)", e,
                 )
+                self._requeue_pending()
                 docs = []
         if docs and self._deadline_exceeded():
             # the claim alone blew the tick budget (store brownout):
@@ -1886,6 +2036,11 @@ class BrainWorker:
             # and must be visible on the tick histogram; an idle WORKER
             # is not an idle RING (receiver threads keep pushing), so
             # snapshot cadence and provisional-fit refinement run here
+            # (sweeps only — micro-ticks stay lean)
+            self._finish_pending()
+            if micro is not None:
+                self._tick_done(0, 0, t0, micro=True)
+                return 0
             self._refine_provisional(now)
             self._maybe_persist()
             if self.metrics:
@@ -1902,16 +2057,19 @@ class BrainWorker:
                 # all-warm steady tick: the cheap moment to upgrade
                 # provisional fits — invalidations land their refits on
                 # the NEXT tick's slow path, in bounded batches
-                self._refine_provisional(now)
+                # (sweeps only; micro-ticks leave housekeeping alone)
+                if micro is None:
+                    self._refine_provisional(now)
                 if self.metrics:
                     if hasattr(self.metrics, "observe_arena"):
                         self.metrics.observe_arena(
                             self._uni.device_state_counters()
                         )
-                    self.metrics.tick_seconds.observe(
-                        time.perf_counter() - t0
-                    )
-                self._tick_done(n_fast, n_fast, t0)
+                    if micro is None:
+                        self.metrics.tick_seconds.observe(
+                            time.perf_counter() - t0
+                        )
+                self._tick_done(n_fast, n_fast, t0, micro=micro is not None)
                 return n_fast
 
         # Progressive admission (VERDICT r4 #7): the slow path — cold
@@ -1981,8 +2139,9 @@ class BrainWorker:
                 self.metrics, "observe_arena"
             ):
                 self.metrics.observe_arena(self._uni.device_state_counters())
-            self.metrics.tick_seconds.observe(time.perf_counter() - t0)
-        self._tick_done(n_fast + len(docs), n_fast, t0)
+            if micro is None:
+                self.metrics.tick_seconds.observe(time.perf_counter() - t0)
+        self._tick_done(n_fast + len(docs), n_fast, t0, micro=micro is not None)
         return n_fast + len(docs)
 
     # -- slow-path pipeline stages (jobs/pipeline.py) --------------------
@@ -2090,6 +2249,7 @@ class BrainWorker:
                         log.exception(
                             "on_verdict hook failed for %s", doc.id
                         )
+        self._observe_verdicts(ok_docs)
 
     def _log_judged(self, doc) -> None:
         """One correlatable line per service-created judgment: emitted
@@ -2125,12 +2285,41 @@ class BrainWorker:
                 job_trace_id=doc.trace_id,
             )
 
-    def _tick_done(self, n_docs: int, n_fast: int, t0: float) -> None:
+    def _tick_done(
+        self, n_docs: int, n_fast: int, t0: float, micro: bool = False
+    ) -> None:
         """Record the finished busy tick for /debug/state and emit one
         correlatable completion log (the tick's trace ID rides on the
-        JSON record when a tracer is wired)."""
-        self._maybe_persist()
+        JSON record when a tracer is wired). Micro-ticks keep their own
+        ledger + counter and skip durability housekeeping (snapshot
+        cadence and journal compaction belong to the sweeps — a
+        sub-second judgment path must never absorb a snapshot pass)."""
+        self._finish_pending()
         seconds = time.perf_counter() - t0
+        if micro:
+            self._last_micro = {
+                "at": time.time(),
+                "docs": n_docs,
+                "seconds": seconds,
+                "runs": self._last_micro.get("runs", 0) + 1,
+            }
+            m = (
+                getattr(self.metrics, "microtick_docs", None)
+                if self.metrics
+                else None
+            )
+            if m is not None and n_docs:
+                m.inc(n_docs)
+            if n_docs:
+                ctx_log(
+                    log,
+                    logging.DEBUG,
+                    "micro-tick complete",
+                    docs=n_docs,
+                    seconds=round(seconds, 4),
+                )
+            return
+        self._maybe_persist()
         self._last_tick = {
             "at": time.time(),
             "docs": n_docs,
@@ -2258,6 +2447,19 @@ class BrainWorker:
                 if self._fit_journals or self._snapshotter is not None
                 else None
             ),
+            # reactive plane (ISSUE 12): dirty-set occupancy/counters,
+            # micro-tick budget + pacing, and the latest micro-tick's
+            # ledger; None when the worker is pure tick-paced
+            "reactive": (
+                {
+                    "dirty": self.dirty.debug_state(),
+                    "microtick_seconds": self.microtick_seconds,
+                    "microtick_docs_budget": self.microtick_docs,
+                    "last_micro": dict(self._last_micro),
+                }
+                if self.dirty is not None
+                else None
+            ),
             # chaos plane + graceful degradation (ISSUE 9): write-behind
             # occupancy, tick budget, per-edge breaker states, released/
             # buffered/replayed doc counters, active chaos plan (tests/
@@ -2284,13 +2486,53 @@ class BrainWorker:
         """Poll forever (the shared-nothing worker loop, design.md:35-43).
 
         `after_tick(n_processed)` runs after every cycle — the hook for
-        periodic model-cache checkpointing and similar housekeeping."""
-        while not (stop and stop()):
-            n = self.tick()
+        periodic model-cache checkpointing and similar housekeeping.
+
+        Reactive mode (a `dirty` set wired AND
+        ``FOREMAST_MICROTICK_SECONDS`` > 0): the idle wait between full
+        ticks becomes the micro-tick drain window — every
+        `microtick_seconds` the worker claims and judges just the
+        dirty documents, so a pushed anomaly meets its verdict in
+        ~`microtick_seconds` + judge time instead of waiting out the
+        poll. Full ticks keep the poll cadence as SWEEPS; a saturated
+        claim (n == claim_limit — more work is surely waiting) still
+        re-sweeps immediately, exactly the pre-reactive busy loop."""
+        def hook(n: int) -> None:
             if after_tick:
                 try:
                     after_tick(n)
                 except Exception:
                     log.exception("after_tick hook failed")
-            if n == 0:
-                time.sleep(poll_seconds)
+
+        def micro_drain() -> None:
+            # one bounded micro drain, with the hook only when work
+            # happened (sweeps keep the run-every-cycle contract the
+            # idle-checkpoint logic relies on)
+            if len(self.dirty):
+                n_micro = self.micro_tick()
+                if n_micro:
+                    hook(n_micro)
+
+        reactive = self.dirty is not None and self.microtick_seconds > 0
+        while not (stop and stop()):
+            n = self.tick()
+            hook(n)
+            if not reactive:
+                if n == 0:
+                    time.sleep(poll_seconds)
+                continue
+            if n >= self.claim_limit:
+                # saturated sweep (backlog exceeds one claim): keep the
+                # pre-reactive busy loop's drain rate, but ALTERNATE one
+                # micro drain between sweeps — a pushed anomaly's
+                # latency stays bounded by one sweep, not by the whole
+                # backlog's drain time
+                micro_drain()
+                continue
+            deadline = time.monotonic() + poll_seconds
+            while not (stop and stop()):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                micro_drain()
+                time.sleep(min(self.microtick_seconds, max(remaining, 0.0)))

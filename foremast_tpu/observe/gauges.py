@@ -254,6 +254,11 @@ class WorkerMetrics:
             means the ring lost authority over historical ranges
         foremast_refine_docs_total{result} / foremast_provisional_fits
             — background refinement of short-history admissions
+        foremast_verdict_latency_seconds{path} — the reactive plane's
+            SLO: push receive-instant (receiver clock) → verdict
+            write, by judging path (micro / sweep)
+        foremast_microtick_docs_total — documents judged by
+            ingest-triggered micro-ticks
 
     The reference exposes only model outputs; the engine's own throughput
     is this framework's headline property, so it is first-class here.
@@ -347,6 +352,26 @@ class WorkerMetrics:
             "foremast_provisional_fits",
             "provisional (short-history) fits awaiting background "
             "refinement",
+            registry=reg,
+        )
+        # reactive plane (ISSUE 12): the push→verdict SLO histogram —
+        # receiver arrival stamp (the RECEIVER's clock, immune to
+        # pusher clock skew) to verdict write, labeled by the tick
+        # path that wrote it (micro = ingest-triggered micro-tick,
+        # sweep = full tick catch-all) — plus the micro-tick doc count
+        self.verdict_latency = Histogram(
+            "foremast_verdict_latency_seconds",
+            "push receive-instant to verdict write, by judging path "
+            "(micro = ingest-triggered micro-tick, sweep = full tick)",
+            ["path"],
+            buckets=(
+                0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 15.0, 60.0, 300.0,
+            ),
+            registry=reg,
+        )
+        self.microtick_docs = Counter(
+            "foremast_microtick_docs_total",
+            "documents judged by ingest-triggered micro-ticks",
             registry=reg,
         )
 

@@ -45,6 +45,15 @@ ALLOWED_LABELS: dict[str, frozenset[str]] = {
     "foremast_cold_hist_reads": frozenset({"source"}),
     "foremast_refine_docs": frozenset({"result"}),
     "foremast_provisional_fits": frozenset(),
+    # reactive plane (ISSUE 12): push→verdict SLO + micro-tick traffic
+    # (worker: observe/gauges.py; dirty set: reactive/dirty.py
+    # ReactiveCollector; watch stream: reactive/watchstream.py)
+    "foremast_verdict_latency_seconds": frozenset({"path"}),
+    "foremast_microtick_docs": frozenset(),
+    "foremast_microtick_dirty_events": frozenset({"event"}),
+    "foremast_microtick_dirty_pending": frozenset(),
+    "foremast_watch_stream_events": frozenset({"type"}),
+    "foremast_watch_stream_restarts": frozenset({"reason"}),
     "foremast_service_requests": frozenset({"route", "code"}),
     "foremast_controller_transitions": frozenset({"phase"}),
     "foremastbrain_gauge_families_dropped": frozenset(),
@@ -122,6 +131,26 @@ FAMILY_DOCS: dict[str, str] = {
     "foremast_provisional_fits": (
         "provisional (short-history) fits awaiting background "
         "refinement"
+    ),
+    "foremast_verdict_latency_seconds": (
+        "push receive-instant (receiver clock) to verdict write, by "
+        "judging path (micro/sweep) — the reactive plane's SLO"
+    ),
+    "foremast_microtick_docs": (
+        "documents judged by ingest-triggered micro-ticks"
+    ),
+    "foremast_microtick_dirty_events": (
+        "dirty-set traffic (marked/coalesced/dropped/foreign/"
+        "requeued/unattributed)"
+    ),
+    "foremast_microtick_dirty_pending": (
+        "route keys currently pending in the dirty set"
+    ),
+    "foremast_watch_stream_events": (
+        "deployment watch-stream events dispatched, by type"
+    ),
+    "foremast_watch_stream_restarts": (
+        "watch-stream reconnects (gone/stall/error/end)"
     ),
     "foremast_service_requests": (
         "gateway requests by route pattern and status code"
@@ -262,6 +291,9 @@ def default_registry_families():
     metrics.tick_seconds.observe(0.01)
     for kind in ("univariate", "bivariate", "lstm"):
         metrics.fast_docs.labels(kind=kind).inc()
+    for path in ("micro", "sweep"):
+        metrics.verdict_latency.labels(path=path).observe(0.1)
+    metrics.microtick_docs.inc()
     tracer = Tracer(service="lint", registry=registry, trace_dir=None)
     from foremast_tpu.observe.spans import TICK_STAGES
 
@@ -317,6 +349,25 @@ def default_registry_families():
     degrade.stats.count_docs("deadline_released")
     degrade.stats.count_event("receiver", "shed")
     registry.register(ChaosCollector(degrade))
+    # reactive plane: dirty-set traffic + the watch-stream families
+    from foremast_tpu.reactive import (
+        DirtySet,
+        ReactiveCollector,
+        WatchStreamMetrics,
+    )
+
+    dirty = DirtySet(max_keys=2)
+    dirty.mark_series('up{app="lint"}')
+    dirty.mark_series('up{app="lint"}')  # coalesced
+    dirty.mark("lint-requeue", 1.0, requeue=True)
+    dirty.mark("lint-extra")  # overflows max_keys=2: dropped
+    dirty.count("unattributed")
+    registry.register(ReactiveCollector(dirty))
+    ws = WatchStreamMetrics(registry=registry)
+    for etype in ("added", "modified", "deleted", "error"):
+        ws.events.labels(type=etype).inc()
+    for reason in ("gone", "stall", "error", "end"):
+        ws.restarts.labels(reason=reason).inc()
     return registry
 
 

@@ -1,0 +1,37 @@
+"""Reactive plane: event-driven detection — judge on arrival, not on tick.
+
+Two halves (ISSUE 12), both riding existing machinery:
+
+  * `dirty` — the ingest-triggered half: the receiver marks each
+    pushed series' route key in a bounded `DirtySet`, and the worker
+    drains it between full ticks through micro-ticks
+    (`BrainWorker.micro_tick`) that claim just the dirty documents;
+    full ticks demote to sweeps. The push→verdict latency histogram
+    (`foremast_verdict_latency_seconds`) is the plane's SLO metric.
+  * `watchstream` — the K8s half: `StreamingInformer` dispatches
+    deployment events on arrival from `HttpKube.watch_deployments`
+    (``watch=true`` long-poll, resourceVersion resume, 410-Gone
+    re-list), with the 30 s resync demoted to a repair sweep.
+
+See docs/operations.md "Event-driven detection".
+"""
+
+from foremast_tpu.reactive.dirty import (
+    DEFAULT_DIRTY_MAX,
+    DirtySet,
+    ReactiveCollector,
+    microtick_seconds_from_env,
+)
+from foremast_tpu.reactive.watchstream import (
+    StreamingInformer,
+    WatchStreamMetrics,
+)
+
+__all__ = [
+    "DEFAULT_DIRTY_MAX",
+    "DirtySet",
+    "ReactiveCollector",
+    "StreamingInformer",
+    "WatchStreamMetrics",
+    "microtick_seconds_from_env",
+]

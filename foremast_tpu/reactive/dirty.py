@@ -1,0 +1,247 @@
+"""The dirty-series set — the ingest half of the reactive plane.
+
+Detection in every plane built so far is tick-paced: a pushed anomaly
+sits resident in the ring until the next full sweep claims its
+document. The ingest plane already KNOWS the instant a sample arrives
+(the receiver's handler thread), so this module turns that arrival
+into work: the receiver marks the sample's route key dirty, and the
+worker drains the dirty set between full ticks through micro-ticks
+(`BrainWorker.micro_tick`) that claim JUST the dirty documents — full
+ticks demote to repair sweeps that catch whatever micro-ticks missed.
+
+`DirtySet` is a bounded, lock-guarded, insertion-ordered map of
+
+    route key (the mesh partition identity: an app name, or the whole
+    canonical series key for label-less series)  ->  arrival stamp
+
+with these contracts:
+
+  * **Arrival stamps are the RECEIVER's clock.** The stamp is taken
+    when the push handler marks the key (`clock()`, wall time on the
+    receiving worker), never from the pusher's sample timestamps —
+    the push→verdict latency SLO (`foremast_verdict_latency_seconds`)
+    must be immune to client clock skew. A pusher replaying yesterday's
+    samples measures the time WE took, not the age of its data.
+  * **Coalescing keeps the EARLIEST stamp.** Many pushes for one key
+    before a drain are one unit of pending work; the latency a verdict
+    finally observes is the oldest un-judged arrival's wait — the
+    honest worst case, counted on ``coalesced``.
+  * **Bounded, drop-oldest, never a leak.** Past ``max_keys`` the
+    oldest entry drops and is counted on ``dropped``; the full sweep
+    still judges those documents on its own cadence, so an overflow
+    degrades latency attribution, never correctness.
+  * **Ownership-filtered (mesh).** With an ``owns`` predicate wired
+    (`MeshRouter.owns_series` — the CLAIM ring, the same ring the
+    micro-tick's claim filter composes with), pushes for series
+    another member owns are counted on ``foreign`` and NOT marked:
+    the receiver accepts them losslessly (accept-and-hint), but this
+    worker will never be able to claim their documents.
+
+Thread-safety: receiver handler threads mark while the worker's tick
+thread takes/requeues; everything behind one leaf lock (the ownership
+probe runs BEFORE the lock is taken — no nesting into MeshRouter's).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict
+
+from foremast_tpu.mesh.routing import DEFAULT_ROUTE_LABEL, series_route_key
+
+DEFAULT_DIRTY_MAX = 8_192
+DEFAULT_MICROTICK_DOCS = 256
+
+_EVENTS = (
+    "marked", "coalesced", "dropped", "foreign", "requeued",
+    "unattributed",
+)
+
+log = logging.getLogger("foremast_tpu.reactive")
+
+
+def _num(raw: str, default, cast, name: str):
+    """Warn-and-default numeric env parse: a malformed knob must not
+    kill worker startup with a raw traceback (cli._env_int's policy,
+    shared by every reactive knob)."""
+    if not raw:
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        log.warning("ignoring malformed %s=%r; using %r", name, raw, default)
+        return default
+
+
+def microtick_seconds_from_env() -> float:
+    """THE resolution of FOREMAST_MICROTICK_SECONDS (empty = unset,
+    0 = micro-ticks off) — one definition so the worker's pacing and
+    the cli's startup log can never report different values."""
+    return _num(
+        os.environ.get("FOREMAST_MICROTICK_SECONDS", ""),
+        0.0, float, "FOREMAST_MICROTICK_SECONDS",
+    )
+
+
+def microtick_docs_from_env() -> int:
+    """THE resolution of FOREMAST_MICROTICK_DOCS (dirty route keys
+    drained per micro-tick) — same single-definition discipline."""
+    return _num(
+        os.environ.get("FOREMAST_MICROTICK_DOCS", ""),
+        DEFAULT_MICROTICK_DOCS, int, "FOREMAST_MICROTICK_DOCS",
+    )
+
+
+class DirtySet:
+    """Bounded arrival ledger keyed by route key; see module docstring."""
+
+    def __init__(
+        self,
+        max_keys: int = DEFAULT_DIRTY_MAX,
+        route_label: str = DEFAULT_ROUTE_LABEL,
+        owns=None,
+        clock=time.time,
+    ):
+        self.max_keys = max(1, int(max_keys))
+        self.route_label = route_label
+        self.owns = owns  # series-key predicate (MeshRouter.owns_series)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._keys: OrderedDict[str, float] = OrderedDict()
+        self._counts = dict.fromkeys(_EVENTS, 0)
+
+    @staticmethod
+    def from_env(route_label: str = DEFAULT_ROUTE_LABEL, owns=None, env=None):
+        e = os.environ if env is None else env
+        return DirtySet(
+            max_keys=_num(
+                e.get("FOREMAST_MICROTICK_DIRTY_MAX", ""),
+                DEFAULT_DIRTY_MAX, int, "FOREMAST_MICROTICK_DIRTY_MAX",
+            ),
+            route_label=route_label,
+            owns=owns,
+        )
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._keys)
+
+    # -- marking (receiver handler threads) -----------------------------
+
+    def mark_series(self, key: str, now: float | None = None) -> bool:
+        """Mark one pushed series' route key dirty, stamped with THIS
+        process's clock (the receiver's arrival instant — see the
+        module docstring's clock contract). Returns whether the key was
+        marked (False = foreign under the ownership predicate)."""
+        owns = self.owns
+        if owns is not None and not owns(key):
+            # probe OUTSIDE the dirty lock: MeshRouter takes its own
+            with self._lock:
+                self._counts["foreign"] += 1
+            return False
+        self.mark(
+            series_route_key(key, self.route_label),
+            self._clock() if now is None else now,
+        )
+        return True
+
+    def mark(self, route_key: str, now: float | None = None,
+             requeue: bool = False) -> None:
+        """Insert keeping the EARLIEST stamp; evict oldest past the cap.
+        ``requeue=True`` is the worker giving back an arrival it could
+        not attribute yet (released docs, claim brownout) — counted
+        separately so the marked/coalesced counters stay push-only, and
+        re-inserted at the FRONT of the drain order: its stamp is the
+        original (oldest-running) arrival, and parking it behind
+        fresher marks would priority-invert the very latencies the SLO
+        histogram exists to bound."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            cur = self._keys.get(route_key)
+            if cur is not None:
+                if now < cur:
+                    self._keys[route_key] = now
+                if requeue:
+                    self._keys.move_to_end(route_key, last=False)
+                self._counts["requeued" if requeue else "coalesced"] += 1
+                return
+            self._keys[route_key] = now
+            if requeue:
+                self._keys.move_to_end(route_key, last=False)
+            self._counts["requeued" if requeue else "marked"] += 1
+            while len(self._keys) > self.max_keys:
+                self._keys.popitem(last=False)
+                self._counts["dropped"] += 1
+
+    # -- draining (worker tick thread) ----------------------------------
+
+    def take(self, limit: int) -> list[tuple[str, float]]:
+        """Pop up to `limit` oldest-marked entries as (key, stamp)."""
+        with self._lock:
+            n = min(max(0, int(limit)), len(self._keys))
+            return [self._keys.popitem(last=False) for _ in range(n)]
+
+    def take_all(self) -> list[tuple[str, float]]:
+        """Pop everything (the full sweep's catch-all drain)."""
+        with self._lock:
+            out = list(self._keys.items())
+            self._keys.clear()
+            return out
+
+    def count(self, event: str, n: int = 1) -> None:
+        """Fold a worker-side accounting event (``unattributed``) into
+        the shared counter dict so one collector exports them all."""
+        with self._lock:
+            self._counts[event] = self._counts.get(event, 0) + n
+
+    def counts(self) -> dict:
+        with self._lock:
+            return dict(self._counts)
+
+    def debug_state(self) -> dict:
+        with self._lock:
+            return {
+                "pending": len(self._keys),
+                "max_keys": self.max_keys,
+                "route_label": self.route_label,
+                "owned_only": self.owns is not None,
+                **self._counts,
+            }
+
+
+class ReactiveCollector:
+    """prometheus_client custom collector over a `DirtySet` — the
+    `foremast_microtick_dirty_*` families (docs/observability.md),
+    materialized at scrape time so the push/mark hot path never touches
+    prometheus_client."""
+
+    def __init__(self, dirty: DirtySet):
+        self._dirty = dirty
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        counts = self._dirty.counts()
+        events = CounterMetricFamily(
+            "foremast_microtick_dirty_events",
+            "dirty-set traffic (marked=new key, coalesced=key already "
+            "pending, dropped=evicted past FOREMAST_MICROTICK_DIRTY_MAX, "
+            "foreign=owned by another mesh member, requeued=given back "
+            "un-judged, unattributed=arrival no judged doc matched)",
+            labels=["event"],
+        )
+        for event in _EVENTS:
+            events.add_metric([event], counts.get(event, 0))
+        yield events
+        yield GaugeMetricFamily(
+            "foremast_microtick_dirty_pending",
+            "route keys currently pending in the dirty set",
+            value=len(self._dirty),
+        )

@@ -39,6 +39,14 @@ class NotFound(KeyError):
     """Object absent — the analogue of a k8s 404 / IsNotFound."""
 
 
+class WatchGone(Exception):
+    """410 Gone from a watch: the resume `resourceVersion` fell out of
+    the API server's event window — the stream cannot resume and the
+    watcher must RE-LIST (client-go's ErrResourceExpired → reflector
+    relist). Raised both for an immediate 410 answer and for the
+    mid-stream ``{"type": "ERROR", ...code 410}`` event."""
+
+
 class KubeClient(Protocol):
     # builtin workloads ---------------------------------------------------
     def list_namespaces(self) -> list[dict]: ...
@@ -444,6 +452,146 @@ class HttpKube:
             )
         raise AssertionError("unreachable")  # pragma: no cover
 
+    # --- streaming watch (reactive plane, ISSUE 12) ----------------------
+
+    def _deployments_path(self, namespace: str | None) -> str:
+        return (
+            f"/apis/apps/v1/namespaces/{namespace}/deployments"
+            if namespace
+            else "/apis/apps/v1/deployments"
+        )
+
+    def list_deployments_rv(
+        self, namespace: str | None = None
+    ) -> tuple[list[dict], str]:
+        """One list round trip returning (items, list resourceVersion) —
+        the watch resume point (a plain `list_deployments` throws the
+        list's own resourceVersion away, forcing the first watch to
+        start from "now" and miss anything between list and watch)."""
+        out = self._req("GET", self._deployments_path(namespace))
+        return out.get("items", []), str(
+            (out.get("metadata") or {}).get("resourceVersion") or ""
+        )
+
+    def watch_deployments(
+        self,
+        namespace: str | None = None,
+        resource_version: str = "",
+        timeout_seconds: float = 30.0,
+        stall_margin: float = 5.0,
+    ):
+        """Long-poll streaming watch (``?watch=true``): yields
+        ``(type, object)`` pairs — type ADDED/MODIFIED/DELETED — as the
+        API server writes them, until the server closes the window
+        (``timeoutSeconds``) or the stream dies.
+
+        Semantics mirror client-go's reflector contract:
+
+          * a 410 answer OR a mid-stream ERROR event with code 410
+            raises `WatchGone` — the caller must re-list (the informer
+            diffs the fresh list against its snapshot, so no event is
+            lost, only batched);
+          * a stream STALL (the server stops writing without closing —
+            half-open TCP, wedged proxy) surfaces as the socket read
+            timeout: every read blocks at most ``timeout_seconds +
+            stall_margin``, so a stalled stream raises `TimeoutError`
+            instead of hanging the watcher forever;
+          * a torn tail (disconnect mid-JSON-line) ends the stream
+            cleanly at the last complete event — the caller resumes
+            from the last resourceVersion it APPLIED;
+          * chaos/breaker ride the same per-request seam as `_req`
+            (edge ``kube``): the op string contains ``watch=true`` so
+            plans can scope stream-stall rules to the watch alone. No
+            retry loop here — the informer's reconnect IS the retry.
+        """
+        # the apiserver takes integer seconds (min 1); the client's
+        # stall detector must measure from the window actually SENT,
+        # or a sub-second request would read its own rounding as a
+        # stalled stream
+        window = max(1, int(round(timeout_seconds)))
+        q = f"?watch=true&timeoutSeconds={window}"
+        if resource_version:
+            q += f"&resourceVersion={urllib.parse.quote(str(resource_version))}"
+        path = self._deployments_path(namespace) + q
+        url = f"{self.base_url}{path}"
+        req = urllib.request.Request(url, method="GET")
+        req.add_header("Accept", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        from foremast_tpu.metrics.source import RETRY_STATUSES
+
+        breaker = self.breaker
+        if breaker is not None:
+            breaker.allow()
+        try:
+            # perturb INSIDE the try (the _req composition): an
+            # injected fault is a ConnectionError and must land in the
+            # OSError arm below so it drives breaker accounting — and a
+            # half-open probe granted by allow() always records an
+            # outcome
+            if self.chaos is not None:
+                self.chaos.perturb(path)
+            resp = urllib.request.urlopen(
+                req,
+                context=self._ctx,
+                timeout=window + max(0.0, float(stall_margin)),
+            )
+        except urllib.error.HTTPError as e:
+            code = e.code
+            e.close()
+            if breaker is not None:
+                # _req's policy: the API server ANSWERED, so outside
+                # the transient statuses the endpoint is alive — a 403
+                # on the watch path must not open the shared kube
+                # breaker for the whole controller
+                if code in RETRY_STATUSES:
+                    breaker.record_failure()
+                else:
+                    breaker.record_success()
+            if code == 410:
+                raise WatchGone(path) from None
+            raise
+        except OSError:
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+        if breaker is not None:
+            breaker.record_success()
+        import http.client as _http_client
+
+        with resp:
+            while True:
+                try:
+                    raw = resp.readline()
+                except _http_client.HTTPException:
+                    # a REAL apiserver streams chunked; a connection
+                    # torn mid-chunk raises IncompleteRead (NOT an
+                    # OSError) — same torn-tail semantics: end at the
+                    # last complete event, resume from the applied rv
+                    return
+                if not raw:
+                    return  # clean window end
+                line = raw.strip()
+                if not line:
+                    continue
+                try:
+                    evt = json.loads(line)
+                except ValueError:
+                    return  # torn tail: resume from the last applied rv
+                etype = evt.get("type", "")
+                obj = evt.get("object") or {}
+                if etype == "ERROR":
+                    if int(obj.get("code", 0) or 0) == 410:
+                        raise WatchGone(path)
+                    # a server-side failure event (etcd leader change,
+                    # internal error): surface as a connection-class
+                    # error so the informer counts an ERROR restart,
+                    # not a benign clean end
+                    raise ConnectionError(
+                        f"watch ERROR event on {path}: {obj}"
+                    )
+                yield etype, obj
+
     # --- builtin workloads ----------------------------------------------
 
     def list_namespaces(self) -> list[dict]:
@@ -453,12 +601,9 @@ class HttpKube:
         return self._req("GET", f"/api/v1/namespaces/{name}")
 
     def list_deployments(self, namespace: str | None = None) -> list[dict]:
-        path = (
-            f"/apis/apps/v1/namespaces/{namespace}/deployments"
-            if namespace
-            else "/apis/apps/v1/deployments"
+        return self._req("GET", self._deployments_path(namespace)).get(
+            "items", []
         )
-        return self._req("GET", path).get("items", [])
 
     def get_deployment(self, namespace: str, name: str) -> dict:
         return self._req("GET", f"/apis/apps/v1/namespaces/{namespace}/deployments/{name}")

@@ -10,6 +10,14 @@ lists diffed against a local snapshot (add/update/delete by UID + spec),
 which survives API-server reconnects for free and needs no client
 machinery. Event *detection* granularity is the resync period, exactly
 like a reference informer that missed its watch stream.
+
+Since ISSUE 12 the missed watch stream exists: `FOREMAST_WATCH_STREAM=1`
+(or ``stream=True``) runs the REACTIVE loop instead — `HttpKube`'s
+``watch=true`` long-poll delivers deployment events on arrival
+(`reactive/watchstream.py`), resourceVersion resume + 410-Gone re-list
+cover the reconnect cases, and the 30 s resync demotes to a repair
+sweep that only catches what the stream lost. Detection granularity
+drops from the resync period to stream delivery (milliseconds).
 """
 
 from __future__ import annotations
@@ -49,7 +57,14 @@ class DeploymentInformer:
         self._primed = False
 
     def resync(self) -> None:
-        current = {_key(d): d for d in self.kube.list_deployments()}
+        self._apply_list(
+            {_key(d): d for d in self.kube.list_deployments()}
+        )
+
+    def _apply_list(self, current: dict[tuple[str, str], dict]) -> None:
+        """Diff a fresh list against the snapshot and emit events — the
+        resync body, shared with the streaming informer's repair/
+        re-list path (reactive/watchstream.py)."""
         if not self._primed:
             # first list primes the cache; emit adds so monitors get
             # created for pre-existing Deployments (AddFunc semantics)
@@ -90,6 +105,7 @@ class WatchPlane:
         analyst_factory=None,
         tracer=None,
         registry=None,
+        stream: bool | None = None,
     ) -> None:
         self.barrelman = Barrelman(
             kube,
@@ -104,7 +120,33 @@ class WatchPlane:
             tracer=tracer,
             registry=registry,
         )
-        self.informer = DeploymentInformer(kube, self.barrelman.handle_deployment)
+        # Event-driven detection (reactive plane, ISSUE 12): `stream`
+        # (env FOREMAST_WATCH_STREAM) swaps the list+diff informer for
+        # a streaming one — deployment events dispatch on ARRIVAL from
+        # the API server's watch stream, the 30 s resync demotes to a
+        # repair sweep, and run() takes the event loop below. Requires
+        # a kube client that can stream (HttpKube); InMemoryKube keeps
+        # the poll loop (its event delivery is already synchronous).
+        if stream is None:
+            import os as _os
+
+            stream = _os.environ.get("FOREMAST_WATCH_STREAM", "0") == "1"
+        self.stream = bool(stream) and hasattr(kube, "watch_deployments")
+        if self.stream:
+            from foremast_tpu.reactive.watchstream import (
+                StreamingInformer,
+                WatchStreamMetrics,
+            )
+
+            self.informer = StreamingInformer(
+                kube,
+                self.barrelman.handle_deployment,
+                metrics=WatchStreamMetrics(registry=registry),
+            )
+        else:
+            self.informer = DeploymentInformer(
+                kube, self.barrelman.handle_deployment
+            )
         self.clock = clock
         self.sleep = sleep
         self._started = clock()
@@ -120,7 +162,10 @@ class WatchPlane:
             "version": __version__,
             "uptime_seconds": round(self.clock() - self._started, 1),
             "deployments_cached": len(self.informer._snapshot),
+            "watch_stream": self.stream,
         }
+        if self.stream:
+            state["stream"] = self.informer.debug_state()
         if self.controller.tracer is not None:
             state["trace"] = self.controller.tracer.debug_state()
         return state
@@ -136,6 +181,8 @@ class WatchPlane:
         return last_resync
 
     def run(self, stop: Callable[[], bool] = lambda: False) -> None:
+        if self.stream:
+            return self.run_stream(stop)
         last_resync = 0.0
         while not stop():
             try:
@@ -143,3 +190,42 @@ class WatchPlane:
             except Exception:  # noqa: BLE001 - keep the control loop alive
                 log.exception("watch-plane step failed")
             self.sleep(MONITOR_POLL_SECONDS)
+
+    def run_stream(self, stop: Callable[[], bool] = lambda: False) -> None:
+        """Event-driven loop: hold the watch stream open between
+        scheduler duties, dispatching deployment events the instant
+        they arrive. Monitor polling keeps its 10 s cadence (job
+        status is a poll by nature until the service pushes), and the
+        30 s deployment resync is DEMOTED to a repair sweep — it no
+        longer bounds detection latency, it only catches what a lossy
+        stream (410 re-lists, compaction) might have dropped."""
+        last_resync = self.clock()
+        last_poll = 0.0
+        try:
+            self.informer.resync()  # prime the snapshot + resume point
+        except Exception:  # noqa: BLE001 - retried inside the loop
+            log.exception("watch-plane initial list failed")
+        while not stop():
+            try:
+                now = self.clock()
+                if now - last_poll >= MONITOR_POLL_SECONDS:
+                    self.controller.tick()
+                    last_poll = now
+                if now - last_resync >= DEPLOY_RESYNC_SECONDS:
+                    self.informer.resync()  # repair sweep
+                    last_resync = now
+                window = min(
+                    MONITOR_POLL_SECONDS - (self.clock() - last_poll),
+                    DEPLOY_RESYNC_SECONDS - (self.clock() - last_resync),
+                )
+                window = max(0.05, min(window, MONITOR_POLL_SECONDS))
+                t0 = self.clock()
+                self.informer.consume(window)
+                leftover = window - (self.clock() - t0)
+                if leftover > 0.05:
+                    # the stream died early (API server down, breaker
+                    # open): pace the reconnects instead of spinning
+                    self.sleep(min(leftover, 1.0))
+            except Exception:  # noqa: BLE001 - keep the control loop alive
+                log.exception("watch-plane stream step failed")
+                self.sleep(1.0)

@@ -9,14 +9,25 @@ object store is plain dicts keyed (namespace, name); the merge logic is
 implemented here independently of `watch.kubeapi` so the client's
 expectations are validated against a second implementation, not against
 itself.
+
+Streaming watch (ISSUE 12 satellite): every mutation logs an rv-ordered
+event, and ``GET ...?watch=true&resourceVersion=N&timeoutSeconds=S``
+streams the suffix as JSON lines then long-polls until the window ends
+— real apiserver semantics including the 410-Gone floor when a resume
+point falls behind the compacted event log. `add_watch_fault` injects
+stream stalls, mid-JSON-line disconnects, and 410 answers, so watch
+tests drive a REAL server misbehaving in real ways, not stubs.
 """
 
 from __future__ import annotations
 
+import copy
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 GROUP = "deployment.foremast.ai"
 VERSION = "v1alpha1"
@@ -60,6 +71,75 @@ class FakeKubeState:
         # int(0=none), "latency": seconds, "times": remaining fires
         # (None = forever)} — consumed in registration order.
         self.faults: list[dict] = []
+        # streaming watch (ISSUE 12 satellite): every object mutation
+        # appends an rv-ordered event here; watch requests stream the
+        # suffix past their resourceVersion and then long-poll on the
+        # condition until timeoutSeconds. Bounded: compaction past
+        # `watch_cap` raises the 410 floor (real apiserver semantics —
+        # a resume point older than the window gets Gone).
+        self.watch_log: list[dict] = []
+        self.watch_cap = 1024
+        self.watch_compacted_to = 0  # resume rv below this => 410
+        self.watch_cond = threading.Condition(self.lock)
+        # injectable stream faults, one consumed per watch REQUEST:
+        # {"gone": bool, "after_events": int, "stall_seconds": float,
+        #  "disconnect": bool, "times": remaining}
+        self.watch_faults: list[dict] = []
+
+    def log_event(self, kind: str, ns: str, etype: str, obj: dict) -> None:
+        """Append one watch event (caller holds `self.lock`)."""
+        self.watch_log.append(
+            {
+                "rv": int(obj["metadata"]["resourceVersion"]),
+                "kind": kind,
+                "ns": ns,
+                "type": etype,
+                "object": copy.deepcopy(obj),
+            }
+        )
+        if len(self.watch_log) > self.watch_cap:
+            drop = len(self.watch_log) - self.watch_cap
+            self.watch_compacted_to = self.watch_log[drop - 1]["rv"]
+            del self.watch_log[:drop]
+        self.watch_cond.notify_all()
+
+    def add_watch_fault(
+        self,
+        gone: bool = False,
+        after_events: int = 0,
+        stall_seconds: float = 0.0,
+        disconnect: bool = False,
+        error_code: int = 0,
+        times: int = 1,
+    ) -> None:
+        """Arm one watch-stream fault: `gone` answers the request 410;
+        `disconnect` tears the connection mid-JSON-line after
+        `after_events` streamed events; `stall_seconds` holds the
+        stream open without writing (the client's stall margin should
+        fire) after `after_events`, then resumes normally;
+        `error_code` opens the stream 200 then immediately writes a
+        ``{"type": "ERROR", "object": {"code": N}}`` event (the real
+        apiserver's mid-stream failure shape — 410 = expired resume
+        point, anything else = server-side watch failure)."""
+        with self.lock:
+            self.watch_faults.append(
+                {
+                    "gone": gone,
+                    "after_events": int(after_events),
+                    "stall_seconds": float(stall_seconds),
+                    "disconnect": disconnect,
+                    "error_code": int(error_code),
+                    "times": int(times),
+                }
+            )
+
+    def take_watch_fault(self) -> dict | None:
+        with self.lock:
+            for f in self.watch_faults:
+                if f["times"] > 0:
+                    f["times"] -= 1
+                    return dict(f)
+        return None
 
     def add_fault(
         self,
@@ -100,11 +180,16 @@ class FakeKubeState:
         return str(self.rv)
 
     def put(self, kind: str, namespace: str, obj: dict) -> dict:
-        name = obj["metadata"]["name"]
-        obj["metadata"].setdefault("namespace", namespace)
-        obj["metadata"]["resourceVersion"] = self.next_rv()
-        self.objects[kind][(namespace, name)] = obj
-        return obj
+        with self.lock:
+            name = obj["metadata"]["name"]
+            obj["metadata"].setdefault("namespace", namespace)
+            obj["metadata"]["resourceVersion"] = self.next_rv()
+            existed = (namespace, name) in self.objects[kind]
+            self.objects[kind][(namespace, name)] = obj
+            self.log_event(
+                kind, namespace, "MODIFIED" if existed else "ADDED", obj
+            )
+            return obj
 
 
 # URL patterns -> (kind, namespaced collection)
@@ -205,6 +290,11 @@ def _handler(state: FakeKubeState):
             kind, ns, name, mode = self._route()
             if kind is None:
                 return self._send(404, {"reason": "NotFound"})
+            qs = parse_qs(urlparse(self.path).query)
+            if mode != "item" and qs.get("watch", ["false"])[0] in (
+                "true", "1",
+            ):
+                return self._watch(kind, ns, qs)
             with state.lock:
                 store = state.objects[kind]
                 if mode == "item" or (kind == "namespaces" and name):
@@ -217,7 +307,109 @@ def _handler(state: FakeKubeState):
                     for (o_ns, _), o in sorted(store.items())
                     if not ns or o_ns == ns
                 ]
-                return self._send(200, {"items": items})
+                # lists carry the store's resourceVersion (the watch
+                # resume point, exactly the real apiserver contract)
+                return self._send(
+                    200,
+                    {
+                        "items": items,
+                        "metadata": {"resourceVersion": str(state.rv)},
+                    },
+                )
+
+        def _watch(self, kind, ns, qs):
+            """Streaming watch: send every logged event past the
+            resume rv as one JSON line each, then long-poll for new
+            ones until timeoutSeconds — with injectable 410s, stream
+            stalls and torn-line disconnects (take_watch_fault)."""
+            try:
+                rv = int(qs.get("resourceVersion", ["0"])[0] or 0)
+            except ValueError:
+                rv = 0
+            try:
+                timeout_s = float(qs.get("timeoutSeconds", ["30"])[0])
+            except ValueError:
+                timeout_s = 30.0
+            fault = state.take_watch_fault() or {}
+            if fault.get("gone"):
+                return self._send(410, {"reason": "Expired", "code": 410})
+            with state.lock:
+                if rv < state.watch_compacted_to:
+                    # the resume point (rv=0 "from the start" included)
+                    # fell out of the retained window — streaming only
+                    # the surviving suffix would silently lose events
+                    return self._send(
+                        410, {"reason": "Expired", "code": 410}
+                    )
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()  # no Content-Length: close-delimited stream
+            if fault.get("error_code"):
+                try:
+                    self.wfile.write(
+                        json.dumps(
+                            {
+                                "type": "ERROR",
+                                "object": {
+                                    "kind": "Status",
+                                    "code": fault["error_code"],
+                                },
+                            }
+                        ).encode()
+                        + b"\n"
+                    )
+                    self.wfile.flush()
+                except OSError:
+                    pass
+                return
+            deadline = time.monotonic() + timeout_s
+            sent = 0
+            stalled = False
+            try:
+                while True:
+                    with state.lock:
+                        pending = [
+                            e
+                            for e in state.watch_log
+                            if e["rv"] > rv
+                            and e["kind"] == kind
+                            and (not ns or e["ns"] == ns)
+                        ]
+                    for e in pending:
+                        line = json.dumps(
+                            {"type": e["type"], "object": e["object"]}
+                        ).encode() + b"\n"
+                        if (
+                            fault.get("disconnect")
+                            and sent >= fault.get("after_events", 0)
+                        ):
+                            # torn tail: half a JSON line, then the
+                            # connection dies (client must resume from
+                            # the last APPLIED rv, not the torn one)
+                            self.wfile.write(line[: max(3, len(line) // 2)])
+                            self.wfile.flush()
+                            self.close_connection = True
+                            return
+                        if (
+                            fault.get("stall_seconds", 0.0) > 0
+                            and sent >= fault.get("after_events", 0)
+                            and not stalled
+                        ):
+                            # hold the stream open without writing:
+                            # the client's stall margin should fire
+                            time.sleep(fault["stall_seconds"])
+                            stalled = True
+                        self.wfile.write(line)
+                        self.wfile.flush()
+                        rv = e["rv"]
+                        sent += 1
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return  # clean window end: client reconnects
+                    with state.watch_cond:
+                        state.watch_cond.wait(min(0.05, remaining))
+            except OSError:
+                return  # client went away mid-stream
 
         def do_POST(self):
             self._record()
@@ -236,6 +428,7 @@ def _handler(state: FakeKubeState):
                 obj["metadata"]["namespace"] = ns
                 obj["metadata"]["resourceVersion"] = state.next_rv()
                 state.objects[kind][key] = obj
+                state.log_event(kind, ns, "ADDED", obj)
                 return self._send(201, obj)
 
         def do_PUT(self):
@@ -260,6 +453,7 @@ def _handler(state: FakeKubeState):
                 obj["metadata"]["name"] = name
                 obj["metadata"]["resourceVersion"] = state.next_rv()
                 store[key] = obj
+                state.log_event(kind, ns, "MODIFIED", obj)
                 return self._send(200, obj)
 
         def do_PATCH(self):
@@ -280,6 +474,7 @@ def _handler(state: FakeKubeState):
                     return self._send(404, {"reason": "NotFound"})
                 _merge(store[key], patch)
                 store[key]["metadata"]["resourceVersion"] = state.next_rv()
+                state.log_event(kind, ns, "MODIFIED", store[key])
                 return self._send(200, store[key])
 
         def do_DELETE(self):
@@ -293,7 +488,9 @@ def _handler(state: FakeKubeState):
                 key = (ns, name)
                 if key not in state.objects[kind]:
                     return self._send(404, {"reason": "NotFound"})
-                del state.objects[kind][key]
+                gone = state.objects[kind].pop(key)
+                gone["metadata"]["resourceVersion"] = state.next_rv()
+                state.log_event(kind, ns, "DELETED", gone)
                 return self._send(200, {"status": "Success"})
 
     return Handler

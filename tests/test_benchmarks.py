@@ -267,6 +267,26 @@ def test_chaos_bench_small_smoke(capsys):
     assert by_phase["crash"]["parked_at_wedge"] > 0
 
 
+def test_latency_bench_small_smoke(capsys):
+    """`make bench-latency --small` smoke (ISSUE 12): the reactive
+    plane end to end at CI shapes — a deployment PATCHed into the fake
+    kube server produces a verdict through the real watch stream +
+    micro-tick chain, anomaly injections through the real receiver all
+    land (the bench FAILS on a timed-out injection, a missing deploy
+    verdict, or a micro-vs-full tick-path parity break). The <= 1 s /
+    p99 <= 2 s bars are asserted at the full 16k shape, not CI smoke
+    shapes."""
+    import benchmarks.latency_bench as latency_bench
+
+    latency_bench.main(["--small"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["bench"] == "latency"
+    assert out["injections_timed_out"] == 0
+    assert out["deploy_to_first_verdict_seconds"] is not None
+    assert out["anomaly_latency_p99_seconds"] is not None
+    assert out["parity"] == "byte-identical (asserted)"
+
+
 def test_elastic_bench_small_smoke(capsys):
     """`make bench-elastic --small` smoke (ISSUE 11): 2 -> 4 -> 2
     workers under continuous load with every acceptance assert in-run

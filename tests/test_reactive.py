@@ -1,0 +1,743 @@
+"""Reactive-plane coverage (ISSUE 12): the dirty-series set, the
+receiver's arrival-clock contract, ingest-triggered micro-ticks
+(tick-path status parity, mesh ownership, brownout degradation, the
+push→verdict latency histogram), and the streaming K8s watch against
+the fake kube server's real chunked watch endpoint (resume, 410
+re-list, stalls, torn disconnects).
+"""
+
+import threading
+import time
+import urllib.request
+import json as _json
+
+import numpy as np
+import pytest
+
+from foremast_tpu.config import BrainConfig
+from foremast_tpu.ingest import (
+    RingSource,
+    RingStore,
+    canonical_series,
+    start_ingest_server,
+    stop_ingest_server,
+)
+from foremast_tpu.jobs.models import (
+    STATUS_COMPLETED_UNHEALTH,
+    STATUS_PREPROCESS_COMPLETED,
+    Document,
+)
+from foremast_tpu.jobs.store import InMemoryStore
+from foremast_tpu.jobs.worker import BrainWorker
+from foremast_tpu.metrics.promql import prometheus_url
+from foremast_tpu.reactive import DirtySet
+from tests.fake_kube_server import FakeKubeServer
+
+NOW = 1_760_000_000.0
+HIST_LEN = 256
+CUR_LEN = 30
+
+
+# ---------------------------------------------------------------------------
+# DirtySet semantics
+# ---------------------------------------------------------------------------
+
+
+def test_dirty_mark_coalesces_to_earliest_and_takes_oldest_first():
+    d = DirtySet(max_keys=16)
+    d.mark("b", 2.0)
+    d.mark("a", 5.0)
+    d.mark("a", 3.0)  # coalesce keeps the EARLIEST arrival
+    d.mark("a", 9.0)  # later arrival never advances the stamp
+    assert len(d) == 2
+    assert d.take(1) == [("b", 2.0)]  # oldest-marked first
+    assert d.take(8) == [("a", 3.0)]
+    assert len(d) == 0
+    c = d.counts()
+    assert c["marked"] == 2 and c["coalesced"] == 2
+
+
+def test_dirty_bounded_drop_oldest_with_counter_never_a_leak():
+    d = DirtySet(max_keys=3)
+    for i in range(10):
+        d.mark(f"k{i}", float(i))
+    assert len(d) == 3
+    assert d.counts()["dropped"] == 7
+    # the survivors are the NEWEST marks (oldest dropped)
+    assert [k for k, _ in d.take_all()] == ["k7", "k8", "k9"]
+
+
+def test_dirty_route_key_extraction_and_ownership_filter():
+    owned = []
+    d = DirtySet(owns=lambda key: key not in owned)
+    # selector carrying the route label -> the app value is the key
+    assert d.mark_series('up{app="svc1",ns="x"}', now=1.0)
+    assert d.take_all() == [("svc1", 1.0)]
+    # label-less series -> the whole canonical key routes
+    assert d.mark_series("sum(rate(x[5m]))", now=2.0)
+    assert d.take_all() == [("sum(rate(x[5m]))", 2.0)]
+    # foreign (ownership predicate rejects): counted, never marked
+    owned.append('up{app="svc2"}')
+    assert not d.mark_series('up{app="svc2"}', now=3.0)
+    assert len(d) == 0
+    assert d.counts()["foreign"] == 1
+
+
+def test_dirty_requeue_preserves_original_stamp():
+    d = DirtySet()
+    d.mark("app", 10.0)
+    (k, stamp), = d.take(1)
+    d.mark(k, stamp, requeue=True)
+    assert d.take_all() == [("app", 10.0)]
+    c = d.counts()
+    assert c["requeued"] == 1 and c["marked"] == 1
+
+
+def test_dirty_requeue_drains_before_fresher_marks():
+    """A requeued arrival carries the OLDEST running SLO clock — it
+    must re-enter at the FRONT of the drain order, not behind marks
+    that arrived while its micro-tick was failing (priority
+    inversion would inflate exactly the p99 the histogram bounds)."""
+    d = DirtySet()
+    d.mark("old", 1.0)
+    (k, stamp), = d.take(1)
+    d.mark("fresh", 50.0)
+    d.mark(k, stamp, requeue=True)
+    assert d.take(1) == [("old", 1.0)]
+    assert d.take_all() == [("fresh", 50.0)]
+
+
+def test_reactive_knob_parsing_tolerates_malformed_env(monkeypatch):
+    """A templated manifest leaving a knob empty or garbled must not
+    kill worker startup: warn-and-default, cli._env_int's policy."""
+    from foremast_tpu.reactive.dirty import (
+        microtick_docs_from_env,
+        microtick_seconds_from_env,
+    )
+
+    monkeypatch.setenv("FOREMAST_MICROTICK_SECONDS", "")
+    monkeypatch.setenv("FOREMAST_MICROTICK_DOCS", "nope")
+    monkeypatch.setenv("FOREMAST_MICROTICK_DIRTY_MAX", "1e4")
+    assert microtick_seconds_from_env() == 0.0
+    assert microtick_docs_from_env() == 256
+    assert DirtySet.from_env().max_keys == 8192
+
+
+# ---------------------------------------------------------------------------
+# receiver arrival clock (satellite: SLO immune to pusher clock skew)
+# ---------------------------------------------------------------------------
+
+
+def _post(url: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        url,
+        data=_json.dumps(body).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return _json.loads(resp.read())
+
+
+def test_receiver_stamps_arrival_with_its_own_clock_not_the_pushers():
+    ring = RingStore(shards=1)
+    dirty = DirtySet()
+    srv, _ = start_ingest_server(0, ring, host="127.0.0.1", dirty=dirty)
+    try:
+        port = srv.server_address[1]
+        # sample timestamps DECADES in the past — a skewed/replaying
+        # pusher; the dirty stamp must be this process's wall clock
+        before = time.time()
+        out = _post(
+            f"http://127.0.0.1:{port}/api/v1/write",
+            {
+                "timeseries": [
+                    {
+                        "alias": 'm{app="skewed"}',
+                        "times": [1_000_000_000, 1_000_000_060],
+                        "values": [1.0, 2.0],
+                    }
+                ]
+            },
+        )
+        assert out["accepted_samples"] == 2
+        (key, stamp), = dirty.take_all()
+        assert key == "skewed"
+        assert before - 1.0 <= stamp <= time.time() + 1.0
+        # a re-push marks again (a last-write-wins revision of an
+        # existing stamp is exactly the spike-correction case that
+        # must re-judge)
+        out = _post(
+            f"http://127.0.0.1:{port}/api/v1/write",
+            {
+                "timeseries": [
+                    {
+                        "alias": 'm{app="skewed"}',
+                        "times": [1_000_000_000, 1_000_000_060],
+                        "values": [1.0, 9.0],
+                    }
+                ]
+            },
+        )
+        assert out["accepted_samples"] == 2
+        assert len(dirty) == 1
+    finally:
+        stop_ingest_server(srv)
+
+
+# ---------------------------------------------------------------------------
+# micro-ticks
+# ---------------------------------------------------------------------------
+
+
+def _build_push_fleet(services: int):
+    """Pure-push fleet: docs in an InMemoryStore, histories + currents
+    resident in a ring (continuous strategy, no baselines)."""
+    rng = np.random.default_rng(0)
+    store = InMemoryStore()
+    ring = RingStore(shards=2)
+    t_now = int(NOW)
+    ht = t_now - 86_400 * 7 + 60 * np.arange(HIST_LEN, dtype=np.int64)
+    ct = ht[-1] + 60 + 60 * np.arange(CUR_LEN, dtype=np.int64)
+    end_time = time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime(t_now + 3600)
+    )
+    keys = []
+    for s in range(services):
+        expr = f'lat{{namespace="ns",app="app{s}"}}'
+        key = canonical_series(expr)
+        keys.append(key)
+        hv = rng.normal(1.0, 0.1, HIST_LEN).astype(np.float32)
+        cv = np.ones(CUR_LEN, np.float32)
+        ring.push(
+            key,
+            np.concatenate([ht, ct]),
+            np.concatenate([hv, cv]),
+            start=float(ht[0]),
+            now=NOW,
+        )
+        cur_url = prometheus_url(
+            {"endpoint": "http://p/api/v1/", "query": expr,
+             "start": int(ct[0]), "end": int(ct[-1]), "step": 60}
+        )
+        hist_url = prometheus_url(
+            {"endpoint": "http://p/api/v1/", "query": expr,
+             "start": int(ht[0]), "end": int(ht[-1]), "step": 60}
+        )
+        store.create(
+            Document(
+                id=f"job-{s}",
+                app_name=f"app{s}",
+                end_time=end_time,
+                current_config=f"latency== {cur_url}",
+                historical_config=f"latency== {hist_url}",
+                strategy="continuous",
+            )
+        )
+    return store, ring, keys, ht, ct
+
+
+def _mk_worker(store, ring, services, dirty=None, metrics=None, mesh=None):
+    cfg = BrainConfig(
+        algorithm="moving_average_all", season_steps=24,
+        max_cache_size=services + 16,
+    )
+    return BrainWorker(
+        store,
+        RingSource(ring, fallback=None),
+        config=cfg,
+        claim_limit=max(services, 4),
+        worker_id="reactive-w",
+        dirty=dirty,
+        metrics=metrics,
+        mesh=mesh,
+    )
+
+
+def _statuses(store):
+    return {
+        d.id: (d.status, d.reason, d.anomaly_info)
+        for d in store._docs.values()
+    }
+
+
+def test_micro_tick_claims_only_dirty_docs():
+    store, ring, keys, ht, ct = _build_push_fleet(3)
+    dirty = DirtySet()
+    w = _mk_worker(store, ring, 3, dirty=dirty)
+    assert w.micro_tick(now=NOW + 150) == 0  # nothing dirty, no claim
+    dirty.mark_series(keys[1], now=NOW)
+    assert w.micro_tick(now=NOW + 150) == 1
+    sts = {d.id: d.status for d in store._docs.values()}
+    assert sts["job-1"] == STATUS_PREPROCESS_COMPLETED
+    assert sts["job-0"] == "initial" and sts["job-2"] == "initial"
+    assert len(dirty) == 0
+
+
+def test_micro_tick_status_byte_identical_to_full_tick():
+    """THE tick-path parity pin (acceptance): the same doc judged by a
+    micro-tick and by a full tick produces byte-identical statuses,
+    reasons and anomaly payloads — cold first judgment, warm re-check,
+    and an anomaly-flagging re-check after a spiking push."""
+    store_a, ring_a, keys_a, ht, ct = _build_push_fleet(3)
+    store_b, ring_b, keys_b, _, _ = _build_push_fleet(3)
+    wa = _mk_worker(store_a, ring_a, 3)  # tick-paced
+    db = DirtySet()
+    wb = _mk_worker(store_b, ring_b, 3, dirty=db)  # reactive
+
+    # cold first judgment
+    assert wa.tick(now=NOW + 150) == 3
+    for k in keys_b:
+        db.mark_series(k, now=NOW)
+    assert wb.micro_tick(now=NOW + 150) == 3
+    assert _statuses(store_a) == _statuses(store_b)
+
+    # warm re-check after a spiking push on app1 (both rings)
+    spike = np.full(3, 40.0, np.float32)
+    for ring, keys in ((ring_a, keys_a), (ring_b, keys_b)):
+        ring.push(keys[1], ct[-3:], spike, now=NOW)
+    assert wa.tick(now=NOW + 300) == 3
+    db.mark_series(keys_b[1], now=NOW)
+    assert wb.micro_tick(now=NOW + 300) == 1
+    a = _statuses(store_a)
+    assert a["job-1"] == _statuses(store_b)["job-1"]
+    assert a["job-1"][0] == STATUS_COMPLETED_UNHEALTH
+
+
+class _StubMesh:
+    """Just enough MeshNode surface for the worker: a claim filter
+    that rejects a fixed app set."""
+
+    handoff = None
+    draining = False
+
+    def __init__(self, rejected_apps):
+        self.rejected = set(rejected_apps)
+
+    def on_tick(self):
+        pass
+
+    def claim_filter(self, doc) -> bool:
+        return doc.app_name not in self.rejected
+
+    def debug_state(self):
+        return {"stub": True}
+
+
+def test_micro_tick_composes_with_mesh_partition_filter():
+    """Dirty routing respects partition ownership: a dirty key whose
+    doc the mesh filter rejects is never claimed (and its arrival is
+    dropped as unattributed, not leaked)."""
+    store, ring, keys, ht, ct = _build_push_fleet(2)
+    dirty = DirtySet()
+    w = _mk_worker(
+        store, ring, 2, dirty=dirty, mesh=_StubMesh({"app0"})
+    )
+    dirty.mark_series(keys[0], now=NOW)
+    dirty.mark_series(keys[1], now=NOW)
+    assert w.micro_tick(now=NOW + 150) == 1
+    sts = {d.id: d.status for d in store._docs.values()}
+    assert sts["job-1"] == STATUS_PREPROCESS_COMPLETED
+    assert sts["job-0"] == "initial"
+    assert dirty.counts()["unattributed"] == 1
+
+
+class _BrownoutStore(InMemoryStore):
+    """First N claims fail transiently (a store brownout)."""
+
+    def __init__(self, fail_claims: int = 1):
+        super().__init__()
+        self.fail_claims = fail_claims
+
+    def claim(self, *a, **kw):
+        if self.fail_claims > 0:
+            self.fail_claims -= 1
+            raise ConnectionError("injected store brownout")
+        return super().claim(*a, **kw)
+
+
+def test_micro_tick_claim_brownout_requeues_arrivals_unspent():
+    """A store brownout mid-micro-tick must not lose arrivals: the
+    pending keys go back to the dirty set with their ORIGINAL stamps
+    (the SLO clock keeps running), and the next cycle judges them."""
+    store, ring, keys, ht, ct = _build_push_fleet(1)
+    docs = list(store._docs.values())
+    brown = _BrownoutStore(fail_claims=1)
+    for d in docs:
+        brown.create(d)
+    dirty = DirtySet()
+    w = _mk_worker(brown, ring, 1, dirty=dirty)
+    dirty.mark_series(keys[0], now=NOW)
+    assert w.micro_tick(now=NOW + 150) == 0  # degraded to empty tick
+    assert dirty.counts()["requeued"] == 1
+    (key, stamp), = dirty.take_all()
+    assert key == "app0" and stamp == NOW  # original stamp preserved
+    dirty.mark(key, stamp, requeue=True)
+    assert w.micro_tick(now=NOW + 150) == 1  # store healed: judged
+
+
+class _FlakySource:
+    """Delegates to a RingSource but fails the first fetch batch
+    transiently (dependency outage during a micro-tick)."""
+
+    def __init__(self, inner, fail_fetches: int):
+        self.inner = inner
+        self.fail_fetches = fail_fetches
+        self.concurrent_fetch = False
+
+    def fetch(self, url):
+        if self.fail_fetches > 0:
+            self.fail_fetches -= 1
+            raise ConnectionError("injected fetch outage")
+        return self.inner.fetch(url)
+
+    def __getattr__(self, name):
+        # hist_columns / hist_coverage / ingest_debug_state pass through
+        return getattr(self.inner, name)
+
+
+def test_micro_tick_fetch_outage_releases_docs_and_requeues_arrival():
+    """Satellite pin: a dependency outage during a micro-tick RELEASES
+    the dirty docs un-judged — status back to preprocess_completed,
+    claimable by the next sweep — and the arrival returns to the dirty
+    set with its original stamp."""
+    store, ring, keys, ht, ct = _build_push_fleet(1)
+    dirty = DirtySet()
+    cfg = BrainConfig(
+        algorithm="moving_average_all", season_steps=24, max_cache_size=16
+    )
+    flaky = _FlakySource(RingSource(ring, fallback=None), fail_fetches=1)
+    w = BrainWorker(
+        store, flaky, config=cfg, claim_limit=4,
+        worker_id="flaky-w", dirty=dirty,
+    )
+    dirty.mark_series(keys[0], now=NOW)
+    w.micro_tick(now=NOW + 150)
+    # released un-judged: claimable (preprocess_completed), no verdict
+    doc = store._docs["job-0"]
+    assert doc.status == STATUS_PREPROCESS_COMPLETED
+    assert doc.anomaly_info is None
+    assert w._degrade.stats.docs_snapshot().get("fetch_released") == 1
+    # the arrival survived with its original stamp
+    (key, stamp), = dirty.take_all()
+    assert key == "app0" and stamp == NOW
+    # next micro-tick (dependency healed) judges it for real
+    dirty.mark(key, stamp, requeue=True)
+    assert w.micro_tick(now=NOW + 150) == 1
+
+
+def _hist_samples(registry, name, labels):
+    for metric in registry.collect():
+        for s in metric.samples:
+            if s.name == name and all(
+                s.labels.get(k) == v for k, v in labels.items()
+            ):
+                return s.value
+    return None
+
+
+def test_verdict_latency_histogram_micro_and_sweep_paths():
+    from prometheus_client import CollectorRegistry
+
+    from foremast_tpu.observe.gauges import WorkerMetrics
+
+    registry = CollectorRegistry()
+    metrics = WorkerMetrics(registry=registry)
+    store, ring, keys, ht, ct = _build_push_fleet(2)
+    dirty = DirtySet()
+    w = _mk_worker(store, ring, 2, dirty=dirty, metrics=metrics)
+    # arrival ~1.2 s ago on the REAL wall clock (the observation side
+    # runs on time.time(); the judgment 'now' stays the fleet's clock)
+    dirty.mark("app0", time.time() - 1.2)
+    assert w.micro_tick(now=NOW + 150) == 1
+    n_micro = _hist_samples(
+        registry, "foremast_verdict_latency_seconds_count",
+        {"path": "micro"},
+    )
+    s_micro = _hist_samples(
+        registry, "foremast_verdict_latency_seconds_sum",
+        {"path": "micro"},
+    )
+    assert n_micro == 1 and 1.0 <= s_micro <= 30.0
+    # a FULL tick drains whatever the micro-ticks missed: path="sweep"
+    dirty.mark("app1", time.time() - 0.5)
+    assert w.tick(now=NOW + 150) >= 1
+    assert (
+        _hist_samples(
+            registry, "foremast_verdict_latency_seconds_count",
+            {"path": "sweep"},
+        )
+        == 1
+    )
+    assert _hist_samples(
+        registry, "foremast_microtick_docs_total", {}
+    ) == 1
+
+
+# ---------------------------------------------------------------------------
+# streaming watch against the fake kube server
+# ---------------------------------------------------------------------------
+
+
+def _dep(name, ns="ns", labels=None):
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": ns,
+            "uid": f"uid-{name}",
+            **({"labels": labels} if labels else {}),
+        }
+    }
+
+
+def _informer(srv, events):
+    from foremast_tpu.reactive.watchstream import StreamingInformer
+    from foremast_tpu.watch.kubeapi import HttpKube
+
+    kube = HttpKube(base_url=srv.url, token="t")
+    return StreamingInformer(
+        kube,
+        lambda e, d, old: events.append((e, d["metadata"]["name"])),
+    )
+
+
+def test_watch_stream_dispatches_on_arrival():
+    events = []
+    with FakeKubeServer() as srv:
+        srv.state.put("deployments", "ns", _dep("d1"))
+        inf = _informer(srv, events)
+        inf.resync()
+        assert events == [("add", "d1")]
+
+        def later():
+            time.sleep(0.15)
+            srv.state.put("deployments", "ns", _dep("d2"))
+
+        t = threading.Thread(target=later)
+        t.start()
+        t0 = time.monotonic()
+        seen_at = None
+        # the event must arrive well inside the window, not at its end
+        assert inf.consume(1.0, stall_margin=1.0) >= 1
+        t.join()
+        assert ("add", "d2") in events
+        # a subsequent update dispatches too, with the previous object
+        srv.state.put("deployments", "ns", _dep("d2", labels={"v": "2"}))
+        assert inf.consume(1.0, stall_margin=1.0) >= 1
+        assert events[-1] == ("update", "d2")
+        assert inf.counts["events"] >= 2
+
+
+def test_watch_stream_resume_after_torn_disconnect_no_loss():
+    events = []
+    with FakeKubeServer() as srv:
+        inf = _informer(srv, events)
+        inf.resync()
+        srv.state.put("deployments", "ns", _dep("d1"))
+        srv.state.put("deployments", "ns", _dep("d2"))
+        # first event streams whole, second tears mid-JSON-line
+        srv.state.add_watch_fault(disconnect=True, after_events=1)
+        inf.consume(1.0, stall_margin=0.5)
+        assert events == [("add", "d1")]
+        # resume from the last APPLIED rv: d2 arrives exactly once
+        inf.consume(1.0, stall_margin=0.5)
+        assert events == [("add", "d1"), ("add", "d2")]
+
+
+def test_watch_stream_410_gone_relists_and_recovers():
+    events = []
+    with FakeKubeServer() as srv:
+        srv.state.put("deployments", "ns", _dep("d1"))
+        inf = _informer(srv, events)
+        inf.resync()
+        # changes land while the stream is down, then the resume rv
+        # expires: consume must re-list and DIFF (no loss, no dup)
+        srv.state.put("deployments", "ns", _dep("d2"))
+        srv.state.add_watch_fault(gone=True)
+        inf.consume(0.5, stall_margin=0.5)
+        assert inf.counts["restart_gone"] == 1
+        assert events == [("add", "d1"), ("add", "d2")]
+        # the informer is live again: new events stream normally
+        srv.state.put("deployments", "ns", _dep("d3"))
+        inf.consume(0.5, stall_margin=0.5)
+        assert ("add", "d3") in events
+
+
+def test_watch_stream_natural_compaction_answers_410():
+    events = []
+    with FakeKubeServer() as srv:
+        srv.state.watch_cap = 4
+        inf = _informer(srv, events)
+        inf.resync()  # rv = 0-ish baseline
+        for i in range(12):  # blow past the event window
+            srv.state.put("deployments", "ns", _dep(f"d{i}"))
+        inf.consume(0.5, stall_margin=0.5)
+        # the stale resume point got 410; the re-list recovered ALL
+        # twelve deployments exactly once each
+        assert inf.counts["restart_gone"] == 1
+        adds = sorted(n for e, n in events if e == "add")
+        assert adds == sorted(f"d{i}" for i in range(12))
+
+
+def test_watch_stream_gone_with_failed_relist_recovers_next_window():
+    """410 whose recovery re-list ALSO fails (apiserver still down at
+    that instant) must not park the stream until the 30 s repair
+    sweep: the next consume() retries the list and detection resumes
+    the moment the server does."""
+    events = []
+    with FakeKubeServer() as srv:
+        inf = _informer(srv, events)
+        inf.resync()
+        srv.state.put("deployments", "ns", _dep("d1"))
+        # the 410 fires, then the recovery re-list fails once
+        srv.state.add_watch_fault(gone=True)
+        real_list = inf.kube.list_deployments_rv
+        failed = []
+
+        def flaky_list(ns=None):
+            if not failed:
+                failed.append(1)
+                raise ConnectionError("injected list outage")
+            return real_list(ns)
+
+        inf.kube.list_deployments_rv = flaky_list
+        inf.consume(0.5, stall_margin=0.5)
+        assert inf.counts["restart_gone"] == 1
+        assert events == []  # recovery list failed; nothing delivered
+        # server healed: the NEXT window re-lists and delivers
+        inf.consume(0.5, stall_margin=0.5)
+        assert events == [("add", "d1")]
+
+
+def test_watch_stream_midstream_error_event_counts_error_restart():
+    """A non-410 mid-stream ERROR event (etcd leader change, internal
+    server failure) is an ERROR restart, never a benign clean end —
+    the runbook keys on foremast_watch_stream_restarts{reason}."""
+    events = []
+    with FakeKubeServer() as srv:
+        inf = _informer(srv, events)
+        inf.resync()
+        srv.state.put("deployments", "ns", _dep("d1"))
+        srv.state.add_watch_fault(error_code=500)
+        inf.consume(0.5, stall_margin=0.5)
+        assert inf.counts["restart_error"] == 1
+        assert inf.counts["restart_end"] == 0
+        inf.consume(0.5, stall_margin=0.5)
+        assert events == [("add", "d1")]
+
+
+def test_watch_stream_midstream_410_event_relists():
+    """The apiserver's OTHER 410 shape — a 200 stream that opens and
+    immediately writes the ERROR/code-410 event — takes the same
+    re-list recovery as an answered 410."""
+    events = []
+    with FakeKubeServer() as srv:
+        srv.state.put("deployments", "ns", _dep("d1"))
+        inf = _informer(srv, events)
+        inf.resync()
+        srv.state.put("deployments", "ns", _dep("d2"))
+        srv.state.add_watch_fault(error_code=410)
+        inf.consume(0.5, stall_margin=0.5)
+        assert inf.counts["restart_gone"] == 1
+        assert events == [("add", "d1"), ("add", "d2")]
+
+
+def test_watch_stream_stall_detected_and_recovered():
+    events = []
+    with FakeKubeServer() as srv:
+        inf = _informer(srv, events)
+        inf.resync()
+        srv.state.put("deployments", "ns", _dep("d1"))
+        srv.state.add_watch_fault(stall_seconds=5.0, after_events=0)
+        t0 = time.monotonic()
+        inf.consume(1.0, stall_margin=0.5)
+        # the stall margin fired well before the 5 s injected stall
+        assert time.monotonic() - t0 < 4.0
+        assert inf.counts["restart_stall"] == 1
+        assert events == []
+        inf.consume(1.0, stall_margin=0.5)
+        assert events == [("add", "d1")]
+
+
+def test_watch_answered_4xx_never_opens_the_kube_breaker():
+    """A config error on the watch path (RBAC 403 on every reconnect)
+    must not open the SHARED kube breaker and short-circuit the whole
+    controller: an answered non-transient status is proof the endpoint
+    is alive (_req's policy); a transport failure still counts."""
+    import urllib.error
+
+    from foremast_tpu.chaos.breaker import CircuitBreaker
+    from foremast_tpu.watch.kubeapi import HttpKube
+
+    with FakeKubeServer() as srv:
+        breaker = CircuitBreaker("kube", failure_threshold=2)
+        kube = HttpKube(base_url=srv.url, token="t", breaker=breaker)
+        for _ in range(4):
+            srv.state.add_fault(
+                path="deployments", method="GET", status=403
+            )
+            with pytest.raises(urllib.error.HTTPError):
+                list(
+                    kube.watch_deployments(
+                        resource_version="1", timeout_seconds=1,
+                        stall_margin=0.5,
+                    )
+                )
+        assert breaker.state == "closed"
+    # transport failures DO count: the server is gone now
+    for _ in range(2):
+        with pytest.raises(OSError):
+            list(
+                kube.watch_deployments(
+                    resource_version="1", timeout_seconds=1,
+                    stall_margin=0.5,
+                )
+            )
+    assert breaker.state == "open"
+
+
+def test_watch_plane_selects_streaming_informer():
+    from foremast_tpu.reactive.watchstream import StreamingInformer
+    from foremast_tpu.watch.kubeapi import HttpKube, InMemoryKube
+    from foremast_tpu.watch.plane import WatchPlane
+
+    with FakeKubeServer() as srv:
+        plane = WatchPlane(
+            HttpKube(base_url=srv.url, token="t"), stream=True
+        )
+        assert plane.stream
+        assert isinstance(plane.informer, StreamingInformer)
+        state = plane.debug_state()
+        assert state["watch_stream"] is True and "stream" in state
+    # InMemoryKube cannot stream: the poll informer stays, silently
+    plane = WatchPlane(InMemoryKube(), stream=True)
+    assert not plane.stream
+
+
+def test_watch_plane_run_stream_dispatches_and_stops():
+    """One run_stream pass against the real fake server: a deployment
+    applied mid-run reaches the handler without waiting for a resync,
+    and the stop callable exits the loop."""
+    from foremast_tpu.watch.kubeapi import HttpKube
+    from foremast_tpu.watch.plane import WatchPlane
+
+    events = []
+    with FakeKubeServer() as srv:
+        plane = WatchPlane(
+            HttpKube(base_url=srv.url, token="t"), stream=True
+        )
+        # observe the raw informer events (barrelman needs namespace
+        # annotations + CRDs; the dispatch path is what this pins)
+        plane.informer.handler = lambda e, d, old: events.append(
+            (e, d["metadata"]["name"])
+        )
+        rounds = []
+
+        def stop():
+            rounds.append(1)
+            if len(rounds) == 2:
+                srv.state.put("deployments", "ns", _dep("live"))
+            return len(rounds) > 3
+
+        plane.run_stream(stop)
+        assert ("add", "live") in events
