@@ -602,6 +602,31 @@ def run_child(args) -> int:
         except (OSError, AttributeError):
             pass  # non-Linux: run unpinned
 
+    # Device-mesh sharded-judge variant (ISSUE 13): shard each worker's
+    # judge over an N-device local mesh. On real TPU hosts the devices
+    # exist; on the CPU-host floor they are forced virtual devices (the
+    # same stand-in tier-1 parity uses). MUST happen before jax imports.
+    if args.device_mesh > 1:
+        # JAX runtime controls, not foremast knobs (the conftest.py
+        # precedent): read only to decide whether virtual devices must
+        # stand in for real chips on a CPU host
+        plat = os.environ.get("JAX_PLATFORMS", "")  # foremast: ignore[env-contract]
+        flags = os.environ.get("XLA_FLAGS", "")  # foremast: ignore[env-contract]
+        if (
+            plat.startswith("cpu")
+            and "xla_force_host_platform_device_count" not in flags
+        ):
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.device_mesh}"
+            ).strip()
+        os.environ["FOREMAST_DEVICE_MESH"] = str(args.device_mesh)
+    else:
+        # explicit OFF for the baseline runs: the pytest smoke inherits
+        # an 8-virtual-device XLA_FLAGS from conftest, and "auto" would
+        # silently shard the unsharded comparison arm
+        os.environ["FOREMAST_DEVICE_MESH"] = "0"
+
     from foremast_tpu.config import BrainConfig
     from foremast_tpu.ingest import RingSource, RingStore, start_ingest_server
     from foremast_tpu.jobs.worker import BrainWorker
@@ -679,6 +704,7 @@ def run_child(args) -> int:
         c0 = time.process_time()
         n = worker.tick()
         dt = time.perf_counter() - t0
+        dm = worker._device_mesh_state()
         store.report_tick(
             worker=worker_id, tag=tag, docs=n, seconds=round(dt, 4),
             cpu_seconds=round(time.process_time() - c0, 4),
@@ -687,6 +713,10 @@ def run_child(args) -> int:
                 k: round(v, 4)
                 for k, v in tracer.last_stage_seconds.items()
             },
+            # cumulative device-mesh counters (pad fraction, H2D place,
+            # host gather) — the parent's roofline account reads the
+            # final warm tick's values
+            device_mesh=dm,
         )
         return n, dt
 
@@ -814,6 +844,7 @@ def run(
     max_stuck: float = 3.0,
     replicas: int = 128,
     timeout: float = 1800.0,
+    device_mesh: int = 0,
 ) -> dict:
     kill = kill and workers > 1
     server = StoreServer(replicas=replicas)
@@ -822,7 +853,13 @@ def run(
     build_fleet(server.store, services, aliases, hist_len, cur_len, now)
 
     env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
+    # children default to CPU, but an explicit parent platform (the TPU
+    # tunnel run ROADMAP item 2 asks for: JAX_PLATFORMS=axon) passes
+    # through — otherwise the sharded variant would silently benchmark
+    # virtual CPU devices and record them as chip numbers
+    env["JAX_PLATFORMS"] = (
+        os.environ.get("JAX_PLATFORMS") or "cpu"  # foremast: ignore[env-contract]
+    )
     env.pop("FOREMAST_INGEST", None)
     procs = []
     for i in range(workers):
@@ -834,6 +871,7 @@ def run(
             "--lease-seconds", str(lease_seconds),
             "--max-stuck", str(max_stuck),
             "--replicas", str(replicas),
+            "--device-mesh", str(device_mesh),
         ]
         if cpus_per_worker:
             cmd += [
@@ -1005,9 +1043,80 @@ def run(
             "seconds": r["seconds"],
             **({"stages": r["stages"]} if r.get("stages") else {}),
         }
+    # Roofline account for the sharded-judge variant (ISSUE 13): where
+    # does a warm sharded tick's wall clock go — H2D placement, device
+    # dispatch, host gather (which absorbs the deferred execution), or
+    # host decode. Cumulative counters come from the FINAL warm tick's
+    # device_mesh report; per-stage seconds sum over the warm ticks.
+    roofline = None
+    if device_mesh > 1:
+        # per-worker cumulative counters: warm-phase deltas = last warm
+        # report minus last prewarm report (cold/prewarm H2D must not
+        # pollute the steady-state account)
+        base: dict[str, dict] = {}
+        final: dict[str, dict] = {}
+        for r in server.tick_reports():
+            if not r.get("device_mesh"):
+                continue
+            if r["tag"].startswith("warm"):
+                final[r["worker"]] = r["device_mesh"]
+            elif r["tag"] in ("cold", "prewarm"):
+                # only PRE-warm snapshots form the baseline: kill runs
+                # emit rebal-* reports AFTER the warm phase, and using
+                # those as base would make every delta negative (and
+                # the <2% pad assert vacuous)
+                base[r["worker"]] = r["device_mesh"]
+        assert final, "sharded variant produced no device_mesh reports"
+
+        def delta(key):
+            return sum(
+                d[key] - base.get(w, {}).get(key, 0)
+                for w, d in final.items()
+            )
+
+        stages: dict[str, float] = {}
+        for r in server.tick_reports():
+            if r["tag"].startswith("warm"):
+                for k, v in (r.get("stages") or {}).items():
+                    stages[k] = stages.get(k, 0.0) + v
+        h2d_s = delta("place_seconds")
+        h2d_b = delta("place_bytes")
+        gat_s = delta("fetch_seconds")
+        gat_b = delta("fetch_bytes")
+        pad = delta("pad_rows_total")
+        rows = delta("batch_rows_total")
+        dms = list(final.values())
+        roofline = {
+            "devices_per_worker": dms[-1]["devices"],
+            "h2d_seconds": round(h2d_s, 4),
+            "h2d_mb_per_s": (
+                round(h2d_b / h2d_s / 1e6, 1) if h2d_s else None
+            ),
+            "gather_seconds": round(gat_s, 4),
+            "gather_mb_per_s": (
+                round(gat_b / gat_s / 1e6, 1) if gat_s else None
+            ),
+            "dispatch_seconds": round(stages.get("score", 0.0), 4),
+            "decode_seconds": round(stages.get("decode", 0.0), 4),
+            "arena_assemble_seconds": round(
+                stages.get("arena_assemble", 0.0), 4
+            ),
+            "padded_row_fraction": (
+                round(pad / rows, 5) if rows else None
+            ),
+            "arena_replica_bytes": dms[-1]["arena_replica_bytes"],
+            "arena_total_device_bytes": dms[-1][
+                "arena_total_device_bytes"
+            ],
+        }
+        if services >= 16384:
+            # acceptance bar: padding must stay noise at fleet shapes
+            assert roofline["padded_row_fraction"] < 0.02, roofline
     return {
         "workers": workers,
         "cpus_per_worker": cpus_per_worker or None,
+        "device_mesh": device_mesh or None,
+        "roofline": roofline,
         "worker_ticks": worker_ticks,
         "services": services,
         "aliases": aliases,
@@ -1045,6 +1154,13 @@ def main(argv=None):
     )
     ap.add_argument(
         "--small", action="store_true", help="CPU smoke shapes (CI)"
+    )
+    ap.add_argument(
+        "--device-mesh", dest="device_mesh", type=int, default=0,
+        help="shard every worker's judge over an N-device local mesh "
+        "(ISSUE 13 sharded-judge variant; forces N virtual host "
+        "devices on CPU platforms, spans real chips on TPU hosts). "
+        "0 = single-device judges (the comparison baseline)",
     )
     ap.add_argument(
         "--cpus-per-worker", type=int, default=-1,
@@ -1100,15 +1216,22 @@ def main(argv=None):
         row = run(
             args.services, args.aliases, args.hist_len, args.cur_len,
             args.warm_ticks, w, kill, cpus_per_worker=cpus_per_worker,
+            device_mesh=args.device_mesh,
         )
         rows.append(row)
         print(json.dumps(row), flush=True)
     base = rows[0]["fleet_warm_windows_per_sec"]
     peak = rows[-1]["fleet_warm_windows_per_sec"]
     summary = {
-        "config": "s-mesh-scaleout",
+        "config": (
+            "s-mesh-scaleout-sharded"
+            if args.device_mesh > 1
+            else "s-mesh-scaleout"
+        ),
         "services": args.services,
         "windows": args.services * args.aliases,
+        "device_mesh": args.device_mesh or None,
+        "roofline": rows[-1]["roofline"],
         "worker_counts": worker_counts,
         "fleet_warm_windows_per_sec": {
             str(r["workers"]): r["fleet_warm_windows_per_sec"] for r in rows

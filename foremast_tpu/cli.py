@@ -193,6 +193,40 @@ def _make_store(elastic_url: str | None, chaos=None, breaker=None, stop=None):
     return store
 
 
+def _mesh_member(store, worker_id: str, chaos_plan=None):
+    """THE worker-mesh Membership + MeshRouter construction — shared by
+    the single-worker branch and the mesh-of-pods leader (ISSUE 13), so
+    the lease/replica/route-label env resolution and the chaos clock
+    wiring can never drift between the two deployment modes."""
+    import os
+
+    from foremast_tpu.mesh import Membership, MeshRouter
+
+    mesh_kw = {}
+    if chaos_plan is not None:
+        # chaos "clock" edge: skew rules shift the clock this member
+        # stamps leases with AND reads peers' leases by (membership.py
+        # documents the tolerance: renewal every lease/3 means a reader
+        # surviving skew < 2/3 lease)
+        mesh_kw["clock"] = chaos_plan.edge("clock").clock()
+    membership = Membership(
+        store,
+        worker_id,
+        lease_seconds=float(
+            os.environ.get("FOREMAST_MESH_LEASE_SECONDS", "") or "15"
+        ),
+        **mesh_kw,
+    )
+    router = MeshRouter(
+        membership,
+        replicas=_env_int("FOREMAST_MESH_REPLICAS", 64),
+        route_label=(
+            os.environ.get("FOREMAST_MESH_ROUTE_LABEL", "") or "app"
+        ),
+    )
+    return membership, router
+
+
 def cmd_serve(args: argparse.Namespace) -> int:
     from foremast_tpu.observe import setup_logging
     from foremast_tpu.observe.spans import Tracer
@@ -395,14 +429,25 @@ def cmd_worker(args: argparse.Namespace) -> int:
     univariate = None
     pod_mode = False
     if args.sharded:
-        from foremast_tpu.parallel import ShardedJudge, init_distributed, make_global_mesh
+        from foremast_tpu.parallel import init_distributed, make_global_mesh
+        from foremast_tpu.parallel.batch import sharded_univariate
 
         # MUST run before any jax computation — including an orbax restore
         init_distributed()  # no-op single-host; JAX_COORDINATOR_* envs for pods
-        univariate = ShardedJudge(config, mesh=make_global_mesh())
+        univariate = sharded_univariate(config, mesh=make_global_mesh())
         import jax as _jax_sh
 
         pod_mode = _jax_sh.process_count() > 1
+    else:
+        # single-process worker: the judge spans the local device mesh
+        # by default (ISSUE 13, FOREMAST_DEVICE_MESH — "auto" = all
+        # local devices; a stock 1-device host resolves to None and
+        # keeps the plain single-device judge). ONE shared resolver
+        # with BrainWorker's device_mesh="env" path — the rules must
+        # never drift between CLI and library construction.
+        from foremast_tpu.parallel.batch import sharded_univariate
+
+        univariate = sharded_univariate(config)
     judge = MultivariateJudge(config, univariate=univariate)
 
     if pod_mode:
@@ -543,12 +588,20 @@ def cmd_worker(args: argparse.Namespace) -> int:
     snap_dir = os.environ.get("FOREMAST_SNAPSHOT_DIR") or None
     snapshotter = None
     if mesh_on and pod_mode:
+        # mesh-of-pods (ISSUE 13): each worker-mesh member is one POD —
+        # a PodWorker whose device program spans its hosts' chips. Only
+        # the LEADER holds the membership lease and evaluates the claim
+        # filter (it is the only process with a real store); the
+        # filtered claim set broadcasts to the followers exactly like
+        # any other claim, so partitioning never shapes follower
+        # control flow. Handoff/ingest stay leader-local pull-mode in
+        # pods (the transfer plane needs a receiver per member —
+        # docs/operations.md "Device mesh").
         print(
-            "FOREMAST_MESH=1 ignored in pod mode (mesh shards fleets "
-            "across independent workers; a pod is one logical worker)",
+            "mesh-of-pods: this pod joins the worker mesh as ONE "
+            "member (leader-held lease + claim filter)",
             file=sys.stderr,
         )
-        mesh_on = False
     if micro_seconds > 0 and pod_mode:
         # pod ticks are SPMD-broadcast collectives; a leader-local
         # micro-tick would desync followers — wiring micro-ticks
@@ -599,6 +652,22 @@ def cmd_worker(args: argparse.Namespace) -> int:
                 pod_inner, args.gauge_port,
                 chaos=_edge("receiver"), degrade=degrade,
             )
+        pod_worker_id = None
+        if mesh_on and store is not None:
+            # the leader's seat in the worker mesh (mesh-of-pods): the
+            # membership record and the claim stamps share one id, and
+            # the MeshNode's claim_filter rides LeaderStore.claim so
+            # the whole pod ticks over this member's partition only
+            import uuid as _uuid
+
+            from foremast_tpu.mesh import MeshNode
+
+            pod_worker_id = f"pod-{_uuid.uuid4().hex[:8]}"
+            pod_membership, pod_router = _mesh_member(
+                store, pod_worker_id, chaos_plan
+            )
+            mesh_node = MeshNode(pod_membership, pod_router)
+            mesh_node.start()
         worker = PodWorker(
             LeaderStore(store),
             LeaderSource(pod_inner),
@@ -608,7 +677,9 @@ def cmd_worker(args: argparse.Namespace) -> int:
             on_verdict=on_verdict,
             metrics=worker_metrics,
             tracer=tracer,
+            mesh=mesh_node,
             degrade=degrade,
+            **({"worker_id": pod_worker_id} if pod_worker_id else {}),
         )
     else:
         # mesh identity is minted HERE so the membership record and the
@@ -642,31 +713,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
             worker_id = _persistent_worker_id(snap_dir, worker_id)
         membership = router = None
         if mesh_on:
-            from foremast_tpu.mesh import Membership, MeshRouter
-
-            mesh_kw = {}
-            if chaos_plan is not None:
-                # chaos "clock" edge: skew rules shift the clock this
-                # member stamps leases with AND reads peers' leases by
-                # (membership.py documents the tolerance: renewal every
-                # lease/3 means a reader surviving skew < 2/3 lease)
-                mesh_kw["clock"] = chaos_plan.edge("clock").clock()
-            membership = Membership(
-                store,
-                worker_id,
-                lease_seconds=float(
-                    os.environ.get("FOREMAST_MESH_LEASE_SECONDS", "")
-                    or "15"
-                ),
-                **mesh_kw,
-            )
-            router = MeshRouter(
-                membership,
-                replicas=_env_int("FOREMAST_MESH_REPLICAS", 64),
-                route_label=(
-                    os.environ.get("FOREMAST_MESH_ROUTE_LABEL", "") or "app"
-                ),
-            )
+            membership, router = _mesh_member(store, worker_id, chaos_plan)
         # planned handoff (ISSUE 11): rebalance on planned scale events
         # becomes a state TRANSFER — the joiner fences until the current
         # owners stream it its partition, SIGTERM drains instead of

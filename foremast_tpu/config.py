@@ -513,6 +513,32 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "logged, never silent",
     ),
     EnvKnob(
+        "FOREMAST_DEVICE_MESH",
+        "auto",
+        "str",
+        "device mesh the worker's judge partitions over (ISSUE 13): "
+        "`auto` (default) = all local devices on the data axis — a "
+        "1-device resolution IS the plain single-device judge, so "
+        "stock CPU hosts are unaffected; `0`/`off` disables mesh "
+        "placement entirely; `N` puts N devices on the data axis; "
+        "`NxM` is an explicit (data, model) grid. The warm columnar "
+        "paths (univariate + joint from-rows) shard their batch "
+        "leading axis over `data` with state arenas REPLICATED per "
+        "device (HBM cost = arena bytes × devices, accounted on "
+        "`/debug/state device_mesh`). Malformed values warn and fall "
+        "back to `auto`. Pod mode (`--sharded`) spans the GLOBAL mesh "
+        "instead and ignores this knob",
+    ),
+    EnvKnob(
+        "FOREMAST_DEVICE_MESH_MODEL",
+        "1",
+        "int",
+        "model-axis width for `FOREMAST_DEVICE_MESH=auto`/`N` "
+        "spellings (tensor parallelism for the learned detectors; the "
+        "`NxM` spelling overrides this). Must stay inside one host's "
+        "ICI domain — see parallel/mesh.py make_global_mesh",
+    ),
+    EnvKnob(
         "FOREMAST_BF16_DELTA",
         "1",
         "bool",

@@ -361,6 +361,13 @@ class RowArena:
                 self.misses += 1
         return rows, scatter
 
+    def device_bytes(self) -> int:
+        """HBM footprint of ONE replica of this arena's device buffers.
+        Under a replicated mesh placement the total cost is this times
+        the device count (the worker's device_mesh varz does that
+        multiplication — ISSUE 13 HBM accounting)."""
+        return self.cap * self.row_bytes
+
     def counters(self) -> dict:
         return {
             "hits": self.hits,

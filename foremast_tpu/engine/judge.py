@@ -339,6 +339,15 @@ class HealthJudge:
             "evictions": 0,
             "fallbacks": 0,
         }
+        # Columnar batch-padding accounting (ISSUE 13): rows dispatched
+        # vs rows that were padding (bucket rounding + data-axis
+        # rounding). Exposed through the worker's device_mesh varz /
+        # metrics so the <2% padded-row overhead bar is observable, not
+        # assumed. Plain HealthJudge counts too (pow2 bucketing pads
+        # even without a mesh) — the fraction is a property of the
+        # dispatch shape, not of sharding.
+        self.pad_rows_total = 0
+        self.batch_rows_total = 0
 
     def judge(self, tasks: Sequence[MetricTask]) -> list[MetricVerdict]:
         """Score a set of metric tasks, batching same-shaped buckets."""
@@ -378,6 +387,19 @@ class HealthJudge:
         """Device-placement hook — identity here (default device);
         parallel.ShardedJudge overrides it to shard over the mesh."""
         return batch
+
+    def _place_cols(self, *arrays):
+        """Placement hook for bare leading-axis-[B] columnar operands
+        (the joint from-rows paths' cur/mask/x buffers, which never ride
+        a ScoreBatch) — identity here; parallel.ShardedJudge device_puts
+        each with its leading axis over the mesh's data axis."""
+        return arrays
+
+    def _batch_multiple(self) -> int:
+        """Every dispatched batch's leading axis must be a multiple of
+        this (1 here; ShardedJudge returns its data-axis size so XLA
+        partitions rows evenly with fully-masked pad rows)."""
+        return 1
 
     def _arena_for(self, m_need: int):
         """The (algorithm, season) arena, grown to season width m_need.
@@ -706,6 +728,17 @@ class HealthJudge:
         cfg = self.config
         b0, tc = values.shape
         rows_b = bucket_length(b0)
+        # data-axis rounding on top of the pow2 bucket (ISSUE 13): a
+        # sharded judge needs B divisible by the mesh's data axis so
+        # every device holds an identical-shape shard. For power-of-two
+        # axes this is already true past 8 rows; the general form keeps
+        # non-pow2 meshes (a 6-chip host) compiling a bounded shape set
+        # (pow2 buckets x one constant multiple).
+        mult = self._batch_multiple()
+        if mult > 1 and rows_b % mult:
+            rows_b += mult - rows_b % mult
+        self.batch_rows_total += rows_b
+        self.pad_rows_total += rows_b - b0
         if rows_b != b0:
             pad = rows_b - b0
             values = np.concatenate(
@@ -722,24 +755,29 @@ class HealthJudge:
                 gap_steps = np.concatenate(
                     [gap_steps, np.zeros(pad, np.int32)]
                 )
+        # HOST buffers all the way into _place: committing them with
+        # jnp.asarray first would make a sharded judge's device_put a
+        # second full-batch copy (default device -> mesh reshard) on
+        # every warm tick — the placement hook must see numpy so the
+        # one H2D lands directly in the sharded layout. The identity
+        # judge is unchanged: the jit call commits uncommitted numpy
+        # operands exactly as jnp.asarray did (same weak-type casts).
         batch = scoring.ScoreBatch(
             historical=MetricWindows(
-                values=jnp.zeros((rows_b, 0), jnp.float32),
-                mask=jnp.zeros((rows_b, 0), bool),
+                values=np.zeros((rows_b, 0), np.float32),
+                mask=np.zeros((rows_b, 0), bool),
                 times=None,
             ),
-            current=MetricWindows(
-                values=jnp.asarray(values), mask=jnp.asarray(mask), times=None
-            ),
+            current=MetricWindows(values=values, mask=mask, times=None),
             baseline=MetricWindows(
-                values=jnp.zeros((rows_b, tc), jnp.float32),
-                mask=jnp.zeros((rows_b, tc), bool),
+                values=np.zeros((rows_b, tc), np.float32),
+                mask=np.zeros((rows_b, tc), bool),
                 times=None,
             ),
-            threshold=jnp.asarray(thr),
-            bound=jnp.asarray(bound),
-            min_lower_bound=jnp.asarray(mlb),
-            min_points=jnp.full((rows_b,), cfg.min_historical_points, jnp.int32),
+            threshold=thr,
+            bound=bound,
+            min_lower_bound=mlb,
+            min_points=np.full((rows_b,), cfg.min_historical_points, np.int32),
         )
         batch = self._place(batch)
         # Fast-path admission guarantees NO baselines, and an empty

@@ -36,6 +36,8 @@ import os
 import threading
 from collections import OrderedDict
 
+from foremast_tpu.models.cache import is_pad_fit_key
+
 # each refit must see ~this factor more points than the previous fit —
 # geometric pacing bounds a fit's lifetime refits to O(log(full/floor))
 GROWTH_FACTOR = 1.5
@@ -85,6 +87,14 @@ class RefineBook:
     # -- write side (fetch-pool threads) ---------------------------------
 
     def _note(self, bkey: tuple, rec: dict) -> None:
+        # defense in depth (ISSUE 13 satellite): a batch-padding fit
+        # key must never become a provisional record — refinement would
+        # chase a document that does not exist, and the provisional
+        # gauge would count dispatch artifacts as fleet debt. The
+        # worker's note_* calls are keyed off real doc aliases today;
+        # this guard keeps that true for every future caller too.
+        if is_pad_fit_key(bkey):
+            return
         puts: list = []
         dels: list = []
         with self._lock:

@@ -139,6 +139,7 @@ class BrainWorker:
         mesh=None,  # mesh.node.MeshNode (optional fleet partitioning)
         degrade: Degradation | None = None,
         dirty=None,  # reactive.DirtySet (optional: micro-tick plane)
+        device_mesh="env",  # jax.sharding.Mesh | None | "env" (ISSUE 13)
     ):
         """`band_mode` controls how much of the model band each verdict
         carries back from the device: "last" (default — only the final
@@ -151,10 +152,27 @@ class BrainWorker:
         if judge is None:
             # MultivariateJudge dispatches by metric count (design.md:57-93:
             # 1 -> univariate, 2 -> bivariate normal, 3+ -> LSTM) and
-            # delegates univariate jobs to a plain HealthJudge
+            # delegates univariate jobs to a HealthJudge. ISSUE 13: that
+            # univariate engine spans the worker's DEVICE MESH by default
+            # (`device_mesh`: a jax Mesh, None to force single-device, or
+            # "env" to resolve FOREMAST_DEVICE_MESH — "auto" = all local
+            # devices; a 1-device resolution IS the single-device judge,
+            # so stock CPU hosts pay zero placement overhead). Sharding
+            # is placement, not semantics: arenas replicate, batches
+            # partition their leading axis, every cache/admission/
+            # degradation contract is unchanged.
             from foremast_tpu.engine.multivariate import MultivariateJudge
 
-            judge = MultivariateJudge(self.config)
+            if device_mesh is None:
+                univariate = None
+            else:
+                from foremast_tpu.parallel.batch import sharded_univariate
+
+                univariate = sharded_univariate(
+                    self.config,
+                    mesh=None if device_mesh == "env" else device_mesh,
+                )
+            judge = MultivariateJudge(self.config, univariate=univariate)
         self.judge = judge
         self.worker_id = worker_id or f"brain-{uuid.uuid4().hex[:8]}"
         self.claim_limit = claim_limit
@@ -2320,6 +2338,12 @@ class BrainWorker:
                 )
             return
         self._maybe_persist()
+        if self.metrics is not None and hasattr(
+            self.metrics, "observe_device_mesh"
+        ):
+            dm = self._device_mesh_state()
+            if dm is not None:
+                self.metrics.observe_device_mesh(dm)
         self._last_tick = {
             "at": time.time(),
             "docs": n_docs,
@@ -2334,6 +2358,38 @@ class BrainWorker:
             fast_path=n_fast,
             seconds=round(seconds, 4),
         )
+
+    def _device_mesh_state(self) -> dict | None:
+        """The /debug/state `device_mesh` section (ISSUE 13): mesh
+        shape, padded-row fraction across the univariate AND joint
+        columnar dispatches, replicated-arena HBM accounting (one
+        replica's bytes x device count — replication is the deliberate
+        trade from batch.py:_arena_sharding, so its cost must be
+        readable, not implied), and the H2D/gather roofline counters.
+        None when the judge is single-device."""
+        uni = self._uni
+        if uni is None or not hasattr(uni, "mesh_debug"):
+            return None
+        out = uni.mesh_debug()
+        if self._mvj is not None:
+            rows = out["batch_rows_total"] + self._mvj.batch_rows_total
+            pads = out["pad_rows_total"] + self._mvj.pad_rows_total
+            out["batch_rows_total"] = rows
+            out["pad_rows_total"] = pads
+            out["padded_row_fraction"] = (
+                round(pads / rows, 4) if rows else None
+            )
+        replica = sum(
+            a.device_bytes() for a in uni._arenas.values()
+        )
+        if self._mvj is not None:
+            replica += sum(
+                a.device_bytes()
+                for a in self._mvj._joint_arenas.values()
+            )
+        out["arena_replica_bytes"] = replica
+        out["arena_total_device_bytes"] = replica * out["devices"]
+        return out
 
     def debug_state(self) -> dict:
         """The /debug/state varz payload (observe.start_observe_server):
@@ -2407,6 +2463,11 @@ class BrainWorker:
             # LSTM-AE params + residual-MVN state); None when the judge
             # has no joint dispatch
             "joint_arena": joint_arena,
+            # device mesh (ISSUE 13, FOREMAST_DEVICE_MESH): mesh shape,
+            # padded-row fraction, replicated-arena HBM (one replica x
+            # device count), H2D/gather roofline counters; None when
+            # the judge runs single-device
+            "device_mesh": self._device_mesh_state(),
             # push-based ingest plane (FOREMAST_INGEST=1): series
             # resident, bytes, evictions, hit ratio, receiver lag,
             # subscriptions; None when the worker runs pure-pull

@@ -140,7 +140,12 @@ def detect_bivariate_from_rows(
     row-index vector — the joint counterpart of
     `scoring.score_from_arena`. Only VALID fits are ever admitted to the
     arena (the judge caches invalid fits nowhere), so the gathered state
-    carries no validity flag."""
+    carries no validity flag.
+
+    Mesh contract (ISSUE 13): per-row independent along [B] — `x`/`y`/
+    `mask` may arrive with their leading axis sharded over a data axis
+    (B a multiple of it) with `mean`/`cov` replicated; the gather then
+    reads each device's local arena replica, zero collectives."""
     fit = BivariateFit(
         mean=jnp.take(mean, rows, axis=0),
         cov=jnp.take(cov, rows, axis=0),

@@ -293,3 +293,14 @@ def score_rows_cutoff(params, rows, x, mask, cutoff):
     the ~60 KB/model parameter stack. Returns (flags [S, B, T], errors)."""
     gathered = jax.tree.map(lambda leaf: jnp.take(leaf, rows, axis=0), params)
     return score_many_cutoff(gathered, x, mask, cutoff)
+
+
+# Mesh-placement contract for the from-rows entry points (ISSUE 13):
+# every computation above is per-row independent along the leading [S]
+# axis (vmapped scoring, axis-0 gathers), so callers may pass `x`/`mask`
+# with their leading axis sharded over a mesh's data axis and `params`
+# replicated (the TreeArena's placement) — XLA partitions the program
+# with zero collectives; the per-row gather runs against each device's
+# local replica. S must be a multiple of the data axis (the judge's
+# batch rounding guarantees it). Nothing here may ever reduce ACROSS
+# the [S] axis, or the contract breaks.

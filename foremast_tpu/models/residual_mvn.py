@@ -169,7 +169,13 @@ def _d2(state: MVNState, cur: jax.Array, upd: jax.Array) -> jax.Array:
     upd [B, Tc] False carries HW state THROUGH a point (it is still
     scored — the residual is measured against the un-updated prediction
     — but cannot contaminate later predictions); the phase advances
-    either way (hw_continue mask semantics)."""
+    either way (hw_continue mask semantics).
+
+    Mesh contract (ISSUE 13): per-row independent along [B] — the
+    [B*F] reshape below multiplies the leading axis, which a data-axis
+    sharding of `cur` follows cleanly (B a multiple of the axis), and
+    the per-job `linalg.solve` batches row-locally. Nothing here may
+    reduce across [B]."""
     b, f, tc = cur.shape
     a, bt, g = HW_PARAMS
     flat = cur.reshape(b * f, tc)

@@ -374,6 +374,51 @@ class WorkerMetrics:
             "documents judged by ingest-triggered micro-ticks",
             registry=reg,
         )
+        # device mesh (ISSUE 13, FOREMAST_DEVICE_MESH): the Prometheus
+        # twins of the /debug/state `device_mesh` section — mesh width,
+        # batch rows split real/pad (pad fraction = pad / (real+pad);
+        # the <2% overhead bar at fleet shapes), replicated-arena HBM
+        # (one replica x device count), and the H2D-place / host-gather
+        # roofline legs
+        self.mesh_devices = Gauge(
+            "foremast_device_mesh_devices",
+            "devices in the judge's (data x model) mesh (1 family "
+            "absent = single-device judge)",
+            registry=reg,
+        )
+        self.mesh_rows = Counter(
+            "foremast_device_mesh_rows_total",
+            "columnar batch rows dispatched over the mesh, real vs "
+            "padding (bucket + data-axis rounding)",
+            ["kind"],
+            registry=reg,
+        )
+        self.mesh_arena_bytes = Gauge(
+            "foremast_device_mesh_arena_bytes",
+            "replicated state-arena HBM across the mesh (one replica's "
+            "bytes x device count)",
+            registry=reg,
+        )
+        self.mesh_transfer_seconds = Counter(
+            "foremast_device_mesh_transfer_seconds_total",
+            "host<->device transfer wall-clock on the sharded judge, "
+            "by leg (h2d = NamedSharding placement, gather = sharded-"
+            "result fetch incl. the deferred device execution it waits "
+            "on)",
+            ["leg"],
+            registry=reg,
+        )
+        self.mesh_transfer_bytes = Counter(
+            "foremast_device_mesh_transfer_bytes_total",
+            "bytes moved by the sharded judge's host<->device legs",
+            ["leg"],
+            registry=reg,
+        )
+        self._mesh_last = {
+            "rows_real": 0, "rows_pad": 0,
+            "h2d_s": 0.0, "h2d_b": 0,
+            "gather_s": 0.0, "gather_b": 0,
+        }
 
     def observe_pipeline(self, stats) -> None:
         """Feed one slow-path tick's ChunkPipeline stats
@@ -385,6 +430,40 @@ class WorkerMetrics:
     def observe_doc(self, status: str, n_windows: int) -> None:
         self.jobs.labels(status=status).inc()
         self.windows.inc(n_windows)
+
+    def observe_device_mesh(self, state: dict) -> None:
+        """Feed the worker's cumulative device_mesh varz section
+        (BrainWorker._device_mesh_state); deltas keep the Prometheus
+        counters monotone, same discipline as observe_arena — negative
+        deltas (a new judge) clamp to zero."""
+        self.mesh_devices.set(state.get("devices", 1))
+        self.mesh_arena_bytes.set(
+            state.get("arena_total_device_bytes", 0)
+        )
+        last = self._mesh_last
+        pad = state.get("pad_rows_total", 0)
+        real = state.get("batch_rows_total", 0) - pad
+        cur = {
+            "rows_real": real,
+            "rows_pad": pad,
+            "h2d_s": state.get("place_seconds", 0.0),
+            "h2d_b": state.get("place_bytes", 0),
+            "gather_s": state.get("fetch_seconds", 0.0),
+            "gather_b": state.get("fetch_bytes", 0),
+        }
+        sinks = {
+            "rows_real": (self.mesh_rows, {"kind": "real"}),
+            "rows_pad": (self.mesh_rows, {"kind": "pad"}),
+            "h2d_s": (self.mesh_transfer_seconds, {"leg": "h2d"}),
+            "h2d_b": (self.mesh_transfer_bytes, {"leg": "h2d"}),
+            "gather_s": (self.mesh_transfer_seconds, {"leg": "gather"}),
+            "gather_b": (self.mesh_transfer_bytes, {"leg": "gather"}),
+        }
+        for k, (family, labels) in sinks.items():
+            delta = cur[k] - last[k]
+            if delta > 0:
+                family.labels(**labels).inc(delta)
+            last[k] = cur[k]
 
     def observe_arena(self, counters: dict) -> None:
         """Feed cumulative judge.device_state_counters(); deltas are

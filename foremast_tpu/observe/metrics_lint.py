@@ -49,6 +49,12 @@ ALLOWED_LABELS: dict[str, frozenset[str]] = {
     # (worker: observe/gauges.py; dirty set: reactive/dirty.py
     # ReactiveCollector; watch stream: reactive/watchstream.py)
     "foremast_verdict_latency_seconds": frozenset({"path"}),
+    # device mesh (ISSUE 13, observe/gauges.py WorkerMetrics)
+    "foremast_device_mesh_devices": frozenset(),
+    "foremast_device_mesh_rows": frozenset({"kind"}),
+    "foremast_device_mesh_arena_bytes": frozenset(),
+    "foremast_device_mesh_transfer_seconds": frozenset({"leg"}),
+    "foremast_device_mesh_transfer_bytes": frozenset({"leg"}),
     "foremast_microtick_docs": frozenset(),
     "foremast_microtick_dirty_events": frozenset({"event"}),
     "foremast_microtick_dirty_pending": frozenset(),
@@ -138,6 +144,23 @@ FAMILY_DOCS: dict[str, str] = {
     ),
     "foremast_microtick_docs": (
         "documents judged by ingest-triggered micro-ticks"
+    ),
+    "foremast_device_mesh_devices": (
+        "devices in the judge's (data x model) mesh"
+    ),
+    "foremast_device_mesh_rows": (
+        "columnar batch rows dispatched over the mesh, real vs pad "
+        "(bucket + data-axis rounding)"
+    ),
+    "foremast_device_mesh_arena_bytes": (
+        "replicated state-arena HBM: one replica's bytes x device count"
+    ),
+    "foremast_device_mesh_transfer_seconds": (
+        "sharded-judge host<->device wall-clock by leg (h2d placement "
+        "/ sharded-result gather)"
+    ),
+    "foremast_device_mesh_transfer_bytes": (
+        "bytes moved by the sharded judge's host<->device legs"
     ),
     "foremast_microtick_dirty_events": (
         "dirty-set traffic (marked/coalesced/dropped/foreign/"

@@ -16,6 +16,8 @@ while the previous result is still in flight (jax's async dispatch).
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -47,21 +49,115 @@ def shard_batch(batch: scoring.ScoreBatch, mesh) -> scoring.ScoreBatch:
     return meshlib.shard_leading(batch, mesh)
 
 
+def sharded_univariate(config: BrainConfig | None = None, mesh=None):
+    """The worker's mesh judge, or None for the identity.
+
+    THE one place a device mesh turns into a univariate judge —
+    BrainWorker (both its `device_mesh="env"` default and an explicit
+    Mesh argument) and cli.cmd_worker all construct through here, so
+    the resolution rules (auto span, 1-device identity, pod guard,
+    infeasible-grid fallback — all in mesh.worker_device_mesh) and the
+    construction/log wiring can never drift between call sites.
+    `mesh=None` resolves FOREMAST_DEVICE_MESH."""
+    import logging
+
+    if mesh is None:
+        mesh = meshlib.worker_device_mesh()
+    if mesh is None:
+        return None
+    judge = ShardedJudge(config, mesh=mesh)
+    logging.getLogger("foremast_tpu.worker").info(
+        "device mesh: judge partitioned over %s", dict(mesh.shape)
+    )
+    return judge
+
+
 class ShardedJudge(HealthJudge):
     """HealthJudge whose compiled scorer runs partitioned over a mesh.
 
-    Drop-in: same `judge(tasks) -> [MetricVerdict]` surface; inherits the
-    bucketing logic and overrides only batch placement.
+    Drop-in: same `judge(tasks) -> [MetricVerdict]` surface AND the same
+    `judge_columnar(...)` fast-tick surface (ISSUE 13): the worker's
+    whole warm path — univariate columnar plus, through `_place_cols`,
+    the joint from-rows programs — rides the mesh. Placement only:
+    batches shard their leading axis over `data`, arenas replicate
+    (`_arena_sharding`), so admission, fit-cache identity and every
+    degradation contract are untouched. A 1-device mesh is the identity
+    (the worker skips this wrapper then — parallel.mesh.
+    worker_device_mesh).
     """
 
     def __init__(self, config: BrainConfig | None = None, mesh=None):
         super().__init__(config)
         self.mesh = mesh if mesh is not None else meshlib.make_mesh()
+        self.n_data = int(self.mesh.shape[meshlib.DATA_AXIS])
+        # roofline accounting (benchmarks/scaleout_bench.py sharded
+        # variant): wall-clock + bytes of the two host<->device hops the
+        # mesh changes — H2D placement and the sharded-result gather.
+        # Dispatch/decode stay on the judge's existing stage spans.
+        self.mesh_stats = {
+            "place_seconds": 0.0,
+            "place_bytes": 0,
+            "place_calls": 0,
+            "fetch_seconds": 0.0,
+            "fetch_bytes": 0,
+        }
+
+    def _batch_multiple(self) -> int:
+        return self.n_data
+
+    def _account_place(self, t0: float, lead, leaves) -> None:
+        """Shared epilogue of both placement hooks: the acceptance
+        assert (ISSUE 13 — every local shard of the leading array holds
+        B/n_data rows) plus the roofline accounting. One body so the
+        bench's H2D leg and `foremast_device_mesh_transfer_*` can never
+        skew between the ScoreBatch and bare-operand paths."""
+        meshlib.assert_partitioned(lead, self.n_data)
+        st = self.mesh_stats
+        st["place_seconds"] += time.perf_counter() - t0
+        st["place_bytes"] += sum(
+            a.size * a.dtype.itemsize for a in leaves
+        )
+        st["place_calls"] += 1
 
     def _place(self, batch):
-        # leading axis over `data`; the task list is already padded to a
-        # multiple of the data axis by _judge_bucket below
-        return shard_batch(batch, self.mesh)
+        # leading axis over `data`; the batch is already padded to a
+        # multiple of the data axis (judge_columnar's rounding, or
+        # _judge_bucket's task-list pad below)
+        t0 = time.perf_counter()
+        placed = shard_batch(batch, self.mesh)
+        self._account_place(
+            t0, placed.current.values, jax.tree.leaves(placed)
+        )
+        return placed
+
+    def _place_cols(self, *arrays):
+        # bare [B, ...] operands (joint from-rows cur/mask/x): leading
+        # axis over `data`, same assert as the ScoreBatch path
+        t0 = time.perf_counter()
+        placed = tuple(
+            jax.device_put(
+                a, meshlib.data_sharding(self.mesh, np.ndim(a))
+            )
+            for a in arrays
+        )
+        if placed:
+            self._account_place(t0, placed[0], placed)
+        return placed
+
+    def mesh_debug(self) -> dict:
+        """The worker `/debug/state` device_mesh section body."""
+        rows = self.batch_rows_total
+        return {
+            "shape": dict(self.mesh.shape),
+            "devices": int(np.prod(list(self.mesh.shape.values()))),
+            "batch_rows_total": rows,
+            "pad_rows_total": self.pad_rows_total,
+            "padded_row_fraction": (
+                round(self.pad_rows_total / rows, 4) if rows else None
+            ),
+            **{k: round(v, 4) if isinstance(v, float) else v
+               for k, v in self.mesh_stats.items()},
+        }
 
     def _arena_sharding(self):
         # Deliberate arena placement (VERDICT r4 weak #4): REPLICATE the
@@ -82,14 +178,27 @@ class ShardedJudge(HealthJudge):
         # under multi-controller: allgather them to every host (small
         # arrays — int8 verdicts, packed bits, band-last points).
         # Single-process meshes keep the plain overlapped device_get.
+        # Timed as the "host gather" leg of the roofline account — on a
+        # warm tick this wait also absorbs the device execution the
+        # async dispatch deferred, which is exactly what the bench wants
+        # attributed (gather-vs-dispatch is the saturation question).
+        t0 = time.perf_counter()
         if jax.process_count() == 1:
-            return jax.device_get(tree)
-        from jax.experimental import multihost_utils as mhu
+            out = jax.device_get(tree)
+        else:
+            from jax.experimental import multihost_utils as mhu
 
-        return jax.tree.map(
-            lambda a: np.asarray(mhu.process_allgather(a, tiled=True)),
-            tree,
+            out = jax.tree.map(
+                lambda a: np.asarray(mhu.process_allgather(a, tiled=True)),
+                tree,
+            )
+        st = self.mesh_stats
+        st["fetch_seconds"] += time.perf_counter() - t0
+        st["fetch_bytes"] += sum(
+            int(np.asarray(a).size * np.asarray(a).dtype.itemsize)
+            for a in jax.tree.leaves(out)
         )
+        return out
 
     def _judge_bucket(self, tasks, th, tc):
         n_data = self.mesh.shape[meshlib.DATA_AXIS]

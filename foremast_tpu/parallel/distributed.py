@@ -185,7 +185,7 @@ class LeaderStore(JobStore):
             raise ValueError("process 0 needs the real store")
         self.inner = inner
 
-    def claim(self, worker_id, max_stuck_seconds, limit=64):
+    def claim(self, worker_id, max_stuck_seconds, limit=64, claim_filter=None):
         # a leader-side claim failure must CROSS the broadcast (ISSUE 9):
         # raising before broadcast_obj would leave every follower blocked
         # in the collective while the leader's worker loop moved on —
@@ -193,9 +193,23 @@ class LeaderStore(JobStore):
         # be. The error ships as a marker and re-raises on every process
         # with its transience preserved, so the worker's claim
         # degradation (transient -> empty tick) stays pod-consistent.
+        #
+        # `claim_filter` is the mesh-of-pods seam (ISSUE 13): only the
+        # leader holds a worker-mesh seat, so only it passes a filter —
+        # the partition-filtered claim set then broadcasts like any
+        # other, and followers (whose kwarg is always None) tick over
+        # the identical documents. Partitioning cannot desync the pod
+        # because it is applied BEFORE the broadcast, never after.
         if is_leader():
             try:
-                docs = self.inner.claim(worker_id, max_stuck_seconds, limit)
+                kw = (
+                    {"claim_filter": claim_filter}
+                    if claim_filter is not None
+                    else {}
+                )
+                docs = self.inner.claim(
+                    worker_id, max_stuck_seconds, limit, **kw
+                )
             except Exception as e:  # noqa: BLE001 — must cross processes
                 from foremast_tpu.chaos.degrade import is_transient_error
 

@@ -69,6 +69,129 @@ def pad_to_multiple(n: int, k: int) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Worker device mesh (ISSUE 13): every BrainWorker's judge runs over a
+# local device mesh by default — FOREMAST_DEVICE_MESH selects the shape.
+# ---------------------------------------------------------------------------
+
+
+def device_mesh_spec(env: dict | None = None) -> tuple[int | None, int] | None:
+    """Parse `FOREMAST_DEVICE_MESH` (+ `FOREMAST_DEVICE_MESH_MODEL`).
+
+    Returns (n_data, n_model) for `make_mesh`, or None when the device
+    mesh is disabled. Accepted spellings:
+
+      * unset / "auto" — all local devices on the data axis (n_data=None
+        derives it from the device count; on a stock CPU host that is a
+        1-device mesh, i.e. the identity);
+      * "0" / "off"    — disabled: no mesh placement at all (the
+        pre-ISSUE-13 single-device behavior);
+      * "N"            — N devices on the data axis;
+      * "NxM"          — explicit (data, model) grid (the axis override;
+        `FOREMAST_DEVICE_MESH_MODEL` sets M for the other spellings).
+
+    Malformed values warn and fall back to "auto" — a templated env must
+    never kill worker startup (the FOREMAST_MICROTICK_* precedent)."""
+    import logging
+    import os
+
+    e = os.environ if env is None else env
+    raw = (e.get("FOREMAST_DEVICE_MESH") or "auto").strip().lower()
+    n_model = 1
+    raw_model = (e.get("FOREMAST_DEVICE_MESH_MODEL") or "").strip()
+    if raw_model:
+        try:
+            n_model = max(1, int(raw_model))
+        except ValueError:
+            logging.getLogger("foremast_tpu.mesh").warning(
+                "FOREMAST_DEVICE_MESH_MODEL=%r unparseable; using 1",
+                raw_model,
+            )
+    if raw in ("0", "off", "none", "disabled"):
+        return None
+    if raw in ("auto", ""):
+        return (None, n_model)
+    try:
+        if "x" in raw:
+            d, _, m = raw.partition("x")
+            di, mi = int(d), int(m)
+            # zero on either axis means OFF, matching the bare "0"
+            # spelling — a templated "{data}x{model}" with data=0 must
+            # disable, not clamp up to a 1-wide axis
+            if di <= 0 or mi <= 0:
+                return None
+            return (di, mi)
+        return (max(1, int(raw)), n_model)
+    except ValueError:
+        logging.getLogger("foremast_tpu.mesh").warning(
+            "FOREMAST_DEVICE_MESH=%r unparseable; using 'auto'", raw
+        )
+        return (None, n_model)
+
+
+def worker_device_mesh(env: dict | None = None) -> Mesh | None:
+    """The mesh a BrainWorker's judge should span, from the env.
+
+    None means disabled (plain single-device judge). A resolved
+    1-device mesh is returned as None too: `device_put` with a 1-device
+    NamedSharding is semantically the identity, so the worker skips the
+    ShardedJudge wrapper entirely rather than paying hook overhead for
+    placement that changes nothing.
+
+    Multi-controller processes always get None: a pod's judge must span
+    the GLOBAL mesh (cli --sharded builds it explicitly before the
+    worker exists) — an env-resolved LOCAL mesh on each process would
+    hand one SPMD program differently-placed operands per host."""
+    spec = device_mesh_spec(env)
+    if spec is None:
+        return None
+    if jax.process_count() > 1:
+        return None
+    n_devs = len(jax.devices())
+    n_data, n_model = spec
+    if n_data is None:
+        n_data = max(1, n_devs // n_model)
+    if n_data * n_model > n_devs:
+        # infeasible grid (a fleet-templated knob on a smaller host):
+        # warn and fall back to the all-local auto mesh — the same
+        # never-kill-startup contract as the spec parser above
+        import logging
+
+        logging.getLogger("foremast_tpu.mesh").warning(
+            "FOREMAST_DEVICE_MESH %dx%d needs %d devices, have %d; "
+            "falling back to the all-local auto mesh",
+            n_data, n_model, n_data * n_model, n_devs,
+        )
+        n_data, n_model = n_devs, 1
+    if n_data * n_model <= 1:
+        return None
+    return make_mesh(n_data=n_data, n_model=n_model)
+
+
+def assert_partitioned(arr, n_data: int) -> None:
+    """In-run proof the leading batch axis is actually partitioned: every
+    addressable shard must hold exactly rows/n_data rows (ISSUE 13
+    acceptance — 'sharding is placement' is only true if the placement
+    happened; a silently-replicated batch would still be correct and
+    ~n_data times slower, which is exactly the failure mode an assert
+    exists for). O(#local devices) host work per call, no data read."""
+    rows = arr.shape[0]
+    if rows % n_data != 0:
+        raise AssertionError(
+            f"batch rows {rows} not a multiple of the data axis {n_data}"
+        )
+    shards = arr.addressable_shards
+    want = rows // n_data
+    got = sorted(s.data.shape[0] for s in shards)
+    n_local = len(shards)
+    if any(g != want for g in got):
+        raise AssertionError(
+            f"batch leading axis not partitioned over the mesh: "
+            f"{n_local} local shards of rows {got[:4]}..., want "
+            f"{want} (= {rows}/{n_data}) each"
+        )
+
+
+# ---------------------------------------------------------------------------
 # Multi-host (the reference's NCCL/MPI-equivalent layer, SURVEY.md §2.8:
 # its distribution is shared-nothing pods over HTTP/ES; ours is XLA
 # collectives over ICI within a slice and DCN across slices)

@@ -210,6 +210,33 @@ def test_scaleout_bench_small_smoke(capsys):
     )
 
 
+def test_scaleout_bench_sharded_judge_small_smoke(capsys):
+    """`make bench-scaleout` sharded-judge variant smoke (ISSUE 13):
+    one REAL worker process whose judge partitions over a forced
+    2-virtual-device mesh — exactly-once judgment asserts run inside
+    run(), the in-run partition assert runs inside ShardedJudge._place,
+    and the summary must carry the roofline account (H2D place / device
+    dispatch / host gather / decode) plus the padded-row fraction."""
+    import benchmarks.scaleout_bench as scaleout_bench
+
+    scaleout_bench.main(
+        ["--small", "--workers", "1", "--no-kill", "--device-mesh", "2"]
+    )
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["config"] == "s-mesh-scaleout-sharded"
+    assert line["device_mesh"] == 2
+    rl = line["roofline"]
+    assert rl is not None
+    assert rl["devices_per_worker"] == 2
+    assert rl["h2d_seconds"] >= 0 and rl["gather_seconds"] > 0
+    assert rl["padded_row_fraction"] is not None
+    assert rl["arena_total_device_bytes"] == 2 * rl["arena_replica_bytes"]
+    assert line["no_double_judgment"] is True
+    assert all(
+        v > 0 for v in line["fleet_warm_windows_per_sec"].values()
+    )
+
+
 def test_restart_bench_small_smoke(capsys):
     """`make bench-restart --small` smoke (ISSUE 7): one REAL worker
     SIGKILLed mid-tick (claim persisted, no verdict) and restarted

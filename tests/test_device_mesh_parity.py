@@ -1,0 +1,165 @@
+"""Multi-device parity CI (ISSUE 13 acceptance): the device-mesh
+sharded warm path is PLACEMENT, not semantics.
+
+A child process re-execs under `XLA_FLAGS=
+--xla_force_host_platform_device_count=8` (the parallel/mesh.py:15
+mechanism — virtual CPU devices standing in for a v5e-8) and runs the
+IDENTICAL seeded mixed fleet through two full workers:
+
+  * sharded  — `BrainWorker(device_mesh=make_mesh(n_data=8))`: the
+    univariate columnar fast tick AND the joint from-rows paths
+    (bivariate + LSTM hybrid) partition their batch leading axis over
+    the 8-device data axis, state arenas replicated;
+  * single   — `BrainWorker(device_mesh=None)`: the plain one-device
+    judge.
+
+The fleet is 13 services — deliberately NOT a multiple of 8, so every
+dispatch pads — and both workers run a cold tick (object path), a spike,
+and a warm tick (columnar paths). The child pins BYTE-identical
+statuses, anomaly payloads, hook bands, and fit-cache key sets, and
+verifies the in-run partition assert actually ran (mesh place calls,
+pad accounting). The parent only checks the child's verdict — process
+isolation keeps the forced device count away from the rest of the
+suite's fixed conftest environment.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import sys
+sys.path.insert(0, {repo!r})
+
+import dataclasses
+import json
+
+import numpy as np
+
+from benchmarks.worker_bench import build_mixed_fleet
+from foremast_tpu.config import BrainConfig
+from foremast_tpu.jobs.worker import BrainWorker
+from foremast_tpu.parallel.mesh import make_mesh
+
+NOW = 1_760_000_000.0
+SERVICES = 13  # not a multiple of 8: every sharded dispatch pads
+HIST_LEN = 256
+CUR_LEN = 30
+
+
+def spike(source, sid, f):
+    for m in range(f):
+        url = f"http://prom/cur?q=m{{m}}:app{{sid}}&step=60"
+        ct, cv = source.data[url]
+        s = cv.copy()
+        s[-3:] += 0.6
+        source.data[url] = (ct, s)
+
+
+def run(device_mesh):
+    bands = []
+
+    def hook(doc, verdicts):
+        for v in verdicts:
+            bands.append(
+                (
+                    doc.id,
+                    v.alias,
+                    int(v.verdict),
+                    tuple(v.anomaly_pairs),
+                    np.asarray(v.upper, np.float32).tobytes().hex(),
+                    np.asarray(v.lower, np.float32).tobytes().hex(),
+                )
+            )
+
+    store, source, _ = build_mixed_fleet(
+        SERVICES, HIST_LEN, CUR_LEN, NOW, joint_frac=0.17
+    )
+    cfg = BrainConfig(
+        algorithm="auto", season_steps=24, max_cache_size=4 * SERVICES + 64
+    )
+    cfg = dataclasses.replace(
+        cfg, anomaly=dataclasses.replace(cfg.anomaly, threshold=4.0)
+    )
+    w = BrainWorker(
+        store, source, config=cfg, claim_limit=2 * SERVICES,
+        worker_id="w", on_verdict=hook, device_mesh=device_mesh,
+    )
+    w.judge.lstm_steps = 10  # CI speed; identical on both workers
+    assert w.tick(now=NOW + 150) > 0
+    # find a joint service id to spike (mixed fleet: joint docs carry
+    # multiple aliases) + one univariate
+    joint_sid = None
+    for d in store._docs.values():
+        n = d.current_config.count("==")
+        if n >= 2 and joint_sid is None:
+            joint_sid = (d.app_name.replace("app", ""), n)
+    spike(source, joint_sid[0], joint_sid[1])
+    uurl = next(
+        u for u in source.data if "cur" in u and ":app0&" in u
+    )
+    ct, cv = source.data[uurl]
+    s = cv.copy()
+    s[-3:] = 40.0
+    source.data[uurl] = (ct, s)
+    assert w.tick(now=NOW + 210) > 0
+    statuses = {{
+        d.id: (d.status, json.dumps(d.anomaly_info, sort_keys=True))
+        for d in store._docs.values()
+    }}
+    fit_keys = sorted(repr(k) for k in w._fit_cache._d)
+    joint_keys = sorted(repr(k) for k in w.judge.cache._d)
+    return statuses, sorted(bands), fit_keys, joint_keys, w
+
+
+sharded_mesh = make_mesh(n_data=8)
+s_stat, s_bands, s_fit, s_joint, sw = run(sharded_mesh)
+p_stat, p_bands, p_fit, p_joint, pw = run(None)
+
+# the sharded worker genuinely placed + partitioned (the in-run assert
+# inside ShardedJudge._place/_place_cols raised already if any dispatch
+# was not B_padded/8 rows per device); the 13-doc fleet forced padding
+dm = sw._device_mesh_state()
+assert dm is not None and dm["devices"] == 8, dm
+assert dm["place_calls"] > 0, dm
+assert dm["pad_rows_total"] > 0, dm
+assert sw._fast_kinds["univariate"] > 0, sw._fast_kinds
+assert sw._fast_kinds["bivariate"] + sw._fast_kinds["lstm"] > 0, (
+    sw._fast_kinds
+)
+assert pw._device_mesh_state() is None
+
+# byte parity: statuses, anomaly payloads, hook verdicts + bands,
+# fit-cache key sets — univariate columnar AND joint from-rows paths
+assert s_stat == p_stat, (
+    {{k: (s_stat[k], p_stat[k]) for k in s_stat if s_stat[k] != p_stat[k]}}
+)
+assert any(st == "completed_unhealth" for st, _ in s_stat.values()), s_stat
+assert s_bands == p_bands, "hook verdict/band mismatch"
+assert s_fit == p_fit, "univariate fit-cache key drift"
+assert s_joint == p_joint, "joint fit-cache key drift"
+print("PARITY OK", len(s_stat), "docs,", dm["pad_rows_total"], "pad rows")
+"""
+
+
+def test_sharded_vs_single_device_byte_parity():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("FOREMAST_DEVICE_MESH", None)
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD.format(repo=REPO)],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert out.returncode == 0, f"child failed:\n{out.stdout}\n{out.stderr}"
+    assert "PARITY OK" in out.stdout, out.stdout

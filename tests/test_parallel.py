@@ -481,3 +481,186 @@ def test_score_time_sharded_phase_means_advances_gap(mesh_2d):
     stale = score_time_sharded(placed, mesh_2d, cfg, algorithm="phase_means")
     assert (np.asarray(with_gap.verdict) == HEALTHY).all()
     assert (np.asarray(stale.verdict) == UNHEALTHY).all()  # phase off by 6
+
+
+# ---------------------------------------------------------------------------
+# device-mesh worker knob + columnar sharding (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def test_device_mesh_spec_parsing():
+    from foremast_tpu.parallel.mesh import device_mesh_spec
+
+    assert device_mesh_spec({}) == (None, 1)
+    assert device_mesh_spec({"FOREMAST_DEVICE_MESH": "auto"}) == (None, 1)
+    assert device_mesh_spec({"FOREMAST_DEVICE_MESH": "0"}) is None
+    assert device_mesh_spec({"FOREMAST_DEVICE_MESH": "off"}) is None
+    assert device_mesh_spec({"FOREMAST_DEVICE_MESH": "4"}) == (4, 1)
+    assert device_mesh_spec({"FOREMAST_DEVICE_MESH": "4x2"}) == (4, 2)
+    # zero on either grid axis means OFF (matches the bare "0"):
+    # a templated "{data}x{model}" with data=0 must disable, not
+    # clamp up to a 1-wide axis (review fix)
+    assert device_mesh_spec({"FOREMAST_DEVICE_MESH": "0x2"}) is None
+    assert device_mesh_spec({"FOREMAST_DEVICE_MESH": "4x0"}) is None
+    assert device_mesh_spec(
+        {"FOREMAST_DEVICE_MESH": "auto", "FOREMAST_DEVICE_MESH_MODEL": "2"}
+    ) == (None, 2)
+    # malformed values warn and fall back to auto — never kill startup
+    assert device_mesh_spec({"FOREMAST_DEVICE_MESH": "garbage"}) == (None, 1)
+    assert device_mesh_spec(
+        {"FOREMAST_DEVICE_MESH": "4", "FOREMAST_DEVICE_MESH_MODEL": "bad"}
+    ) == (4, 1)
+
+
+def test_worker_device_mesh_resolution(monkeypatch):
+    """auto spans all local devices; 1-device resolutions collapse to
+    None (the identity — no ShardedJudge wrapper for stock hosts)."""
+    from foremast_tpu.parallel.mesh import worker_device_mesh
+
+    mesh = worker_device_mesh({})
+    assert mesh is not None and mesh.shape["data"] == jax.device_count()
+    assert worker_device_mesh({"FOREMAST_DEVICE_MESH": "off"}) is None
+    assert worker_device_mesh({"FOREMAST_DEVICE_MESH": "1"}) is None
+    # the explicit 1x1 grid means SINGLE-DEVICE, not auto (review fix:
+    # it used to alias to auto and shard over every device)
+    assert worker_device_mesh({"FOREMAST_DEVICE_MESH": "1x1"}) is None
+    m2 = worker_device_mesh({"FOREMAST_DEVICE_MESH": "4x2"})
+    assert dict(m2.shape) == {"data": 4, "model": 2}
+    # infeasible grids warn and fall back to the all-local auto mesh
+    # instead of killing worker startup (review fix: make_mesh used to
+    # raise through BrainWorker.__init__)
+    big = worker_device_mesh({"FOREMAST_DEVICE_MESH": "1024"})
+    assert dict(big.shape) == {"data": jax.device_count(), "model": 1}
+    bigm = worker_device_mesh(
+        {"FOREMAST_DEVICE_MESH": "auto",
+         "FOREMAST_DEVICE_MESH_MODEL": str(4 * jax.device_count())}
+    )
+    assert dict(bigm.shape) == {"data": jax.device_count(), "model": 1}
+
+
+def test_sharded_judge_columnar_pads_to_data_axis(mesh8):
+    """judge_columnar on a ShardedJudge rounds B up to a data-axis
+    multiple, partitions the batch (the in-run assert inside _place
+    fires otherwise), and returns byte-identical results vs a plain
+    single-device judge on the same rows."""
+    from foremast_tpu.engine.judge import HealthJudge
+    from foremast_tpu.models.cache import ModelCache
+
+    rng = np.random.default_rng(0)
+    cfg = BrainConfig()
+    b0, tc = 13, 10  # 13: not a multiple of 8
+    values = (0.5 + 0.05 * rng.standard_normal((b0, tc))).astype(np.float32)
+    values[7, 3] = 50.0
+    mask = np.ones((b0, tc), bool)
+    keys = [(cfg.algorithm, cfg.season_steps, f"k{i}") for i in range(b0)]
+    entries = [(0.5, 0.0, np.zeros(1, np.float32), 0, 0.05, 200)] * b0
+    nidx = np.full(b0, tc - 1, np.int32)
+    thr = np.full(b0, 3.0, np.float32)
+    bound = np.ones(b0, np.int32)
+    mlb = np.zeros(b0, np.float32)
+
+    def run(judge):
+        judge.fit_cache = ModelCache(256)
+        return judge.judge_columnar(
+            values.copy(), mask.copy(), list(keys), list(entries),
+            nidx, thr, bound, mlb,
+        )
+
+    sharded = ShardedJudge(cfg, mesh=mesh8)
+    sv, sa, su, sl = run(sharded)
+    pv, pa, pu, pl = run(HealthJudge(cfg))
+    assert sharded.batch_rows_total % 8 == 0
+    assert sharded.pad_rows_total == sharded.batch_rows_total - b0
+    assert sharded.mesh_stats["place_calls"] == 1
+    np.testing.assert_array_equal(sv, pv)
+    np.testing.assert_array_equal(sa, pa)
+    assert su.tobytes() == pu.tobytes() and sl.tobytes() == pl.tobytes()
+    assert int(sv[7]) == UNHEALTHY
+
+
+def test_pad_fit_keys_never_journal():
+    """ISSUE 13 satellite: ShardedJudge batch padding writes its
+    constant '__pad__' fit into the in-memory cache (warm ticks stay
+    fit-free) but the PR-7 write-through journal, its compaction snap,
+    and the PR-10 RefineBook must never record it."""
+    import os
+    import tempfile
+
+    from foremast_tpu.jobs.refine import RefineBook
+    from foremast_tpu.models.cache import (
+        FitJournal,
+        ModelCache,
+        is_pad_fit_key,
+    )
+
+    assert is_pad_fit_key("__pad__")
+    assert is_pad_fit_key(("moving_average_all", 24, "__pad__"))
+    assert is_pad_fit_key("__pad__col__")
+    assert is_pad_fit_key(("uni", ("ma", 24, "__pad__")))  # refine bkey
+    assert not is_pad_fit_key(("moving_average_all", 24, "app|m|url"))
+
+    with tempfile.TemporaryDirectory() as d:
+        journal = FitJournal(os.path.join(d, "fit-uni"))
+        cache = ModelCache(64)
+        journal.attach(cache)
+        judge = ShardedJudge(BrainConfig(), mesh=make_mesh(n_data=8))
+        judge.fit_cache = cache
+        rng = np.random.default_rng(0)
+        hist = (0.5 + 0.05 * rng.standard_normal(200)).astype(np.float32)
+        cur = (0.5 + 0.05 * rng.standard_normal(10)).astype(np.float32)
+        t = 1_700_000_000 + 60 * np.arange(200, dtype=np.int64)
+        tasks = [
+            MetricTask(
+                job_id=f"j{i}", alias="m", metric_type="latency",
+                hist_times=t, hist_values=hist,
+                cur_times=t[:10], cur_values=cur,
+                fit_key=f"fit{i}",
+            )
+            for i in range(3)  # pads to 8: five '__pad__' rows fit too
+        ]
+        assert len(judge.judge(tasks)) == 3
+        # the pad fit IS cached (warm ticks stay fit-free)...
+        assert any(is_pad_fit_key(k) for k in cache._d)
+        # ...but never journaled, and compaction keeps it off disk too
+        restored = FitJournal(os.path.join(d, "fit-uni")).restore()
+        assert restored and not any(is_pad_fit_key(k) for k in restored)
+        journal.compact()
+        restored = FitJournal(os.path.join(d, "fit-uni")).restore()
+        assert restored and not any(is_pad_fit_key(k) for k in restored)
+        journal.close()
+
+    # RefineBook guard: a pad key cannot become a provisional record
+    book = RefineBook()
+    book.note_uni(("ma", 24, "__pad__"), "__pad__", "u", 5)
+    assert len(book._recs) == 0
+    book.note_uni(("ma", 24, "real"), "gap", "u", 5)
+    assert len(book._recs) == 1
+
+
+def test_leader_store_claim_filter_passthrough():
+    """Mesh-of-pods seam (ISSUE 13): LeaderStore.claim forwards the
+    leader's worker-mesh claim filter to the real store, so the
+    partition-filtered claim set is what broadcasts to followers."""
+    from foremast_tpu.jobs.models import Document
+    from foremast_tpu.jobs.store import InMemoryStore
+    from foremast_tpu.parallel import LeaderStore
+
+    inner = InMemoryStore()
+    for i in range(4):
+        inner.create(
+            Document(
+                id=f"j{i}", app_name=f"app{i}",
+                end_time="2999-01-01T00:00:00Z",
+                current_config="m== http://x", historical_config="",
+                strategy="continuous",
+            )
+        )
+    store = LeaderStore(inner)
+    got = store.claim(
+        "w0", 90.0, limit=16,
+        claim_filter=lambda d: d.app_name in ("app1", "app3"),
+    )
+    assert sorted(d.id for d in got) == ["j1", "j3"]
+    # and the un-filtered spelling still claims the rest
+    rest = store.claim("w0", 90.0, limit=16)
+    assert sorted(d.id for d in rest) == ["j0", "j2"]
