@@ -23,10 +23,14 @@ bench-suite:
 bench-pipeline:
 	$(PY) -m benchmarks.pipeline_bench
 
-# mixed univariate + joint fleet, end-to-end worker ticks (ISSUE 4):
-# 16,384 services with 15% joint (bivariate/LSTM-hybrid) docs
+# mixed-fleet suite (ISSUE 4 + ISSUE 14): the 16,384-service / 15%-joint
+# fleet, the canary-heavy fleet (50% baseline-carrying docs — columnar
+# canary bucket vs the object-path baseline, >= 3x and >= 12.5k w/s/chip
+# asserted in-run, statuses byte-identical across arms), the
+# strategy x regime scenario-matrix F1 sweep (floors asserted in-run),
+# and pusher fan-in shapes over the real ingest receiver
 bench-mixed:
-	$(PY) -m benchmarks.worker_bench --services 16384 --joint-frac 0.15 --algorithm auto --ticks 5
+	$(PY) -m benchmarks.mixed_bench
 
 # watch-plane scale: 10k DeploymentMonitors on InMemoryKube
 bench-plane:

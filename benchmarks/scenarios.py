@@ -1,0 +1,228 @@
+"""Scenario-matrix workload generation (ISSUE 14): canary-shaped fleets.
+
+The quality benchmark's `gen()` families probe detector behavior per
+SIGNAL SHAPE; this module widens the workload generator into the matrix
+the fleet bench sweeps — deployment STRATEGY x traffic REGIME — so the
+headline canary claim is measured on canary-shaped fleets, not just the
+baseline-less ones rounds 5-15 benchmarked:
+
+  strategy — `canary` (a baseline window rides every judgment: the
+             reference's baseline-pods-vs-canary-pods headline query,
+             metricsquery.go:111-116), `rolling` (rollingUpdate — no
+             baseline, bounded endTime), `continuous` (no baseline,
+             open-ended re-check);
+  regime   — `diurnal` (daily cycle), `spiky` (benign traffic bursts in
+             the history — part of the distribution, not anomalies),
+             `stair` (stair-step ramps: capacity changes / migrations),
+             `outage` (outage-shaped GAPS in the history — the chaos
+             plane's blackhole fault vocabulary re-used as a traffic
+             shape: scrapes that never happened are masked-out samples,
+             exactly what a PromQL range returns after an outage).
+
+Each scenario draws B (history, current[, baseline]) window sets with
+known injected anomaly points; `scenario_matrix()` scores them through
+the SAME engine entry point the worker dispatches (`scoring.score`) and
+returns point-level F1 per cell plus the canary cells' pairwise
+false-reject rate (clean same-distribution baselines must not lower the
+threshold). `FAN_IN_SHAPES` names the pusher fan-in dimension the
+ingest-fed fleet variant in `benchmarks.mixed_bench` sweeps.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from benchmarks import prf1
+from foremast_tpu.engine import scoring
+from foremast_tpu.ops.windows import MetricWindows
+
+STRATEGIES = ("canary", "rolling", "continuous")
+REGIMES = ("diurnal", "spiky", "stair", "outage")
+# pusher fan-in shapes for the ingest-fed fleet variant: how many
+# concurrent pushers split the fleet's series (1 = one batching agent,
+# 8 = per-node agents converging on one receiver)
+FAN_IN_SHAPES = (1, 8)
+
+PERIOD = 24
+NOISE = 0.05
+SPIKE_SIGMA = 8.0
+STAIR_STEP = 0.4  # level jump per stair (a capacity migration)
+SPIKY_BURST = 0.35  # benign burst height: tall, but part of the regime
+
+
+def _regime_signal(regime: str, t: np.ndarray, th: int, rng) -> np.ndarray:
+    """Deterministic base signal of one regime at time steps `t` [B, n]
+    (broadcast over rows)."""
+    if regime == "diurnal":
+        return 1.0 + 0.5 * np.sin(2 * np.pi * t / PERIOD)
+    if regime == "spiky":
+        return np.ones_like(t, dtype=float)
+    if regime == "stair":
+        # stair-step ramps WITHIN the history (capacity changes /
+        # traffic migrations at th/4, th/2, 3th/4), with the current
+        # window continuing the last learned level — global-mean bands
+        # mis-center across the steps; the auto screen's changepoint
+        # trend localizes them. (A step AT the history/current boundary
+        # is a genuine level-shift anomaly, not a regime — that case
+        # belongs to the anomaly injection, not the signal.)
+        return 1.0 + STAIR_STEP * np.minimum(
+            np.floor(t / max(th // 4, 1)), 3.0
+        )
+    if regime == "outage":
+        return np.ones_like(t, dtype=float)
+    raise ValueError(regime)
+
+
+def gen_scenario(
+    strategy: str,
+    regime: str,
+    b: int,
+    th: int,
+    tc: int,
+    seed: int = 0,
+):
+    """One scenario cell: (hist [B,Th], hist_mask, cur [B,Tc], truth
+    [B,Tc] bool, base [B,Tc] | None).
+
+    Injected anomalies are SPIKE_SIGMA-sigma points in the current
+    window (two per row). The canary strategy's baseline is a clean
+    same-distribution draw at the current phase — healthy canary, so
+    the rank tests must hold (differs=False) while the band detection
+    still catches the spikes. The spiky regime's history bursts and the
+    outage regime's masked gaps are NOT anomalies: they are the regime.
+    """
+    rng = np.random.default_rng(
+        seed + 1000 * STRATEGIES.index(strategy) + REGIMES.index(regime)
+    )
+    t_hist = np.arange(th)[None, :]
+    t_cur = (th + np.arange(tc))[None, :]
+    hist = _regime_signal(regime, t_hist, th, rng) + rng.normal(
+        0, NOISE, (b, th)
+    )
+    cur = _regime_signal(regime, t_cur, th, rng) + rng.normal(
+        0, NOISE, (b, tc)
+    )
+    hist_mask = np.ones((b, th), bool)
+    if regime == "spiky":
+        # benign bursts in the HISTORY (cron jobs, deploy traffic):
+        # ~2% of samples sit SPIKY_BURST high — the fitted band must
+        # absorb them (they widen sigma), not learn them as clean
+        for i in range(b):
+            k = max(th // 50, 2)
+            idx = rng.choice(th, size=k, replace=False)
+            hist[i, idx] += SPIKY_BURST
+    elif regime == "outage":
+        # outage-shaped gaps: two blackhole windows of ~5% of the
+        # history each — masked samples, exactly a scrape outage's
+        # PromQL shape (the chaos plane's fault vocabulary as data)
+        gap = max(th // 20, 2)
+        for i in range(b):
+            for _ in range(2):
+                g0 = int(rng.integers(0, th - gap))
+                hist_mask[i, g0 : g0 + gap] = False
+    truth = np.zeros((b, tc), bool)
+    for i in range(b):
+        idx = rng.choice(tc, size=2, replace=False)
+        cur[i, idx] += SPIKE_SIGMA * NOISE
+        truth[i, idx] = True
+    base = None
+    if strategy == "canary":
+        # baseline pods: same signal family at the same phase, its own
+        # noise draw — same distribution as a healthy canary's current
+        base = _regime_signal(regime, t_cur, th, rng) + rng.normal(
+            0, NOISE, (b, tc)
+        )
+        base = base.astype(np.float32)
+    return (
+        hist.astype(np.float32),
+        hist_mask,
+        cur.astype(np.float32),
+        truth,
+        base,
+    )
+
+
+def _batch(hist, hist_mask, cur, base):
+    b, tc = cur.shape
+
+    def win(v, m=None):
+        return MetricWindows(
+            values=jnp.asarray(v),
+            mask=jnp.asarray(m) if m is not None else jnp.ones(v.shape, bool),
+            times=jnp.zeros(v.shape, jnp.int32),
+        )
+
+    if base is None:
+        baseline = MetricWindows(
+            values=jnp.zeros_like(jnp.asarray(cur)),
+            mask=jnp.zeros(cur.shape, bool),
+            times=jnp.zeros(cur.shape, jnp.int32),
+        )
+    else:
+        baseline = win(base)
+    return scoring.ScoreBatch(
+        historical=win(hist, hist_mask),
+        current=win(cur),
+        baseline=baseline,
+        threshold=jnp.full((b,), 4.0, jnp.float32),
+        bound=jnp.full((b,), 1, jnp.int32),
+        min_lower_bound=jnp.zeros((b,), jnp.float32),
+        min_points=jnp.full((b,), 10, jnp.int32),
+    )
+
+
+def score_scenario(
+    strategy: str,
+    regime: str,
+    b: int,
+    th: int,
+    tc: int,
+    seed: int = 0,
+    algorithm: str = "auto_univariate",
+):
+    """(f1, precision, recall, differs_rate) for one matrix cell.
+
+    differs_rate is the fraction of rows whose pairwise tests rejected
+    same-distribution — on the clean baselines every cell draws it is
+    the rank tests' false-reject rate (canary cells only; 0.0 where no
+    baseline exists, the gates' hardwired outcome)."""
+    hist, hist_mask, cur, truth, base = gen_scenario(
+        strategy, regime, b, th, tc, seed
+    )
+    res = scoring.score(
+        _batch(hist, hist_mask, cur, base),
+        algorithm=algorithm,
+        season_length=PERIOD,
+    )
+    flags = np.asarray(res.anomalies)
+    tp = int((flags & truth).sum())
+    fp = int((flags & ~truth).sum())
+    fn = int((~flags & truth).sum())
+    precision, recall, f1 = prf1(tp, fp, fn)
+    differs_rate = float(np.asarray(res.dist_differs).mean())
+    return f1, precision, recall, differs_rate
+
+
+def scenario_matrix(b: int, th: int, tc: int, seed: int = 0) -> list[dict]:
+    """The full strategy x regime sweep, one row dict per cell —
+    `make bench-mixed` prints these and BENCHMARKS.md pins them
+    (extends the `fleet_mix` table with the strategy dimension)."""
+    rows = []
+    for strategy in STRATEGIES:
+        for regime in REGIMES:
+            f1, precision, recall, differs = score_scenario(
+                strategy, regime, b, th, tc, seed
+            )
+            rows.append(
+                {
+                    "scenario": f"{strategy}/{regime}",
+                    "strategy": strategy,
+                    "regime": regime,
+                    "f1": round(f1, 3),
+                    "precision": round(precision, 3),
+                    "recall": round(recall, 3),
+                    "pairwise_differs_rate": round(differs, 4),
+                }
+            )
+    return rows

@@ -58,11 +58,22 @@ class ArraySource(MetricSource):
         return self.data[url]
 
 
-def _add_service(store, source, sid, ht, ct, hist_len, cur_len, end_time, rng):
+def _add_service(
+    store, source, sid, ht, ct, hist_len, cur_len, end_time, rng,
+    baseline=False,
+):
     """Create one service's document + its 4 per-alias series. Returns
-    (doc_id, urls) so churn can retire the service cleanly."""
+    (doc_id, urls) so churn can retire the service cleanly.
+
+    `baseline=True` (ISSUE 14): the doc is CANARY-shaped — every alias
+    also carries a baselineConfig URL serving a pre-deploy window of the
+    same clean distribution (so the pairwise rank tests run every tick
+    but don't reject: the healthy-canary steady state), exactly the
+    reference's baseline-pods-vs-canary-pods headline query shape
+    (metricsquery.go:111-116)."""
     cur_parts = []
     hist_parts = []
+    base_parts = []
     urls = []
     for a in ALIASES:
         cur_url = f"http://prom/cur?q={a}:app{sid}&end={int(ct[0]) - 60}&step=60"
@@ -85,13 +96,27 @@ def _add_service(store, source, sid, ht, ct, hist_len, cur_len, end_time, rng):
         urls.extend((cur_url, hist_url))
         cur_parts.append(f"{a}== {cur_url}")
         hist_parts.append(f"{a}== {hist_url}")
+        if baseline:
+            base_url = f"http://prom/base?q={a}:app{sid}&step=60"
+            # the baseline pods' window: same signal family with its
+            # own noise draw — same distribution, so the rank tests
+            # hold (differs=False) and the canary stays healthy
+            bv = (
+                1.0
+                + 0.05 * np.sin(np.arange(cur_len) / 3.0)
+                + rng.normal(0, 0.01, cur_len)
+            ).astype(np.float32)
+            source.data[base_url] = (ct - 3600, bv)
+            urls.append(base_url)
+            base_parts.append(f"{a}== {base_url}")
     doc = Document(
         id=f"job-{sid}",
         app_name=f"app{sid}",
         end_time=end_time,
         current_config=" ||".join(cur_parts),
         historical_config=" ||".join(hist_parts),
-        strategy="continuous",
+        baseline_config=" ||".join(base_parts),
+        strategy="canary" if baseline else "continuous",
     )
     store.create(doc)
     return doc.id, urls
@@ -154,6 +179,7 @@ def build_mixed_fleet(
     now: float,
     joint_frac: float = 0.0,
     seed: int = 0,
+    baseline_frac: float = 0.0,
 ):
     """One document per service, re-check steady state.
 
@@ -164,8 +190,14 @@ def build_mixed_fleet(
     alternating 2-alias bivariate and 4-alias LSTM-hybrid — and the
     REST are single-alias docs (under `auto`, metric count IS the model
     selector, so a 4-alias doc is itself a joint doc; the univariate
-    share of a mixed auto fleet is its single-metric services). Returns
-    (store, source, windows_by_doc)."""
+    share of a mixed auto fleet is its single-metric services).
+    baseline_frac > 0 (the ISSUE 14 canary-heavy condition, univariate
+    fleets only): that fraction of services are CANARY docs — every
+    alias carries a baselineConfig window, so the doc judges through
+    the pairwise rank tests each tick. Returns (store, source,
+    windows_by_doc)."""
+    if joint_frac > 0 and baseline_frac > 0:
+        raise ValueError("joint_frac and baseline_frac are separate modes")
     rng = np.random.default_rng(seed)
     store = InMemoryStore()
     source = ArraySource()
@@ -178,9 +210,10 @@ def build_mixed_fleet(
         "%Y-%m-%dT%H:%M:%SZ", time.gmtime(t_now + 3600)
     )
     n_joint = int(round(services * joint_frac))
+    n_canary = int(round(services * baseline_frac))
     windows_by_doc: dict[str, int] = {}
     for s in range(services):
-        if s < n_joint:
+        if joint_frac > 0 and s < n_joint:
             f = 2 if s % 2 == 0 else 4
             doc_id = _add_joint_service(
                 store, source, str(s), ht, ct, f, end_time, rng
@@ -194,7 +227,7 @@ def build_mixed_fleet(
         else:
             doc_id, _ = _add_service(
                 store, source, str(s), ht, ct, hist_len, cur_len,
-                end_time, rng,
+                end_time, rng, baseline=s < n_canary,
             )
             windows_by_doc[doc_id] = len(ALIASES)
     return store, source, windows_by_doc

@@ -48,6 +48,11 @@ DEFAULT_WRITE_BEHIND_AGE_SECONDS = 90.0
 # write-behind / release reasons (foremast_degraded_docs{reason})
 REASON_DEADLINE = "deadline_released"
 REASON_FETCH = "fetch_released"
+# a fast-tick admitted doc the columnar program could no longer score
+# (joint window-bucket drift, and any future admission invariant that
+# breaks mid-tick): re-routed to the slow path for a refit — counted
+# here so demotions never ride the slow leftovers silently (ISSUE 14)
+REASON_DEMOTED = "fast_demoted"
 REASON_BUFFERED = "write_buffered"
 REASON_REPLAYED = "write_replayed"
 REASON_DROPPED_CAP = "write_dropped_cap"

@@ -449,6 +449,19 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "behavior — ~10x slower per joint doc at fleet scale)",
     ),
     EnvKnob(
+        "FOREMAST_CANARY_COLUMNAR",
+        "1",
+        "bool",
+        "default `1`: warm BASELINE-carrying univariate docs (the "
+        "canary/continuous strategies) ride the columnar fast tick as "
+        "their own bucket — baseline windows fill a second [B, Tc] "
+        "buffer judged by a pairwise-active compiled variant "
+        "(Mann-Whitney/Wilcoxon/Kruskal/Friedman batched over the "
+        "buffer). `0` routes every baseline-carrying doc through the "
+        "per-task object path (the pre-round-16 behavior — ~10k w/s "
+        "regardless of device)",
+    ),
+    EnvKnob(
         "FOREMAST_COLD_CHUNK_DOCS",
         "1024",
         "int",

@@ -306,9 +306,34 @@ class RowArena:
         self.hits += nhits
         alloc = np.nonzero(~hit)[0]
         if len(alloc):
+            # Working-set growth (ISSUE 14): a warm tick SPLIT across
+            # sibling bucket calls (the baseline-less and canary
+            # columnar buckets share this arena) has a working set
+            # larger than any single batch, but capacity only ever grew
+            # to the largest batch — so each bucket would evict the
+            # rows its sibling used ONE call ago and the whole fleet
+            # state would re-scatter every tick (LRU thrash, the exact
+            # failure mode the auto-grow comment in _ensure_capacity
+            # describes). Rows touched within the last two calls are
+            # treated as resident working set: when the allocation
+            # cannot be served from free + genuinely stale rows, grow
+            # (same soft-budget warning / hard-cap rules) instead of
+            # recycling them.
+            # available = the free pool plus assigned rows idle for 3+
+            # calls (free rows keep stamp -1 and never re-enter `free`
+            # after assignment, so the two sets are disjoint; aged
+            # transients undercount here, which at worst grows a little
+            # early — never thrashes)
+            available = len(self.free) + int(
+                ((self.stamp >= 0) & (self.stamp < self.tick - 2)).sum()
+            )
+            shortfall = len(alloc) - available
+            if shortfall > 0 and self.cap + shortfall <= self.hard_rows:
+                self._ensure_capacity(self.cap + shortfall)
             order = None
             oi = 0
-            for i in alloc.tolist():
+            for ai, i in enumerate(alloc.tolist()):
+                alloc_left = len(alloc) - ai  # incl. this allocation
                 k = keys[i]
                 if k is not None:
                     r = getrow(k, -1)
@@ -317,11 +342,32 @@ class RowArena:
                         # the row its first occurrence just claimed
                         rows[i] = r
                         continue
+                if not self.free:
+                    if order is None:
+                        order = np.argsort(self.stamp, kind="stable")
+                    # In-loop anti-thrash backstop (the pre-loop
+                    # estimate's 2-call recency window under-protects
+                    # when 3+ assigns share the arena per tick cycle:
+                    # uni + canary + several slow-path buckets). Peek
+                    # the next eviction candidate without consuming it;
+                    # if it was used within the last 8 calls the
+                    # working set genuinely exceeds capacity — grow
+                    # ONCE for the remaining allocations (same
+                    # soft-budget warning / hard-cap rules) instead of
+                    # recycling live rows every tick. A row idle for
+                    # 8+ assign calls is cold under any real tick shape.
+                    pi = oi
+                    while pi < len(order) and self.stamp[order[pi]] == self.tick:
+                        pi += 1
+                    if (
+                        pi < len(order)
+                        and self.stamp[order[pi]] >= self.tick - 8
+                        and self.cap + alloc_left <= self.hard_rows
+                    ):
+                        self._ensure_capacity(self.cap + alloc_left)
                 if self.free:
                     r = self.free.pop()
                 else:
-                    if order is None:
-                        order = np.argsort(self.stamp, kind="stable")
                     while True:
                         if oi >= len(order):
                             # Unreachable by construction: _ensure_capacity

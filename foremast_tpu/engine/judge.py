@@ -214,6 +214,34 @@ def _compact_full_nopair(verdict, anoms, upper, lower):
 
 
 @jax.jit
+def _compact_min_pair(verdict, anoms, p, differs):
+    """`_compact_min` plus the pairwise outputs — the canary columnar
+    bucket's hook-less decode (baseline-carrying docs compute a REAL
+    (p, differs) on device; the host must not fabricate the constants
+    the baseline-less program is entitled to)."""
+    return (
+        verdict.astype(jnp.int8),
+        jnp.packbits(anoms, axis=1),
+        p,
+        differs,
+    )
+
+
+@jax.jit
+def _compact_full_pair(verdict, anoms, upper, lower, p, differs):
+    """`_compact_full_nopair` plus the pairwise outputs (canary columnar
+    bucket, band_mode="full")."""
+    return (
+        verdict.astype(jnp.int8),
+        jnp.packbits(anoms, axis=1),
+        upper,
+        lower,
+        p,
+        differs,
+    )
+
+
+@jax.jit
 def _compact_result_nopair(verdict, anoms, upper, lower, nidx):
     """_compact_result without the pairwise outputs — the columnar warm
     path serves baseline-less re-checks, where (p=1.0, differs=False)
@@ -705,28 +733,43 @@ class HealthJudge:
         mlb: np.ndarray,
         gap_steps: np.ndarray | None = None,
         with_bands: bool = True,
+        base_values: np.ndarray | None = None,
+        base_mask: np.ndarray | None = None,
     ):
         """Columnar warm-tick scoring: arrays in, compact arrays out.
 
         The worker's fleet fast path (jobs/worker.py _fast_tick) calls
         this for re-check ticks where EVERY row already carries a cached
-        fit entry and no baselines exist: no MetricTask/MetricVerdict
-        objects, no ragged packing, no per-task key tuples — per-window
-        host cost is one buffer write and one dict lookup, which is what
-        lets the shipped loop approach the engine's throughput
-        (BASELINE.md's 100k windows/s is a SYSTEM number).
+        fit entry: no MetricTask/MetricVerdict objects, no ragged
+        packing, no per-task key tuples — per-window host cost is one
+        buffer write and one dict lookup, which is what lets the shipped
+        loop approach the engine's throughput (BASELINE.md's 100k
+        windows/s is a SYSTEM number).
 
         values/mask: [B, tc] current windows (host numpy, caller-packed);
         keys/entries: per-row fit-cache key + terminal-state entry (pad
         rows use the shared _PAD constants); nidx: per-row last-valid
         index for the band-last gather; thr/bound/mlb: per-row anomaly
-        operands. Returns (verdict int8 [B], anomaly flags bool [B, tc],
-        upper_last [B], lower_last [B]); with_bands=False skips the band
-        fetch entirely (upper/lower come back as None) for callers with
-        no gauge hook.
+        operands. base_values/base_mask (ISSUE 14): an optional SECOND
+        [B, tc] buffer pair carrying baseline windows — the canary
+        bucket. When present the program compiles with the configured
+        pairwise rank tests active (Mann-Whitney/Wilcoxon/Kruskal/
+        Friedman with their min-points gates, batched over [B, tc]) and
+        the decode also fetches (p [B], differs [B]); rows whose
+        baseline mask is all-False get the same hardwired (p=1, False)
+        the object path's gates produce. When absent the baseline-less
+        PAIRWISE_NONE program runs, exactly as before.
+
+        Returns (verdict int8 [B], anomaly flags bool [B, tc],
+        upper_last [B], lower_last [B], p [B] | None, differs [B] |
+        None); with_bands=False skips the band fetch entirely
+        (upper/lower come back as None) for callers with no gauge hook;
+        p/differs are None on the baseline-less variant (the host fills
+        the (1.0, False) constants itself).
         """
         cfg = self.config
         b0, tc = values.shape
+        pairwise = base_values is not None
         rows_b = bucket_length(b0)
         # data-axis rounding on top of the pow2 bucket (ISSUE 13): a
         # sharded judge needs B divisible by the mesh's data axis so
@@ -755,6 +798,16 @@ class HealthJudge:
                 gap_steps = np.concatenate(
                     [gap_steps, np.zeros(pad, np.int32)]
                 )
+            if pairwise:
+                # pad baseline rows all-masked: every rank-test gate
+                # fails, (p=1, differs=False) — inert like the rest of
+                # the pad row
+                base_values = np.concatenate(
+                    [base_values, np.zeros((pad, tc), np.float32)]
+                )
+                base_mask = np.concatenate(
+                    [base_mask, np.zeros((pad, tc), bool)]
+                )
         # HOST buffers all the way into _place: committing them with
         # jnp.asarray first would make a sharded judge's device_put a
         # second full-batch copy (default device -> mesh reshard) on
@@ -770,8 +823,16 @@ class HealthJudge:
             ),
             current=MetricWindows(values=values, mask=mask, times=None),
             baseline=MetricWindows(
-                values=np.zeros((rows_b, tc), np.float32),
-                mask=np.zeros((rows_b, tc), bool),
+                values=(
+                    base_values
+                    if pairwise
+                    else np.zeros((rows_b, tc), np.float32)
+                ),
+                mask=(
+                    base_mask
+                    if pairwise
+                    else np.zeros((rows_b, tc), bool)
+                ),
                 times=None,
             ),
             threshold=thr,
@@ -780,15 +841,22 @@ class HealthJudge:
             min_points=np.full((rows_b,), cfg.min_historical_points, np.int32),
         )
         batch = self._place(batch)
-        # Fast-path admission guarantees NO baselines, and an empty
-        # baseline gates every rank test off — (p=1, differs=False) is
-        # the hardwired outcome. PAIRWISE_NONE compiles the judgment
-        # without the tests at all (byte-identical verdicts): at fleet
-        # batch sizes their argsorts dominate the warm program's memory
-        # traffic — the cost that capped co-hosted mesh workers in
-        # benchmarks/scaleout_bench.py.
+        # The warm program splits into TWO compiled variants (ISSUE 14):
+        # the baseline-less bucket proves no baselines exist, and an
+        # empty baseline gates every rank test off — (p=1,
+        # differs=False) is the hardwired outcome — so PAIRWISE_NONE
+        # compiles the judgment without the tests at all
+        # (byte-identical verdicts; at fleet batch sizes their ranking
+        # compare-matrices dominate the warm program's memory traffic —
+        # the cost that capped co-hosted mesh workers in
+        # benchmarks/scaleout_bench.py). The CANARY bucket carries a
+        # real [B, tc] baseline buffer, so it compiles the configured
+        # pairwise algorithm — rank transforms batched over the buffer,
+        # threshold lowering fused into the same program.
         pw = dict(
-            pairwise_algorithm=scoring.PAIRWISE_NONE,
+            pairwise_algorithm=(
+                cfg.pairwise.algorithm if pairwise else scoring.PAIRWISE_NONE
+            ),
             p_threshold=cfg.pairwise.threshold,
             min_mw=cfg.pairwise.min_mann_white_points,
             min_wilcoxon=cfg.pairwise.min_wilcoxon_points,
@@ -800,34 +868,66 @@ class HealthJudge:
         with span(
             "judge.decode", stage="decode", rows=rows_b, device=True
         ):
+            ps = differs = None
             if with_bands and self.band_mode == "full":
                 # full [B, tc] bands for custom hooks (parity with the
                 # object path's "full" mode — same band shape on warm
                 # and cold ticks)
-                v8, packed, ub, lb = self._fetch(
-                    _compact_full_nopair(
-                        res.verdict, res.anomalies, res.upper, res.lower
+                if pairwise:
+                    v8, packed, ub, lb, ps, differs = self._fetch(
+                        _compact_full_pair(
+                            res.verdict, res.anomalies, res.upper,
+                            res.lower, res.p_value, res.dist_differs,
+                        )
                     )
-                )
+                else:
+                    v8, packed, ub, lb = self._fetch(
+                        _compact_full_nopair(
+                            res.verdict, res.anomalies, res.upper, res.lower
+                        )
+                    )
                 ub, lb = ub[:b0], lb[:b0]
             elif with_bands:
-                v8, packed, ub, lb = self._fetch(
-                    _compact_result_nopair(
-                        res.verdict,
-                        res.anomalies,
-                        res.upper,
-                        res.lower,
-                        jnp.asarray(nidx),
+                if pairwise:
+                    v8, packed, ub, lb, ps, differs = self._fetch(
+                        _compact_result(
+                            res.verdict,
+                            res.anomalies,
+                            res.upper,
+                            res.lower,
+                            res.p_value,
+                            res.dist_differs,
+                            jnp.asarray(nidx),
+                        )
                     )
-                )
+                else:
+                    v8, packed, ub, lb = self._fetch(
+                        _compact_result_nopair(
+                            res.verdict,
+                            res.anomalies,
+                            res.upper,
+                            res.lower,
+                            jnp.asarray(nidx),
+                        )
+                    )
                 ub, lb = ub[:b0], lb[:b0]
             else:
-                v8, packed = self._fetch(
-                    _compact_min(res.verdict, res.anomalies)
-                )
+                if pairwise:
+                    v8, packed, ps, differs = self._fetch(
+                        _compact_min_pair(
+                            res.verdict, res.anomalies,
+                            res.p_value, res.dist_differs,
+                        )
+                    )
+                else:
+                    v8, packed = self._fetch(
+                        _compact_min(res.verdict, res.anomalies)
+                    )
                 ub = lb = None
             anoms = np.unpackbits(packed, axis=1, count=tc)
-        return v8[:b0], anoms[:b0], ub, lb
+        if ps is not None:
+            ps, differs = ps[:b0], differs[:b0]
+        return v8[:b0], anoms[:b0], ub, lb, ps, differs
 
     def _judge_bucket(
         self, tasks: list[MetricTask], th: int, tc: int

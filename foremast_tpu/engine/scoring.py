@@ -183,14 +183,16 @@ def pairwise_decision(
     whose min-points gate fails are inconclusive (p=1, not counted).
 
     `PAIRWISE_NONE` is the compile-time skip for callers that can PROVE
-    the baseline is absent (the worker's columnar fast path admits only
-    baseline-less docs): an empty baseline gates every test off anyway —
-    the result is the (p=1, differs=False) constant — but the rank
-    tests' argsorts still execute inside the program. At fleet batch
-    sizes those sorts dominate the warm judgment's memory traffic, so
-    the skip is a large win with byte-identical outputs. `algorithm` is
-    static in every jit entry point, so this is a Python branch, not a
-    device select.
+    the baseline is absent (the worker's columnar fast path compiles it
+    for its baseline-LESS bucket; the canary bucket — baseline-carrying
+    docs, ISSUE 14 — compiles the configured algorithm with the real
+    [B, Tc] baseline buffer instead): an empty baseline gates every
+    test off anyway — the result is the (p=1, differs=False) constant —
+    but the rank tests' comparison matrices still execute inside the
+    program. At fleet batch sizes those dominate the warm judgment's
+    memory traffic, so the skip is a large win with byte-identical
+    outputs. `algorithm` is static in every jit entry point, so this is
+    a Python branch, not a device select.
     """
     x, xm = current.values, current.mask
     if algorithm == PAIRWISE_NONE:

@@ -25,6 +25,7 @@ import numpy as np
 
 from foremast_tpu.chaos.degrade import (
     REASON_DEADLINE,
+    REASON_DEMOTED,
     REASON_FETCH,
     REASON_REPLAYED,
     Degradation,
@@ -247,10 +248,23 @@ class BrainWorker:
             and self._mvj is not None
             and _os0.environ.get("FOREMAST_JOINT_COLUMNAR", "1") == "1"
         )
+        # canary columnar path (ISSUE 14): baseline-carrying univariate
+        # docs ride the fast tick as their own bucket — a second
+        # [B, tc] baseline buffer through a pairwise-active compiled
+        # variant. FOREMAST_CANARY_COLUMNAR=0 opts out (they demote to
+        # the object path, the pre-ISSUE-14 behavior).
+        self._canary_fast = (
+            _os0.environ.get("FOREMAST_CANARY_COLUMNAR", "1") == "1"
+        )
         # cumulative columnar-path doc counts per model kind — the
         # per-kind bucket counters /debug/state and WorkerMetrics expose
-        # (proof that joint docs actually ride the fast path)
-        self._fast_kinds = {"univariate": 0, "bivariate": 0, "lstm": 0}
+        # (proof that joint docs actually ride the fast path;
+        # "baseline" is the canary bucket — single-alias docs judged
+        # WITH their baseline windows through the pairwise-active
+        # columnar program)
+        self._fast_kinds = {
+            "univariate": 0, "bivariate": 0, "lstm": 0, "baseline": 0,
+        }
         # per-document decoded config/endTime metadata (immutable per doc
         # id — see _doc_meta) and per-fit-key gap anchors (step, last
         # hist timestamp) for the history-free warm path
@@ -1321,6 +1335,23 @@ class BrainWorker:
         cached[2] = token
         return True
 
+    def _demote_to_slow(self, slow: list, demoted: list, why: str) -> None:
+        """Route fast-tick demotions (an admitted doc the columnar
+        program can no longer score — e.g. a joint doc whose window
+        bucket drifted from the fitted one) back onto the slow path,
+        COUNTED on foremast_degraded_docs{reason="fast_demoted"}
+        (ISSUE 14 satellite: demotions used to ride the slow leftovers
+        silently, so an operator could not see the fast path shedding
+        work)."""
+        if not demoted:
+            return
+        slow.extend(demoted)
+        self._degrade.stats.count_docs(REASON_DEMOTED, len(demoted))
+        log.info(
+            "fast path demoted %d doc(s) to the slow path (%s)",
+            len(demoted), why,
+        )
+
     def _account_fast_kinds(self, kind_counts: dict) -> None:
         """Fold one tick's columnar doc counts into the cumulative
         per-kind counters (/debug/state) and the WorkerMetrics family."""
@@ -1552,9 +1583,15 @@ class BrainWorker:
         their bivariate/LSTM-hybrid fits are cached, they are claimed
         here and scored through one arena-gathered joint program per
         model kind (`_judge_joint_fast`) instead of falling onto the
-        per-task object path forever. Docs that don't qualify
-        (baselines, unsettled or absent histories, cold fits) are
-        returned for the slow path. Returns (n_processed, slow_docs).
+        per-task object path forever. BASELINE-carrying univariate docs
+        (the canary/continuous strategies — the reference's headline
+        use case) ride it too (ISSUE 14): they form their own bucket
+        whose baseline windows fill a second [B, tc] buffer judged by
+        the pairwise-active columnar program. Docs that don't qualify
+        (unsettled or absent histories, cold fits, multi-alias docs
+        with baselines, canary docs under FOREMAST_CANARY_COLUMNAR=0)
+        are returned for the slow path. Returns (n_processed,
+        slow_docs).
 
         Admission (which docs qualify, with their entry/gap references)
         is itself cached per doc: a version-stable tick trusts entries
@@ -1578,7 +1615,8 @@ class BrainWorker:
             )
             if len(jadmit) > 8 * max(self.claim_limit, 512):
                 jadmit.clear()
-        fast = []  # (doc, end_epoch, rowsinfo, ops)
+        fast = []  # (doc, end_epoch, rowsinfo, ops) — baseline-less
+        fastc = []  # same shape — the canary bucket (>=1 baseline URL)
         fastj = []  # (doc, end_epoch, jinfo) — joint docs, warm
         slow = []
         for doc in docs:
@@ -1586,7 +1624,9 @@ class BrainWorker:
             if cached is not None and (
                 cached[3] == token or self._revalidate(cached, token)
             ):
-                fast.append((doc, cached[0], cached[1], cached[2]))
+                (fastc if cached[4] else fast).append(
+                    (doc, cached[0], cached[1], cached[2])
+                )
                 continue
             aliases, end_epoch, ops = self._doc_meta(doc)
             if not aliases:
@@ -1602,6 +1642,7 @@ class BrainWorker:
                     fastj.append(item)
                 continue
             rowsinfo = []
+            has_base = False
             for (
                 alias,
                 cur_url,
@@ -1612,8 +1653,17 @@ class BrainWorker:
                 hist_end,
                 fullkey,
             ) in aliases:
+                # baseline presence is a BUCKET dimension, not a
+                # slow-path demotion (ISSUE 14): a baseline-carrying
+                # alias routes its doc to the canary bucket below —
+                # unless the canary columnar path is opted out, in
+                # which case it keeps the pre-ISSUE-14 object-path
+                # routing. The fit gates (settled history, cached
+                # entry/gap) are identical for both buckets: the
+                # baseline window, like the current window, is fetched
+                # fresh every tick and never feeds the fit.
                 if (
-                    base_url is not None
+                    (base_url is not None and not self._canary_fast)
                     or hist_url is None
                     or hist_end is None
                     or hist_end > now - HIST_SETTLED_SECONDS
@@ -1630,25 +1680,52 @@ class BrainWorker:
                     if gap is None:
                         rowsinfo = None
                         break
-                rowsinfo.append((alias, cur_url, fullkey, entry, gap))
+                if base_url is not None:
+                    has_base = True
+                rowsinfo.append(
+                    (alias, cur_url, fullkey, entry, gap, base_url)
+                )
             if rowsinfo is None:
                 slow.append(doc)
             else:
-                admit[doc.id] = [end_epoch, rowsinfo, ops, token]
-                fast.append((doc, end_epoch, rowsinfo, ops))
-        if not fast and not fastj:
+                admit[doc.id] = [end_epoch, rowsinfo, ops, token, has_base]
+                (fastc if has_base else fast).append(
+                    (doc, end_epoch, rowsinfo, ops)
+                )
+        if not fast and not fastc and not fastj:
             return 0, slow
 
         # fetch current windows (thread pool only for blocking sources):
-        # univariate and joint docs share one pooled fan-out — a fetch
-        # entry is (item, url list) regardless of kind
-        fetch_items = [(item, [r[1] for r in item[2]]) for item in fast]
-        fetch_items += [(item, list(item[2][2])) for item in fastj]
+        # univariate, canary and joint docs share one pooled fan-out —
+        # a fetch entry is (kind, item, url list). Canary docs append
+        # their per-row baseline URLs after the current URLs (None for
+        # a baseline-less alias inside a canary doc: it fetches as an
+        # empty window, whose all-False mask gates every rank test off
+        # — the object path's exact semantics for that alias).
+        fetch_items = [
+            ("uni", item, [r[1] for r in item[2]]) for item in fast
+        ]
+        fetch_items += [
+            (
+                "canary",
+                item,
+                [r[1] for r in item[2]] + [r[5] for r in item[2]],
+            )
+            for item in fastc
+        ]
+        fetch_items += [
+            ("joint", item, list(item[2][2])) for item in fastj
+        ]
 
         def fetch_doc(entry):
-            item, urls = entry
+            _kind, item, urls = entry
             try:
-                return [self.source.fetch(u) for u in urls]
+                return [
+                    self.source.fetch(u)
+                    if u is not None
+                    else (_EMPTY_TIMES, _EMPTY_VALUES)
+                    for u in urls
+                ]
             except Exception as e:
                 if is_transient_error(e):
                     # dependency outage (or breaker open): release the
@@ -1678,8 +1755,9 @@ class BrainWorker:
         failed = []
         released = []
         ok_items = []
+        ok_citems = []
         ok_joint = []
-        for (item, _urls), s in zip(fetch_items, series):
+        for (kind, item, _urls), s in zip(fetch_items, series):
             if s is None:
                 doc = item[0]
                 doc.status = STATUS_PREPROCESS_FAILED
@@ -1689,53 +1767,94 @@ class BrainWorker:
                 failed.append(doc)
             elif s is RELEASED:
                 released.append(item[0])
-            elif len(item) == 4:
+            elif kind == "uni":
                 ok_items.append((item, s))
+            elif kind == "canary":
+                ok_citems.append((item, s))
             else:
                 ok_joint.append((item, s))
         self._release_docs(released, REASON_FETCH)
         if self.metrics:
             for doc in failed:
                 self.metrics.observe_doc(doc.status, 0)
-        if not ok_items and not ok_joint:
+        if not ok_items and not ok_citems and not ok_joint:
             return len(failed) + len(released), slow
         updated_all: list = []
         n_joint = 0
-        kind_counts = {"univariate": 0, "bivariate": 0, "lstm": 0}
+        kind_counts = {
+            "univariate": 0, "bivariate": 0, "lstm": 0, "baseline": 0,
+        }
         if ok_joint:
             j_updated, demoted, j_counts = self._judge_joint_fast(
                 ok_joint, now
             )
             updated_all.extend(j_updated)
             n_joint = len(j_updated)
-            slow.extend(demoted)
+            self._demote_to_slow(slow, demoted, "joint window bucket drift")
             for kind, n in j_counts.items():
                 kind_counts[kind] += n
         if ok_items:
             updated_all.extend(self._judge_uni_fast(ok_items, now))
             kind_counts["univariate"] += len(ok_items)
+        if ok_citems:
+            updated_all.extend(
+                self._judge_uni_fast(ok_citems, now, canary=True)
+            )
+            kind_counts["baseline"] += len(ok_citems)
         self._account_fast_kinds(kind_counts)
         with span(
             "worker.write_back", stage="write_back", docs=len(updated_all)
         ):
             self._store_update_many(updated_all)
         self._observe_verdicts(updated_all)
-        return len(ok_items) + n_joint + len(failed) + len(released), slow
+        return (
+            len(ok_items)
+            + len(ok_citems)
+            + n_joint
+            + len(failed)
+            + len(released),
+            slow,
+        )
 
-    def _judge_uni_fast(self, ok_items, now: float) -> list:
+    def _judge_uni_fast(self, ok_items, now: float, canary: bool = False) -> list:
         """Columnar warm judgment of admitted univariate rows: one
         [B, tc] buffer pair, one `judge_columnar` call, segment-reduction
         decode (the `_judge_joint_fast` counterpart for single-alias
-        rows). Returns the decided docs; the caller persists."""
+        rows). `canary=True` is the baseline-carrying bucket (ISSUE 14):
+        each item's fetched series carry the baseline windows AFTER the
+        current windows (the `_fast_tick` fetch layout), which fill a
+        second [B, tc] buffer pair judged by the pairwise-active
+        compiled variant — hook verdicts then carry the REAL device
+        (p, differs) instead of the baseline-less constants. Returns
+        the decided docs; the caller persists."""
         uni = self._uni
         gap_sensitive = self._gap_sensitive
         # columnar fill: one [B, tc] buffer pair, no per-row objects
         from foremast_tpu.engine.judge import bucket_length
 
+        bv_flat = None
+        if canary:
+            # split each item's series back into (current, baseline)
+            # halves; the decode below must only ever see the currents
+            split = []
+            bv_flat = []
+            for item, s in ok_items:
+                rows = len(item[2])
+                split.append((item, s[:rows]))
+                bv_flat.extend(s[rows:])
+            ok_items = split
         cv_flat = [cv for _, s in ok_items for _, cv in s]
         n_rows = len(cv_flat)
         lens = np.fromiter((len(cv) for cv in cv_flat), np.int64, count=n_rows)
         n_max = int(lens.max(initial=1))
+        if canary:
+            # the shared window bucket covers the baseline windows too —
+            # the object path's per-task rule is bucket_length(max(cur,
+            # base)) (judge.judge), so the canary bucket's shape follows
+            # the same maximum
+            n_max = max(
+                n_max, max((len(bv) for _, bv in bv_flat), default=1)
+            )
         tc = bucket_length(max(n_max, 1))
         nidx = np.maximum(lens - 1, 0).astype(np.int32)
         values = np.zeros((n_rows, tc), np.float32)
@@ -1752,6 +1871,31 @@ class BrainWorker:
                 if n:
                     values[i, :n] = cv[:n]
                     maskarr[i, :n] = True
+        base_vals = base_m = None
+        if canary:
+            # second [B, tc] buffer: baseline windows, left-packed like
+            # the currents; a baseline-less alias inside a canary doc
+            # fetched empty, so its all-False mask row gates every rank
+            # test off (the object path's exact outcome for it)
+            base_vals = np.zeros((n_rows, tc), np.float32)
+            base_m = np.zeros((n_rows, tc), bool)
+            blens = np.fromiter(
+                (len(bv) for _, bv in bv_flat), np.int64, count=n_rows
+            )
+            b_min, b_max = int(blens.min(initial=0)), int(blens.max(initial=0))
+            if b_min == b_max and b_min > 0:
+                # uniform baseline length (the steady state): one
+                # C-level stack, same as the currents above
+                base_vals[:, :b_max] = np.stack(
+                    [bv for _, bv in bv_flat]
+                )
+                base_m[:, :b_max] = True
+            else:
+                for i, (_, bv) in enumerate(bv_flat):
+                    nb = min(len(bv), tc)
+                    if nb:
+                        base_vals[i, :nb] = np.asarray(bv, np.float32)[:nb]
+                        base_m[i, :nb] = True
         opcat = np.concatenate([item[3] for item, _ in ok_items], axis=1)
         thr = opcat[0]
         bnd = opcat[1].astype(np.int32)
@@ -1773,7 +1917,7 @@ class BrainWorker:
                     i += 1
 
         with_bands = self.on_verdict is not None
-        v8, anoms, ub, lb = uni.judge_columnar(
+        v8, anoms, ub, lb, ps, difs = uni.judge_columnar(
             values,
             maskarr,
             keys,
@@ -1784,6 +1928,8 @@ class BrainWorker:
             mlb,
             gap_steps=gaps,
             with_bands=with_bands,
+            base_values=base_vals,
+            base_mask=base_m,
         )
 
         # decode: segment reductions over per-doc row ranges
@@ -1812,15 +1958,18 @@ class BrainWorker:
         with span("worker.decide", stage="decide", docs=len(ok_items)):
             return self._decide_fast(
                 ok_items, v8, seg_unh, seg_min, starts, pairs_for,
-                ub, lb, tc, now,
+                ub, lb, tc, now, ps, difs,
             )
 
     def _decide_fast(
         self, ok_items, v8, seg_unh, seg_min, starts, pairs_for,
-        ub, lb, tc, now,
+        ub, lb, tc, now, ps=None, difs=None,
     ):
         """Fast-path status decisions + hook dispatch (split from
-        _fast_tick so the decide stage is one guarded span)."""
+        _fast_tick so the decide stage is one guarded span). `ps`/`difs`
+        are the canary bucket's per-row device pairwise outcomes (None
+        on the baseline-less bucket, whose hook verdicts carry the
+        hardwired constants)."""
         hook = self.on_verdict
         updated = []
         observe = self.metrics.observe_doc if self.metrics else None
@@ -1846,9 +1995,10 @@ class BrainWorker:
             if hook:
                 vs = []
                 full_bands = ub is not None and ub.ndim == 2
-                for k2, ((alias, _, _, _, _), (ct, cv)) in enumerate(
+                for k2, (row, (ct, cv)) in enumerate(
                     zip(rowsinfo, s)
                 ):
+                    alias = row[0]
                     r = a + k2
                     n = min(len(cv), tc)
                     if full_bands:
@@ -1867,11 +2017,15 @@ class BrainWorker:
                             anomaly_pairs=pairs_for(r, s, k2),
                             upper=up,
                             lower=lo,
-                            # baseline-less by construction (fast-path
-                            # admission): the pairwise decision is the
-                            # all-gates-failed constant
-                            p_value=1.0,
-                            dist_differs=False,
+                            # baseline-less bucket: the pairwise
+                            # decision is the all-gates-failed
+                            # constant; the canary bucket carries the
+                            # REAL device outcomes (object-path _emit
+                            # parity)
+                            p_value=float(ps[r]) if ps is not None else 1.0,
+                            dist_differs=(
+                                bool(difs[r]) if difs is not None else False
+                            ),
                         )
                     )
                 try:
@@ -2359,6 +2513,26 @@ class BrainWorker:
             seconds=round(seconds, 4),
         )
 
+    def _columnar_pad_state(self) -> dict | None:
+        """Padded-row accounting across the univariate AND joint
+        columnar dispatches — meaningful on every judge (pow2 bucketing
+        pads with or without a device mesh). None when no columnar
+        dispatch has run."""
+        rows = pads = 0
+        if self._uni is not None:
+            rows += self._uni.batch_rows_total
+            pads += self._uni.pad_rows_total
+        if self._mvj is not None:
+            rows += self._mvj.batch_rows_total
+            pads += self._mvj.pad_rows_total
+        if not rows:
+            return None
+        return {
+            "batch_rows_total": rows,
+            "pad_rows_total": pads,
+            "padded_row_fraction": round(pads / rows, 4),
+        }
+
     def _device_mesh_state(self) -> dict | None:
         """The /debug/state `device_mesh` section (ISSUE 13): mesh
         shape, padded-row fraction across the univariate AND joint
@@ -2482,6 +2656,12 @@ class BrainWorker:
             # > 0 is the observable proof multi-alias docs ride the fast
             # path (ISSUE 4 acceptance)
             "fast_path_docs": dict(self._fast_kinds),
+            # columnar batch-padding accounting for SINGLE-device
+            # judges too (the pow2 bucket pads regardless of sharding;
+            # sharded judges report the same counters with the mesh
+            # roofline under `device_mesh`) — the <2% padded-row bar is
+            # observable on stock hosts, not assumed
+            "columnar_pad": self._columnar_pad_state(),
             "last_tick": dict(self._last_tick),
             # occupancy of the latest slow-path chunk pipeline run:
             # device_idle_seconds (judge waited on fetch), write_queue

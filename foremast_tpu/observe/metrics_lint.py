@@ -114,7 +114,10 @@ FAMILY_DOCS: dict[str, str] = {
         "device state-arena traffic (hits/misses/evictions/fallbacks)"
     ),
     "foremast_worker_fast_docs": (
-        "documents scored on the columnar fast path, by model kind"
+        "documents scored on the columnar fast path, by model kind "
+        "(univariate/bivariate/lstm, plus `baseline` — the canary "
+        "bucket: baseline-carrying univariate docs judged through the "
+        "pairwise-active columnar program)"
     ),
     "foremast_worker_pipeline_idle_seconds": (
         "seconds the judge stage sat stalled waiting on a chunk's fetch"

@@ -77,8 +77,11 @@ class ShardedJudge(HealthJudge):
 
     Drop-in: same `judge(tasks) -> [MetricVerdict]` surface AND the same
     `judge_columnar(...)` fast-tick surface (ISSUE 13): the worker's
-    whole warm path — univariate columnar plus, through `_place_cols`,
-    the joint from-rows programs — rides the mesh. Placement only:
+    whole warm path — univariate columnar (both its baseline-less and
+    canary pairwise-active variants: the ISSUE 14 baseline buffer rides
+    the ScoreBatch pytree through `_place`, partitioning like every
+    other [B, tc] operand) plus, through `_place_cols`, the joint
+    from-rows programs — rides the mesh. Placement only:
     batches shard their leading axis over `data`, arenas replicate
     (`_arena_sharding`), so admission, fit-cache identity and every
     degradation contract are untouched. A 1-device mesh is the identity

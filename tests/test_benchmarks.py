@@ -391,3 +391,59 @@ def test_mixed_univariate_joint_worker_tick():
     for kind, (f1, points) in by_kind.items():
         floor = 1.0 if kind in ("bivariate", "lstm") else 0.93
         assert f1 >= floor, (kind, f1, points)
+
+
+def test_mixed_bench_canary_small():
+    """`make bench-mixed` canary phase smoke (ISSUE 14): a canary-heavy
+    fleet judged through the columnar canary bucket vs the knob-off and
+    full-object arms — byte parity between ALL arms is asserted inside
+    run_canary() at every shape; the >= 3x / >= 12.5k w/s bars are
+    asserted at full benchmark shapes, not CI smoke shapes."""
+    from benchmarks.mixed_bench import run_canary
+
+    out = run_canary(24, 2, 256, 30, assert_bars=False)
+    assert out["config"] == "w-canary-fleet-tick"
+    assert out["equivalent"] is True
+    assert out["canary_services"] == 12
+    fast = out["fast_path_docs"]
+    assert fast["baseline"] > 0 and fast["univariate"] > 0, fast
+    assert out["columnar"]["warm_windows_per_sec"] > 0
+    assert out["object_path"]["warm_windows_per_sec"] > 0
+    assert out["value"] > 0
+
+
+def test_mixed_bench_scenario_matrix_small():
+    """Scenario-matrix smoke (ISSUE 14): every strategy x regime cell
+    runs at CI shape and holds its F1 floor (in-run assert inside
+    run_scenarios); canary cells must report the pairwise false-reject
+    rate and never score materially WORSE than their baseline-less
+    siblings on the same regime (the rank tests must not hurt clean
+    detection)."""
+    from benchmarks.mixed_bench import run_scenarios
+    from benchmarks.scenarios import REGIMES, STRATEGIES
+
+    rows = run_scenarios(16, 240, 30, assert_floors=True)
+    assert len(rows) == len(STRATEGIES) * len(REGIMES)
+    by = {(r["strategy"], r["regime"]): r for r in rows}
+    for regime in REGIMES:
+        canary = by[("canary", regime)]
+        assert "pairwise_differs_rate" in canary
+        for other in ("rolling", "continuous"):
+            assert canary["f1"] >= by[(other, regime)]["f1"] - 0.1, (
+                canary, by[(other, regime)],
+            )
+
+
+def test_mixed_bench_fanin_small():
+    """Pusher fan-in smoke (ISSUE 14): the canary fleet pushed through
+    the REAL receiver by 1 vs 8 concurrent pushers, judged pure-push
+    from the ring — statuses identical across fan-in shapes (asserted
+    inside run_fanin) and the canary bucket engaged on the warm tick."""
+    from benchmarks.mixed_bench import run_fanin
+
+    rows = run_fanin(8, 128, 30, (1, 4))
+    assert [r["fan_in"] for r in rows] == [1, 4]
+    for row in rows:
+        assert row["pure_push"] is True
+        assert row["equivalent_across_shapes"] is True
+        assert row["push_samples_per_sec"] > 0

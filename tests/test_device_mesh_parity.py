@@ -163,3 +163,124 @@ def test_sharded_vs_single_device_byte_parity():
     )
     assert out.returncode == 0, f"child failed:\n{out.stdout}\n{out.stderr}"
     assert "PARITY OK" in out.stdout, out.stdout
+
+
+_CANARY_CHILD = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import sys
+sys.path.insert(0, {repo!r})
+
+import json
+
+import numpy as np
+
+from benchmarks.worker_bench import build_mixed_fleet
+from foremast_tpu.config import BrainConfig
+from foremast_tpu.jobs.worker import BrainWorker
+from foremast_tpu.parallel.mesh import make_mesh
+
+NOW = 1_760_000_000.0
+SERVICES = 13  # not a multiple of 8: every sharded dispatch pads
+HIST_LEN = 256
+CUR_LEN = 30
+
+
+def run(device_mesh):
+    bands = []
+
+    def hook(doc, verdicts):
+        for v in verdicts:
+            bands.append(
+                (
+                    doc.id,
+                    v.alias,
+                    int(v.verdict),
+                    tuple(v.anomaly_pairs),
+                    round(float(v.p_value), 7),
+                    bool(v.dist_differs),
+                    np.asarray(v.upper, np.float32).tobytes().hex(),
+                    np.asarray(v.lower, np.float32).tobytes().hex(),
+                )
+            )
+
+    # canary-heavy fleet (ISSUE 14): over half the docs carry baseline
+    # windows, so the warm tick runs the PAIRWISE-ACTIVE columnar
+    # program — the variant this test pins across the mesh
+    store, source, _ = build_mixed_fleet(
+        SERVICES, HIST_LEN, CUR_LEN, NOW, baseline_frac=0.6
+    )
+    cfg = BrainConfig(
+        algorithm="moving_average_all",
+        season_steps=24,
+        max_cache_size=4 * SERVICES + 64,
+    )
+    w = BrainWorker(
+        store, source, config=cfg, claim_limit=2 * SERVICES,
+        worker_id="w", on_verdict=hook, device_mesh=device_mesh,
+    )
+    assert w.tick(now=NOW + 150) > 0
+    # spike one canary doc's current AND shift another canary doc's
+    # baseline distribution (differs=True lowers the threshold
+    # in-program — the pairwise outputs must survive the mesh bitwise)
+    url = next(
+        u for u in source.data
+        if u.startswith("http://prom/cur") and "latency:app1&" in u
+    )
+    ct, cv = source.data[url]
+    s = cv.copy()
+    s[-3:] = 40.0
+    source.data[url] = (ct, s)
+    burl = next(
+        u for u in source.data
+        if u.startswith("http://prom/base") and "latency:app0&" in u
+    )
+    bt, bv = source.data[burl]
+    source.data[burl] = (bt, (bv + 0.5).astype(np.float32))
+    assert w.tick(now=NOW + 210) > 0
+    statuses = {{
+        d.id: (d.status, json.dumps(d.anomaly_info, sort_keys=True))
+        for d in store._docs.values()
+    }}
+    return statuses, sorted(bands), w
+
+
+s_stat, s_bands, sw = run(make_mesh(n_data=8))
+p_stat, p_bands, pw = run(None)
+
+dm = sw._device_mesh_state()
+assert dm is not None and dm["devices"] == 8, dm
+assert dm["place_calls"] > 0, dm
+assert dm["pad_rows_total"] > 0, dm  # 13-doc fleet forces pad rows
+assert sw._fast_kinds["baseline"] > 0, sw._fast_kinds
+assert pw._device_mesh_state() is None
+
+assert s_stat == p_stat, (
+    {{k: (s_stat[k], p_stat[k]) for k in s_stat if s_stat[k] != p_stat[k]}}
+)
+assert any(st == "completed_unhealth" for st, _ in s_stat.values()), s_stat
+assert s_bands == p_bands, "hook verdict/band/pairwise mismatch"
+# the shifted-baseline doc's REAL pairwise rejection survived sharding
+assert any(b[0] == "job-0" and b[5] for b in s_bands), s_bands
+print("CANARY PARITY OK", len(s_stat), "docs,", dm["pad_rows_total"], "pad rows")
+"""
+
+
+def test_sharded_vs_single_device_canary_byte_parity():
+    """ISSUE 14 satellite: the pairwise-active columnar program (canary
+    bucket — baseline buffers ride the same mesh placement) is byte-
+    identical sharded vs single-device, pad accounting included."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("FOREMAST_DEVICE_MESH", None)
+    out = subprocess.run(
+        [sys.executable, "-c", _CANARY_CHILD.format(repo=REPO)],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert out.returncode == 0, f"child failed:\n{out.stdout}\n{out.stderr}"
+    assert "CANARY PARITY OK" in out.stdout, out.stdout

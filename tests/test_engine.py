@@ -817,3 +817,49 @@ def test_bf16_delta_setter_overrides_env(monkeypatch):
     finally:
         scoring.set_bf16_delta(None)
     assert not scoring.bf16_delta_enabled()
+
+
+def test_arena_grows_for_cross_bucket_working_set():
+    """ISSUE 14: a warm tick split across sibling bucket calls (the
+    baseline-less and canary columnar buckets share the univariate
+    arena) must GROW the arena to the cross-call working set, not evict
+    the sibling's rows every call (LRU thrash: the whole fleet state
+    would re-scatter each tick)."""
+    from foremast_tpu.engine.arena import StateArena, _row_bytes
+
+    a = StateArena(1, max_bytes=4096 * _row_bytes(1))
+    bucket_a = [f"a{i}" for i in range(32)]
+    bucket_b = [f"b{i}" for i in range(32)]
+    # cold pass: both buckets scatter once
+    ra, sa = a.assign(bucket_a, range(32))
+    rb, sb = a.assign(bucket_b, range(32))
+    assert len(sa) == 32 and len(sb) == 32
+    # warm passes: every row must HIT — zero evictions, zero scatters —
+    # for several alternating cycles (capacity grew to hold both)
+    for _ in range(3):
+        for bucket in (bucket_a, bucket_b):
+            rows, scatter = a.assign(bucket, ())
+            assert scatter == [], scatter
+    assert a.evictions == 0
+    assert a.cap >= 64
+
+
+def test_arena_grows_for_many_bucket_working_set():
+    """The in-loop backstop (code review round): with 3+ assigns per
+    tick cycle — uni + canary + several slow-path buckets — a row used
+    a few calls ago is still working set; only rows idle for 8+ calls
+    may be recycled instead of growing."""
+    from foremast_tpu.engine.arena import StateArena, _row_bytes
+
+    a = StateArena(1, max_bytes=4096 * _row_bytes(1))
+    buckets = [
+        [f"{c}{i}" for i in range(16)] for c in "abcde"
+    ]  # 5 buckets x 16 rows = 80-row working set
+    for bucket in buckets:
+        a.assign(bucket, range(16))
+    for _ in range(3):
+        for bucket in buckets:
+            rows, scatter = a.assign(bucket, ())
+            assert scatter == [], scatter
+    assert a.evictions == 0
+    assert a.cap >= 80

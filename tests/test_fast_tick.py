@@ -12,7 +12,7 @@ both the deployed default and a gap-sensitive seasonal algorithm, and
 import numpy as np
 import pytest
 
-from benchmarks.worker_bench import build_fleet
+from benchmarks.worker_bench import build_fleet, build_mixed_fleet
 from foremast_tpu.config import BrainConfig
 from foremast_tpu.jobs import (
     BrainWorker,
@@ -26,8 +26,16 @@ CUR_LEN = 30
 
 
 def _mk_worker(services, algorithm, season, band_mode="last", hook=None,
-               seed=0):
-    store, source = build_fleet(services, HIST_LEN, CUR_LEN, NOW, seed=seed)
+               seed=0, baseline_frac=0.0):
+    if baseline_frac > 0:
+        store, source, _ = build_mixed_fleet(
+            services, HIST_LEN, CUR_LEN, NOW, seed=seed,
+            baseline_frac=baseline_frac,
+        )
+    else:
+        store, source = build_fleet(
+            services, HIST_LEN, CUR_LEN, NOW, seed=seed
+        )
     cfg = BrainConfig(algorithm=algorithm, season_steps=season,
                       max_cache_size=4 * services + 64)
     worker = BrainWorker(
@@ -175,7 +183,7 @@ def test_admission_revalidates_per_key_not_wholesale():
     worker._fit_cache.put(key, replacement)
     rows_before = {k: v[1] for k, v in admit.items()}
     worker.tick(now=NOW + 180)
-    assert any(e is replacement for _, _, _, e, _ in admit["job-0"][1])
+    assert any(r[3] is replacement for r in admit["job-0"][1])
     for k in admit:
         if k != "job-0":
             assert admit[k][1] is rows_before[k]  # untouched rowsinfo
@@ -214,3 +222,146 @@ def test_cold_fit_bf16_upload_matches_f32(monkeypatch, algorithm):
     monkeypatch.setenv("FOREMAST_BF16_DELTA", "0")
     assert b_w.tick(now=NOW + 200) == services - 1
     assert _statuses(a_store) == _statuses(b_store)
+
+
+# -- canary columnar bucket (ISSUE 14) --------------------------------------
+
+
+def _hook_recorder(records):
+    def hook(doc, verdicts):
+        for v in verdicts:
+            records.append(
+                (
+                    doc.id,
+                    v.alias,
+                    int(v.verdict),
+                    tuple(v.anomaly_pairs),
+                    np.asarray(v.upper, np.float32).tobytes(),
+                    np.asarray(v.lower, np.float32).tobytes(),
+                    round(float(v.p_value), 7),
+                    bool(v.dist_differs),
+                )
+            )
+
+    return hook
+
+
+@pytest.mark.parametrize(
+    "algorithm,season",
+    [("moving_average_all", 24), ("auto_univariate", 24)],
+    ids=["deployed-default", "gap-sensitive-seasonal"],
+)
+def test_canary_fast_path_engages_and_matches_object_path(algorithm, season):
+    """Baseline-carrying (canary) docs must ride the columnar fast tick
+    as their own bucket (ISSUE 14) and produce statuses, anomaly_info,
+    AND hook verdicts (bands + pairwise p/differs) byte-identical to
+    the object path — including a doc whose BASELINE distribution
+    shifted (dist_differs=True lowers the threshold in-program)."""
+    services = 6
+    fast_rec, slow_rec = [], []
+    fast_w, fast_store, fast_src = _mk_worker(
+        services, algorithm, season, baseline_frac=0.5,
+        hook=_hook_recorder(fast_rec), band_mode="full",
+    )
+    slow_w, slow_store, slow_src = _mk_worker(
+        services, algorithm, season, baseline_frac=0.5,
+        hook=_hook_recorder(slow_rec), band_mode="full",
+    )
+    _force_slow(slow_w)
+    calls = _count_columnar(fast_w)
+
+    assert fast_w.tick(now=NOW + 150) == services
+    assert slow_w.tick(now=NOW + 150) == services
+    assert not calls, "cold tick must not take the fast path"
+    assert _statuses(fast_store) == _statuses(slow_store)
+
+    # spike one canary doc's current window, and SHIFT another canary
+    # doc's baseline distribution (the rank tests must reject and lower
+    # the threshold identically on both paths)
+    for src in (fast_src, slow_src):
+        url = next(
+            u for u in src.data
+            if u.startswith("http://prom/cur") and "latency:app1&" in u
+        )
+        ct, cv = src.data[url]
+        spiked = cv.copy()
+        spiked[-3:] = 40.0
+        src.data[url] = (ct, spiked)
+        burl = next(
+            u for u in src.data
+            if u.startswith("http://prom/base") and "latency:app0&" in u
+        )
+        bt, bv = src.data[burl]
+        src.data[burl] = (bt, (bv + 0.5).astype(np.float32))
+
+    fast_rec.clear()
+    slow_rec.clear()
+    assert fast_w.tick(now=NOW + 200) == services
+    assert slow_w.tick(now=NOW + 200) == services
+    assert calls, "warm re-check tick must take the columnar fast path"
+    assert fast_w._fast_kinds["baseline"] > 0, fast_w._fast_kinds
+    fast_s, slow_s = _statuses(fast_store), _statuses(slow_store)
+    assert fast_s == slow_s
+    assert fast_s["job-1"][0] == STATUS_COMPLETED_UNHEALTH
+    assert sorted(fast_rec) == sorted(slow_rec)
+    # the shifted-baseline doc's hook verdicts must carry the REAL
+    # device pairwise outcome, not the baseline-less constants
+    differs = [r for r in fast_rec if r[0] == "job-0" and r[7]]
+    assert differs, "shifted baseline never rejected same-distribution"
+    assert all(r[6] < 0.05 for r in differs)
+
+
+def test_canary_columnar_opt_out(monkeypatch):
+    """FOREMAST_CANARY_COLUMNAR=0 keeps baseline-carrying docs on the
+    object path (the pre-round-16 routing) with identical judgments."""
+    monkeypatch.setenv("FOREMAST_CANARY_COLUMNAR", "0")
+    off_w, off_store, _ = _mk_worker(
+        4, "moving_average_all", 24, baseline_frac=1.0
+    )
+    assert not off_w._canary_fast
+    monkeypatch.delenv("FOREMAST_CANARY_COLUMNAR")
+    on_w, on_store, _ = _mk_worker(
+        4, "moving_average_all", 24, baseline_frac=1.0
+    )
+    for w in (off_w, on_w):
+        assert w.tick(now=NOW + 150) == 4
+        assert w.tick(now=NOW + 200) == 4
+    assert off_w._fast_kinds["baseline"] == 0
+    assert on_w._fast_kinds["baseline"] == 4
+    assert _statuses(off_store) == _statuses(on_store)
+
+
+def test_canary_doc_with_partial_baseline_aliases():
+    """A canary doc where only SOME aliases carry baselines: the
+    baseline-less aliases judge with the hardwired (p=1, False) inside
+    the pairwise-active program (all-masked baseline rows), matching
+    the object path bit for bit."""
+    services = 3
+    fast_rec, slow_rec = [], []
+    fast_w, fast_store, fast_src = _mk_worker(
+        services, "moving_average_all", 24, baseline_frac=1.0,
+        hook=_hook_recorder(fast_rec),
+    )
+    slow_w, slow_store, slow_src = _mk_worker(
+        services, "moving_average_all", 24, baseline_frac=1.0,
+        hook=_hook_recorder(slow_rec),
+    )
+    _force_slow(slow_w)
+    # strip ONE alias's baseline from one doc on both fleets: the doc
+    # stays canary-shaped but carries a baseline-less row
+    for store in (fast_store, slow_store):
+        doc = store._docs["job-2"]
+        parts = doc.baseline_config.split(" ||")
+        doc.baseline_config = " ||".join(parts[1:])
+    assert fast_w.tick(now=NOW + 150) == services
+    assert slow_w.tick(now=NOW + 150) == services
+    fast_rec.clear()
+    slow_rec.clear()
+    assert fast_w.tick(now=NOW + 200) == services
+    assert slow_w.tick(now=NOW + 200) == services
+    assert fast_w._fast_kinds["baseline"] == services
+    assert _statuses(fast_store) == _statuses(slow_store)
+    assert sorted(fast_rec) == sorted(slow_rec)
+    # the stripped alias reports the baseline-less constants
+    stripped = [r for r in fast_rec if r[0] == "job-2" and r[1] == "latency"]
+    assert stripped and all(r[6] == 1.0 and not r[7] for r in stripped)

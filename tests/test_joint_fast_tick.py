@@ -211,11 +211,20 @@ def test_joint_window_bucket_drift_demotes_to_slow_path():
             ct2 = np.concatenate([ct, ct[-1:] + 60 * np.arange(1, 4)])
             cv2 = np.concatenate([cv, cv[-3:]]).astype(np.float32)
             src.data[url] = (ct2, cv2)
+    from foremast_tpu.chaos.degrade import REASON_DEMOTED
+
+    demoted_before = a._degrade.stats.docs_snapshot().get(REASON_DEMOTED, 0)
     assert a.tick(now=NOW + 200) == SERVICES
     assert b.tick(now=NOW + 200) == SERVICES
     assert _statuses(a_store) == _statuses(b_store)
     # the drifted doc went through the slow path, not the lstm bucket
     assert a._fast_kinds["lstm"] == 0
+    # ... and the demotion was COUNTED on the degraded-docs counter
+    # (ISSUE 14 satellite: it used to ride the slow leftovers silently)
+    demoted_after = a._degrade.stats.docs_snapshot().get(REASON_DEMOTED, 0)
+    assert demoted_after == demoted_before + 1, (
+        demoted_before, demoted_after,
+    )
 
 
 def test_joint_fast_disabled_by_env(monkeypatch):

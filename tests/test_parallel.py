@@ -567,8 +567,9 @@ def test_sharded_judge_columnar_pads_to_data_axis(mesh8):
         )
 
     sharded = ShardedJudge(cfg, mesh=mesh8)
-    sv, sa, su, sl = run(sharded)
-    pv, pa, pu, pl = run(HealthJudge(cfg))
+    sv, sa, su, sl, sp, sd = run(sharded)
+    pv, pa, pu, pl, pp, pd = run(HealthJudge(cfg))
+    assert sp is None and pp is None  # baseline-less: constants host-side
     assert sharded.batch_rows_total % 8 == 0
     assert sharded.pad_rows_total == sharded.batch_rows_total - b0
     assert sharded.mesh_stats["place_calls"] == 1

@@ -192,3 +192,54 @@ def test_friedman_gates_and_all_ties():
     assert not bool(ok[0]) and float(p[0]) == 1.0
     assert not bool(ok[1]) and float(p[1]) == 1.0
     assert np.isfinite(np.asarray(stat)).all()
+
+
+# -- two-sample kernel vs concat masked_ranks (ISSUE 14 rewrite) ------------
+
+
+def test_two_sample_rank_stats_matches_concat_ranks():
+    """The two-sample kernels' (r1, tie) and the r1+r2 identity are
+    BIT-identical to ranking the concatenation with masked_ranks — the
+    exactness argument the kernel rewrite rests on (every count is an
+    exact small integer; rank sums are multiples of 0.5 far below
+    2^23)."""
+    import jax.numpy as jnp
+
+    from foremast_tpu.ops.ranks import _two_sample_rank_stats, masked_ranks
+
+    rng = np.random.default_rng(7)
+    for trial in range(4):
+        b, nx, ny = 64, 17, 23
+        if trial == 0:
+            x = rng.choice([0.0, 0.25, 0.5, 1.0], (b, nx))
+            y = rng.choice([0.0, 0.25, 0.5, 1.0], (b, ny))
+        elif trial == 1:
+            x = rng.normal(1, 0.1, (b, nx))
+            y = rng.normal(1, 0.1, (b, ny))
+        elif trial == 2:
+            x = np.ones((b, nx))
+            y = np.ones((b, ny))  # total cross-sample tie
+        else:
+            x = rng.normal(1, 0.1, (b, nx))
+            y = rng.normal(9, 0.1, (b, ny))  # disjoint supports
+        x = x.astype(np.float32)
+        y = y.astype(np.float32)
+        xm = rng.random((b, nx)) > 0.3
+        ym = rng.random((b, ny)) > 0.3
+        xm[:3] = False  # all-masked sample rows
+        ym[3:6] = False
+        ranks, tie_ref = masked_ranks(
+            jnp.concatenate([jnp.asarray(x), jnp.asarray(y)], axis=-1),
+            jnp.concatenate([jnp.asarray(xm), jnp.asarray(ym)], axis=-1),
+        )
+        r1_ref = np.asarray(jnp.sum(ranks[..., :nx] * xm, axis=-1))
+        r2_ref = np.asarray(jnp.sum(ranks[..., nx:] * ym, axis=-1))
+        r1, tie, n_x, n_y = _two_sample_rank_stats(
+            jnp.asarray(x), jnp.asarray(xm), jnp.asarray(y), jnp.asarray(ym)
+        )
+        n = np.asarray(n_x) + np.asarray(n_y)
+        np.testing.assert_array_equal(np.asarray(r1), r1_ref)
+        np.testing.assert_array_equal(np.asarray(tie), np.asarray(tie_ref))
+        np.testing.assert_array_equal(
+            n * (n + 1.0) * 0.5 - np.asarray(r1), r2_ref
+        )

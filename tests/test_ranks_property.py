@@ -106,3 +106,130 @@ def test_wilcoxon_matches_scipy(pairs):
     w_min = min(stat, nz * (nz + 1) / 2.0 - stat)
     np.testing.assert_allclose(w_min, ref.statistic, rtol=1e-5, atol=1e-3)
     np.testing.assert_allclose(p, ref.pvalue, rtol=1e-3, atol=5e-4)
+
+
+# -- batched columnar kernels vs the scalar reference (ISSUE 14) ------------
+#
+# The canary columnar bucket judges its pairwise tests as ONE batched
+# program over [B, tc] buffers (and the two-sample kernels compute union
+# ranks from [B, Nx, Ny] blocks + the r1+r2 identity instead of ranking
+# the concatenation). These properties pin that the batched forms are
+# POINTWISE identical to running each row alone — lengths, ties, masks,
+# below-min-points gating, and the all-masked-baseline (p=1, False)
+# hardwired outcome included.
+
+from foremast_tpu.config import PAIRWISE_ALL
+from foremast_tpu.engine.scoring import pairwise
+from foremast_tpu.ops.windows import MetricWindows
+
+_grid = st.sampled_from([0.0, 0.25, 0.5, 1.0, 2.0, 3.5])
+_row = st.tuples(
+    st.lists(_grid, min_size=0, max_size=40),           # current values
+    st.lists(_grid, min_size=0, max_size=40),           # baseline values
+    st.integers(min_value=0, max_value=7),              # mask pattern seed
+)
+
+
+def _pack_rows(rows, tc):
+    b = len(rows)
+    cur = np.zeros((b, tc), np.float32)
+    curm = np.zeros((b, tc), bool)
+    base = np.zeros((b, tc), np.float32)
+    basem = np.zeros((b, tc), bool)
+    for i, (cv, bv, mseed) in enumerate(rows):
+        rng = np.random.default_rng(mseed)
+        nc = min(len(cv), tc)
+        nb = min(len(bv), tc)
+        cur[i, :nc] = cv[:nc]
+        base[i, :nb] = bv[:nb]
+        # masks with random holes (invalid samples INSIDE the window)
+        curm[i, :nc] = rng.random(nc) > 0.15 if nc else False
+        basem[i, :nb] = rng.random(nb) > 0.15 if nb else False
+    return cur, curm, base, basem
+
+
+def _decide(cur, curm, base, basem):
+    def win(v, m):
+        return MetricWindows(
+            values=np.asarray(v, np.float32),
+            mask=np.asarray(m, bool),
+            times=None,
+        )
+
+    p, differs = pairwise(
+        win(cur, curm),
+        win(base, basem),
+        PAIRWISE_ALL,
+        0.05,
+        20,
+        20,
+        5,
+        20,
+    )
+    return np.asarray(p), np.asarray(differs)
+
+
+@settings(max_examples=40, deadline=None)
+@given(rows=st.lists(_row, min_size=1, max_size=9))
+def test_batched_pairwise_decision_matches_per_row(rows):
+    """pairwise_decision over a [B, tc] batch == each row judged alone
+    (B=1): batching is never allowed to leak across rows, whatever the
+    mix of lengths, ties, masks, and gate outcomes in the batch."""
+    tc = 40
+    cur, curm, base, basem = _pack_rows(rows, tc)
+    p_b, d_b = _decide(cur, curm, base, basem)
+    for i in range(len(rows)):
+        p_1, d_1 = _decide(
+            cur[i : i + 1], curm[i : i + 1],
+            base[i : i + 1], basem[i : i + 1],
+        )
+        assert p_b[i] == p_1[0], (i, p_b[i], p_1[0])
+        assert d_b[i] == d_1[0], (i, rows[i])
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    cv=st.lists(_grid, min_size=21, max_size=40),
+    mseed=st.integers(min_value=0, max_value=100),
+)
+def test_all_masked_baseline_is_hardwired_constant(cv, mseed):
+    """An all-masked (absent) baseline gates every rank test off: the
+    decision is EXACTLY (p=1.0, differs=False) — the invariant that
+    makes the baseline-less PAIRWISE_NONE program byte-equivalent."""
+    tc = 40
+    rng = np.random.default_rng(mseed)
+    cur = np.zeros((1, tc), np.float32)
+    cur[0, : len(cv)] = cv
+    curm = np.zeros((1, tc), bool)
+    curm[0, : len(cv)] = rng.random(len(cv)) > 0.1
+    base = rng.normal(1.0, 0.3, (1, tc)).astype(np.float32)
+    basem = np.zeros((1, tc), bool)  # values present, mask says absent
+    p, differs = _decide(cur, curm, base, basem)
+    assert p[0] == 1.0 and not differs[0]
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    n_cur=st.integers(min_value=0, max_value=25),
+    n_base=st.integers(min_value=0, max_value=25),
+)
+def test_below_min_points_gates_to_inconclusive(n_cur, n_base):
+    """Below every test's min-points gate the decision must be the
+    inconclusive constant — including the asymmetric cases (one side
+    rich, the other sparse)."""
+    if n_cur >= 20 or n_base >= 5:
+        # kruskal's gate is 5/side; stay strictly under every gate on
+        # at least one side so NO test can be applicable
+        n_base = min(n_base, 4)
+    tc = 32
+    rng = np.random.default_rng(n_cur * 31 + n_base)
+    cur = np.zeros((1, tc), np.float32)
+    base = np.zeros((1, tc), np.float32)
+    curm = np.zeros((1, tc), bool)
+    basem = np.zeros((1, tc), bool)
+    cur[0, :n_cur] = rng.normal(1.0, 0.3, n_cur)
+    base[0, :n_base] = rng.normal(5.0, 0.3, n_base)  # wildly different
+    curm[0, :n_cur] = True
+    basem[0, :n_base] = True
+    p, differs = _decide(cur, curm, base, basem)
+    assert p[0] == 1.0 and not differs[0]
