@@ -88,16 +88,16 @@ def main():
             times.append(time.perf_counter() - t0)
 
     windows_per_sec = B * ITERS / statistics.median(times)
-    print(
-        json.dumps(
-            {
-                "metric": "metric_windows_per_sec",
-                "value": round(windows_per_sec, 1),
-                "unit": "windows/s",
-                "vs_baseline": round(windows_per_sec / PER_CHIP_BASELINE, 3),
-            }
-        )
-    )
+    result = {
+        "metric": "metric_windows_per_sec",
+        "value": round(windows_per_sec, 1),
+        "unit": "windows/s",
+        "vs_baseline": round(windows_per_sec / PER_CHIP_BASELINE, 3),
+    }
+    print(json.dumps(result))
+    from benchmarks.report import write_summary
+
+    write_summary("engine", result)
 
 
 if __name__ == "__main__":

@@ -623,7 +623,12 @@ def main(argv=None) -> int:
         "--small", action="store_true", help="CPU smoke shapes (CI)"
     )
     args = parser.parse_args(argv)
-    run(small=args.small)
+    phases = run(small=args.small)
+    from benchmarks.report import write_summary
+
+    write_summary(
+        "chaos", {"phases": phases}, small=args.small
+    )
     return 0
 
 

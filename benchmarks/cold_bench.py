@@ -445,6 +445,9 @@ def main(argv=None):
         full_bars=full_bars,
     )
     print(json.dumps(result), flush=True)
+    from benchmarks.report import write_summary
+
+    write_summary("cold", result, small=args.small)
     return 0
 
 

@@ -315,6 +315,9 @@ def main(argv=None):
         args.hist_len = min(args.hist_len, 128)
     result = run(args.services, args.aliases, args.hist_len, args.cur_len)
     print(json.dumps(result), flush=True)
+    from benchmarks.report import write_summary
+
+    write_summary("ingest", result, small=args.small)
     return 0
 
 

@@ -441,8 +441,24 @@ def main(argv=None):
     # seeded draws make them exact pins)
     b = 16 if small else 128
     th = 240 if small else 1008
+    scenario_rows = []
     for row in run_scenarios(b, th, 30):
         row["config"] = "q-scenario-matrix"
+        scenario_rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    # phase 3b: label-shape routing/ownership cells (ISSUE 15
+    # satellite — ROADMAP item 4's multi-cluster / multi-tenant
+    # generator gap): doc↔series co-location and ownership spread must
+    # be invariant across label shapes, asserted inside the cell
+    from benchmarks.scenarios import LABEL_SHAPES, label_shape_routing_cell
+
+    label_rows = []
+    for shape in LABEL_SHAPES:
+        row = label_shape_routing_cell(
+            shape, services=64 if small else 1024
+        )
+        label_rows.append(row)
         print(json.dumps(row), flush=True)
 
     # phase 4: pusher fan-in shapes over the real receiver
@@ -450,8 +466,23 @@ def main(argv=None):
 
     fan_services = 16 if small else 1024
     fan_hist = min(args.hist_len, 256) if small else 2048
-    for row in run_fanin(fan_services, fan_hist, args.cur_len, FAN_IN_SHAPES):
+    fanin_rows = run_fanin(
+        fan_services, fan_hist, args.cur_len, FAN_IN_SHAPES
+    )
+    for row in fanin_rows:
         print(json.dumps(row), flush=True)
+    from benchmarks.report import write_summary
+
+    write_summary(
+        "mixed",
+        {
+            "canary": canary,
+            "scenario_matrix": scenario_rows,
+            "label_shapes": label_rows,
+            "fan_in": fanin_rows,
+        },
+        small=small,
+    )
     return 0
 
 

@@ -205,6 +205,9 @@ def main(argv=None):
         fetch_workers=args.fetch_workers,
     )
     print(json.dumps(result), flush=True)
+    from benchmarks.report import write_summary
+
+    write_summary("pipeline", result, small=args.small)
     return 0
 
 

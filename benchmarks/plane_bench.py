@@ -129,6 +129,9 @@ def main(argv=None):
     result["value"] = result["poll_tick_seconds"]
     result["unit"] = "seconds"
     print(json.dumps(result), flush=True)
+    from benchmarks.report import write_summary
+
+    write_summary("plane", result, small=args.small)
     return 0
 
 

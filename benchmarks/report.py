@@ -1,0 +1,121 @@
+"""Machine-readable bench summaries: ``BENCH_rNN.json`` (ISSUE 15).
+
+BENCHMARKS.md pins each round's numbers as prose; CI cannot diff prose.
+Every ``make bench-*`` entry point now ALSO folds its headline result
+into one JSON artifact per benchmark round at the repo root:
+
+    BENCH_r17.json
+    {
+      "round": 17,
+      "generated_by": "benchmarks.report",
+      "results": {
+        "latency": {"asserts_passed": true, ...headline numbers...},
+        "mixed":   {...},
+        ...
+      }
+    }
+
+so the perf trajectory (throughput, p50/p99, in-run asserts) is
+diffable across PRs. One file per round, one key per bench — re-running
+a bench inside the same round overwrites only its own key.
+
+Round resolution: ``FOREMAST_BENCH_ROUND`` when set (re-running a bench
+for an already-pinned round), else the highest ``## Round N`` heading
+in BENCHMARKS.md **plus one** — a bench run is, by definition, the
+round being measured for the NEXT BENCHMARKS.md entry.
+
+``--small`` smoke runs never write (tier-1 tests must not dirty the
+tree); pass ``path`` to redirect (tests use a tmpdir).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+# BENCHMARKS.md headings carry the round as "## <title> (round N, ...)"
+# (a plain "## Round N" also counts, future-proofing)
+_ROUND_RE = re.compile(
+    r"^## (?:Round (\d+)|[^\n]*\(round (\d+))", re.MULTILINE | re.IGNORECASE
+)
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def current_round(root: str | None = None) -> int:
+    """The round this bench run measures (see module docstring)."""
+    env = os.environ.get("FOREMAST_BENCH_ROUND", "")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    root = _repo_root() if root is None else root
+    try:
+        with open(os.path.join(root, "BENCHMARKS.md")) as f:
+            rounds = [
+                int(a or b) for a, b in _ROUND_RE.findall(f.read())
+            ]
+    except OSError:
+        rounds = []
+    return (max(rounds) + 1) if rounds else 1
+
+
+def write_summary(
+    bench: str,
+    result: dict,
+    small: bool = False,
+    asserts_passed: bool = True,
+    path: str | None = None,
+) -> str | None:
+    """Fold one bench's headline result into the round's JSON artifact.
+
+    Returns the file path written, or None for smoke runs. `result`
+    must already be JSON-serializable (every bench prints it as a JSON
+    line — this is the same dict). Failures to write are raised: a CI
+    lane asking for the artifact must not silently get prose only."""
+    if small:
+        return None
+    if path is None:
+        rnd = current_round()
+        path = os.path.join(_repo_root(), f"BENCH_r{rnd:02d}.json")
+    else:
+        rnd = current_round(os.path.dirname(path) or ".")
+    doc = {"round": rnd, "generated_by": "benchmarks.report", "results": {}}
+    try:
+        with open(path) as f:
+            existing = json.load(f)
+    except OSError:
+        existing = None  # absent: start fresh
+    except ValueError as e:
+        raise ValueError(
+            f"{path} exists but is not JSON; refusing to overwrite a "
+            "foreign artifact — set FOREMAST_BENCH_ROUND"
+        ) from e
+    if existing is not None:
+        if not (
+            isinstance(existing, dict)
+            and existing.get("generated_by") == "benchmarks.report"
+        ):
+            # a file we did not write (e.g. a driver artifact from an
+            # early round) must never be clobbered — fail loudly, the
+            # round resolution is misconfigured
+            raise ValueError(
+                f"{path} exists with a foreign schema; refusing to "
+                "overwrite — set FOREMAST_BENCH_ROUND to the intended "
+                "round"
+            )
+        doc = existing
+        doc["round"] = rnd
+        if not isinstance(doc.get("results"), dict):
+            doc["results"] = {}
+    doc["results"][bench] = dict(result, asserts_passed=asserts_passed)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path

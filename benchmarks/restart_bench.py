@@ -575,8 +575,14 @@ def main(argv=None):
         variants.append(False)
     if args.mesh or not args.single:
         variants.append(True)
+    results = {}
     for mesh in variants:
-        print(json.dumps(run(args, mesh)), flush=True)
+        result = run(args, mesh)
+        results["mesh" if mesh else "single"] = result
+        print(json.dumps(result), flush=True)
+    from benchmarks.report import write_summary
+
+    write_summary("restart", results, small=args.small)
     return 0
 
 

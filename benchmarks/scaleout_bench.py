@@ -1249,6 +1249,13 @@ def main(argv=None):
     if args.services >= 16384 and worker_counts[-1] >= 4:
         assert summary["value"] and summary["value"] >= 3.0, summary
     print(json.dumps(summary), flush=True)
+    from benchmarks.report import write_summary
+
+    write_summary(
+        "scaleout_sharded" if args.device_mesh > 1 else "scaleout",
+        summary,
+        small=args.small,
+    )
     return 0
 
 

@@ -43,6 +43,17 @@ REGIMES = ("diurnal", "spiky", "stair", "outage")
 # concurrent pushers split the fleet's series (1 = one batching agent,
 # 8 = per-node agents converging on one receiver)
 FAN_IN_SHAPES = (1, 8)
+# label SHAPES (ISSUE 15 satellite / ROADMAP item 4's remaining
+# generator gap): how a fleet's series are labeled. `single` is the
+# rounds-5..16 shape (one flat namespace); `multi_cluster` spreads the
+# same apps over federated clusters (a `cluster` label on every
+# series); `multi_tenant` adds a `tenant` label on top — the
+# multi-team SaaS shape where one app name exists per tenant. Routing
+# and ownership must be label-shape-INVARIANT: the mesh routes by the
+# `app` label value alone, so a service's doc, fits, arena rows and
+# pushed series co-locate on one worker no matter how many extra
+# labels the selector carries (`label_shape_routing_cell` proves it).
+LABEL_SHAPES = ("single", "multi_cluster", "multi_tenant")
 
 PERIOD = 24
 NOISE = 0.05
@@ -202,6 +213,89 @@ def score_scenario(
     precision, recall, f1 = prf1(tp, fp, fn)
     differs_rate = float(np.asarray(res.dist_differs).mean())
     return f1, precision, recall, differs_rate
+
+
+def scenario_labels(
+    shape: str,
+    s: int,
+    clusters: int = 4,
+    tenants: int = 8,
+) -> dict[str, str]:
+    """The label set of service index `s` under a label shape."""
+    labels = {"namespace": "bench", "app": f"app{s}"}
+    if shape == "single":
+        return labels
+    labels["cluster"] = f"c{s % clusters}"
+    if shape == "multi_tenant":
+        labels["tenant"] = f"t{s % tenants}"
+        return labels
+    if shape != "multi_cluster":
+        raise ValueError(shape)
+    return labels
+
+
+def scenario_selector(
+    shape: str,
+    s: int,
+    metric: str = "latency",
+    clusters: int = 4,
+    tenants: int = 8,
+) -> str:
+    """A PromQL selector for service `s` under a label shape (label
+    order deliberately NON-canonical — cluster/tenant first — so the
+    cell also proves canonicalization, not just extraction)."""
+    labels = scenario_labels(shape, s, clusters, tenants)
+    body = ",".join(
+        f'{k}="{v}"' for k, v in reversed(sorted(labels.items()))
+    )
+    return f"{metric}{{{body}}}"
+
+
+def label_shape_routing_cell(
+    shape: str,
+    services: int = 256,
+    workers: int = 4,
+    route_label: str = "app",
+) -> dict:
+    """The routing/ownership proof for one label shape: every
+    service's DOC route key and SERIES route key resolve to the same
+    ring owner (doc↔series co-location — the invariant the mesh claim
+    filter, the dirty set's ownership probe, and the receiver's
+    accept-and-hint all assume), regardless of extra cluster/tenant
+    labels; and ownership stays spread (no shape may collapse the
+    fleet onto one member). Raises AssertionError on violation;
+    returns the cell row for the bench table."""
+    from foremast_tpu.ingest.wire import canonical_series
+    from foremast_tpu.jobs.models import Document
+    from foremast_tpu.mesh.partition import HashRing
+    from foremast_tpu.mesh.routing import doc_route_key, series_route_key
+
+    ring = HashRing([f"w{i}" for i in range(workers)])
+    owners: dict[str, int] = {}
+    for s in range(services):
+        selector = scenario_selector(shape, s)
+        key = canonical_series(selector)
+        doc = Document(id=f"job-{s}", app_name=f"app{s}")
+        rk_doc = doc_route_key(doc)
+        rk_series = series_route_key(key, route_label)
+        assert rk_doc == rk_series == f"app{s}", (
+            shape, selector, rk_doc, rk_series,
+        )
+        owner = ring.owner(rk_doc)
+        assert owner == ring.owner(rk_series), (shape, s)
+        owners[owner] = owners.get(owner, 0) + 1
+    # spread sanity: with blake2b points, 256 keys over 4 workers
+    # cannot legally land on one member; a collapse means the label
+    # shape leaked into the route key
+    assert len(owners) == workers, owners
+    return {
+        "config": "q-label-shape-routing",
+        "label_shape": shape,
+        "services": services,
+        "workers": workers,
+        "owners": {k: owners[k] for k in sorted(owners)},
+        "co_located": True,
+    }
 
 
 def scenario_matrix(b: int, th: int, tc: int, seed: int = 0) -> list[dict]:

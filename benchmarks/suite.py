@@ -50,9 +50,13 @@ def _bench(fn, *args, iters=5):
     return (time.perf_counter() - t0) / iters
 
 
+_EMITTED: list[dict] = []  # this process's rows, for the JSON summary
+
+
 def _emit(config, metric, value, unit, **extra):
     line = {"config": config, "metric": metric, "value": round(value, 2), "unit": unit}
     line.update(extra)
+    _EMITTED.append(line)
     print(json.dumps(line), flush=True)
 
 
@@ -365,6 +369,9 @@ def main(argv=None):
     keys = [args.config] if args.config else list(CONFIGS)
     for k in keys:
         CONFIGS[k](args.small)
+    from benchmarks.report import write_summary
+
+    write_summary("suite", {"configs": list(_EMITTED)}, small=args.small)
     return 0
 
 

@@ -31,7 +31,12 @@ Semantics (docs/operations.md "Failure modes & degradation"):
   whose fetch/judge did not start before the deadline are RELEASED
   un-judged — status back to ``preprocess_completed``, claimable next
   tick — instead of wedging the tick behind a slow dependency. Counted
-  per reason; never silent.
+  per reason; never silent. A SLICED sweep (ISSUE 15) accounts the
+  same budget at slice granularity: the deadline is set once at sweep
+  start, every slice's prepare stage checks it before fetching, and
+  on expiry the still-pooled remainder releases as ONE bulk write
+  (``deadline_released``) instead of judging over budget — so the
+  budget bounds sweep wall clock with at most one slice of overshoot.
 """
 
 from __future__ import annotations
@@ -53,6 +58,11 @@ REASON_FETCH = "fetch_released"
 # breaks mid-tick): re-routed to the slow path for a refit — counted
 # here so demotions never ride the slow leftovers silently (ISSUE 14)
 REASON_DEMOTED = "fast_demoted"
+# a sliced sweep aborted mid-flight (judge/write stage death): slices
+# that were claimed + prepared but never judged give their docs back
+# un-judged instead of parking them behind the stuck-takeover window
+# (ISSUE 15 — the bounded-slice philosophy applied to the abort path)
+REASON_ABORT = "sweep_aborted"
 REASON_BUFFERED = "write_buffered"
 REASON_REPLAYED = "write_replayed"
 REASON_DROPPED_CAP = "write_dropped_cap"

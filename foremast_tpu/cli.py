@@ -807,6 +807,14 @@ def cmd_worker(args: argparse.Namespace) -> int:
             degrade=degrade,
             dirty=dirty,
         )
+        if worker._sweep_sliceable():
+            logging.getLogger("foremast_tpu.cli").info(
+                "sliced sweeps ON: %d-doc slices under the %d-doc "
+                "claim, dirty-drain preemption at slice boundaries "
+                "(FOREMAST_SWEEP_SLICE_DOCS; docs/operations.md "
+                "\"Event-driven detection\")",
+                worker.sweep_slice_docs, worker.claim_limit,
+            )
         if snap_dir:
             # fit journals restore lazily (the first claim of each doc
             # rehydrates its fits, so admission passes with no history

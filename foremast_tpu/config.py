@@ -686,6 +686,22 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "micro-tick or sweep",
     ),
     EnvKnob(
+        "FOREMAST_SWEEP_SLICE_DOCS",
+        "2048",
+        "int",
+        "sliced, preemptible sweeps (ISSUE 15, docs/operations.md "
+        "\"Event-driven detection\"): a full sweep whose claim exceeds "
+        "this many docs runs as bounded SLICES through the warm-path "
+        "pipeline — the prefetch thread packs slice N+1 while the "
+        "device runs slice N and the writer decodes + bulk-writes "
+        "slice N-1 — with a dirty-drain preemption point at every "
+        "slice boundary, so pushed-anomaly p99 is bounded by one "
+        "slice's wall clock instead of the sweep's. `0` = monolithic "
+        "ticks (the pre-ISSUE-15 behavior; also forced in pod mode). "
+        "Smaller slices tighten the latency bound and cost more "
+        "per-dispatch overhead; see the slice-size tuning guidance",
+    ),
+    EnvKnob(
         "FOREMAST_MICROTICK_DIRTY_MAX",
         "8192",
         "int",
@@ -1008,6 +1024,15 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "dashboard's charted namespace label",
     ),
     EnvKnob("FOREMAST_UI_APP", "demo", "str", "dashboard's charted app label"),
+    EnvKnob(
+        "FOREMAST_BENCH_ROUND",
+        None,
+        "int",
+        "benchmark-round override for the BENCH_rNN.json summaries "
+        "(benchmarks/report.py): set when re-running a bench for an "
+        "already-pinned BENCHMARKS.md round; unset, the round is the "
+        "highest pinned round + 1",
+    ),
     # -- deployment / platform integration
     EnvKnob(
         "NAMESPACE",

@@ -737,6 +737,11 @@ class HealthJudge:
         base_mask: np.ndarray | None = None,
     ):
         """Columnar warm-tick scoring: arrays in, compact arrays out.
+        Dispatch + blocking gather in one call — `judge_columnar_async`
+        + `ColumnarPending.wait()` split the two halves so a pipelined
+        caller can overlap the device's execution with host work
+        (ISSUE 15); this wrapper IS that split, so the monolithic and
+        pipelined paths cannot diverge.
 
         The worker's fleet fast path (jobs/worker.py _fast_tick) calls
         this for re-check ticks where EVERY row already carries a cached
@@ -767,6 +772,48 @@ class HealthJudge:
         p/differs are None on the baseline-less variant (the host fills
         the (1.0, False) constants itself).
         """
+        return self.judge_columnar_async(
+            values,
+            mask,
+            keys,
+            entries,
+            nidx,
+            thr,
+            bound,
+            mlb,
+            gap_steps=gap_steps,
+            with_bands=with_bands,
+            base_values=base_values,
+            base_mask=base_mask,
+        ).wait()
+
+    def judge_columnar_async(
+        self,
+        values: np.ndarray,
+        mask: np.ndarray,
+        keys: list,
+        entries: list,
+        nidx: np.ndarray,
+        thr: np.ndarray,
+        bound: np.ndarray,
+        mlb: np.ndarray,
+        gap_steps: np.ndarray | None = None,
+        with_bands: bool = True,
+        base_values: np.ndarray | None = None,
+        base_mask: np.ndarray | None = None,
+    ) -> "ColumnarPending":
+        """The dispatch half of `judge_columnar` (ISSUE 15): pad, place
+        (one H2D off the caller's HOST numpy — the handoff contract that
+        keeps a sharded judge's placement a single copy), run the arena
+        gather + score + compact programs, and return WITHOUT blocking.
+        JAX async dispatch means the device is now executing while the
+        caller packs the next slice or decodes the previous one; the
+        only blocking point is `ColumnarPending.wait()`'s gather.
+
+        Arena mutation (assign/scatter) happens HERE, so dispatch calls
+        must stay on one thread in slice order — the same contract the
+        slow pipeline pins for its judge stage. wait() touches no arena
+        state and may run on a writer thread."""
         cfg = self.config
         b0, tc = values.shape
         pairwise = base_values is not None
@@ -865,64 +912,75 @@ class HealthJudge:
         )
         gap = None if gap_steps is None else jnp.asarray(gap_steps)
         res = self._arena_score(batch, keys, entries, (), gap, pw)
+        # dispatch the compact program too (still async): the pending
+        # handle holds only the small result-shaped device arrays, so a
+        # pipelined caller queues O(depth) compact outputs, never whole
+        # score batches
+        full = with_bands and self.band_mode == "full"
+        if full:
+            # full [B, tc] bands for custom hooks (parity with the
+            # object path's "full" mode — same band shape on warm
+            # and cold ticks)
+            if pairwise:
+                dev = _compact_full_pair(
+                    res.verdict, res.anomalies, res.upper,
+                    res.lower, res.p_value, res.dist_differs,
+                )
+            else:
+                dev = _compact_full_nopair(
+                    res.verdict, res.anomalies, res.upper, res.lower
+                )
+        elif with_bands:
+            if pairwise:
+                dev = _compact_result(
+                    res.verdict,
+                    res.anomalies,
+                    res.upper,
+                    res.lower,
+                    res.p_value,
+                    res.dist_differs,
+                    jnp.asarray(nidx),
+                )
+            else:
+                dev = _compact_result_nopair(
+                    res.verdict,
+                    res.anomalies,
+                    res.upper,
+                    res.lower,
+                    jnp.asarray(nidx),
+                )
+        else:
+            if pairwise:
+                dev = _compact_min_pair(
+                    res.verdict, res.anomalies,
+                    res.p_value, res.dist_differs,
+                )
+            else:
+                dev = _compact_min(res.verdict, res.anomalies)
+        return ColumnarPending(
+            self, dev, b0, tc, rows_b, with_bands, pairwise
+        )
+
+    def _columnar_wait(self, pending: "ColumnarPending"):
+        """The gather half: ONE overlapped device->host fetch of the
+        compact result arrays, then the host-side unpack. No judge
+        state is touched — safe off the tick thread."""
+        b0, tc = pending.b0, pending.tc
         with span(
-            "judge.decode", stage="decode", rows=rows_b, device=True
+            "judge.decode", stage="decode", rows=pending.rows, device=True
         ):
             ps = differs = None
-            if with_bands and self.band_mode == "full":
-                # full [B, tc] bands for custom hooks (parity with the
-                # object path's "full" mode — same band shape on warm
-                # and cold ticks)
-                if pairwise:
-                    v8, packed, ub, lb, ps, differs = self._fetch(
-                        _compact_full_pair(
-                            res.verdict, res.anomalies, res.upper,
-                            res.lower, res.p_value, res.dist_differs,
-                        )
-                    )
-                else:
-                    v8, packed, ub, lb = self._fetch(
-                        _compact_full_nopair(
-                            res.verdict, res.anomalies, res.upper, res.lower
-                        )
-                    )
+            if pending.with_bands and pending.pairwise:
+                v8, packed, ub, lb, ps, differs = self._fetch(pending.dev)
                 ub, lb = ub[:b0], lb[:b0]
-            elif with_bands:
-                if pairwise:
-                    v8, packed, ub, lb, ps, differs = self._fetch(
-                        _compact_result(
-                            res.verdict,
-                            res.anomalies,
-                            res.upper,
-                            res.lower,
-                            res.p_value,
-                            res.dist_differs,
-                            jnp.asarray(nidx),
-                        )
-                    )
-                else:
-                    v8, packed, ub, lb = self._fetch(
-                        _compact_result_nopair(
-                            res.verdict,
-                            res.anomalies,
-                            res.upper,
-                            res.lower,
-                            jnp.asarray(nidx),
-                        )
-                    )
+            elif pending.with_bands:
+                v8, packed, ub, lb = self._fetch(pending.dev)
                 ub, lb = ub[:b0], lb[:b0]
+            elif pending.pairwise:
+                v8, packed, ps, differs = self._fetch(pending.dev)
+                ub = lb = None
             else:
-                if pairwise:
-                    v8, packed, ps, differs = self._fetch(
-                        _compact_min_pair(
-                            res.verdict, res.anomalies,
-                            res.p_value, res.dist_differs,
-                        )
-                    )
-                else:
-                    v8, packed = self._fetch(
-                        _compact_min(res.verdict, res.anomalies)
-                    )
+                v8, packed = self._fetch(pending.dev)
                 ub = lb = None
             anoms = np.unpackbits(packed, axis=1, count=tc)
         if ps is not None:
@@ -1104,6 +1162,32 @@ class HealthJudge:
                 )
             )
         return out
+
+
+class ColumnarPending:
+    """A dispatched-but-ungathered columnar judgment (ISSUE 15).
+
+    Holds the compact result arrays still resident on the device plus
+    the decode shape. The device may still be executing; `wait()` is
+    the one blocking point (`HealthJudge._columnar_wait` — a sharded
+    judge's `_fetch` override rides along, so mesh-partitioned slices
+    gather exactly as the monolithic call did). Thread contract: the
+    producing `judge_columnar_async` call ran on the dispatch (tick)
+    thread; `wait()` may run on any single consumer thread."""
+
+    __slots__ = ("judge", "dev", "b0", "tc", "rows", "with_bands", "pairwise")
+
+    def __init__(self, judge, dev, b0, tc, rows, with_bands, pairwise):
+        self.judge = judge
+        self.dev = dev
+        self.b0 = b0
+        self.tc = tc
+        self.rows = rows
+        self.with_bands = with_bands
+        self.pairwise = pairwise
+
+    def wait(self):
+        return self.judge._columnar_wait(self)
 
 
 def combine_verdicts(verdicts: Sequence[MetricVerdict]) -> int:

@@ -50,6 +50,18 @@ loop would have stopped) and re-raises on the tick thread. On every
 path the writer thread is joined and in-flight prefetches are awaited
 before ``run()`` returns, so no stage thread outlives the tick and
 every chunk judged before the failure is persisted.
+
+ISSUE 15 extensions (the warm-path sliced sweep rides the same class):
+
+  * ``run()`` accepts an unbounded ITERATOR of chunk specs; a lazy
+    fetch stage (a claim-pool-backed slice preparer) returns ``END``
+    to stop feeding — the pipeline drains cleanly, never judging END.
+  * ``boundary`` — a tick-thread hook invoked between chunks, after
+    chunk N's result is handed to the writer: the sliced sweep's
+    micro-tick PREEMPTION POINT.
+  * ``on_drained(chunk, payload)`` — abort-path callback for chunks
+    whose fetch completed but whose judgment never ran, so a fetch
+    stage with side effects (claimed documents) can give them back.
 """
 
 from __future__ import annotations
@@ -65,6 +77,14 @@ log = logging.getLogger("foremast_tpu.pipeline")
 DEFAULT_DEPTH = 2
 
 _DONE = object()
+
+# Lazy-source exhaustion sentinel (ISSUE 15): a fetch stage backed by a
+# claim pool (the sliced sweep) signals "no more work" by RETURNING
+# `END` — the pipeline stops feeding, drains in-flight stages, and
+# never judges or writes the END chunk. Lists keep working unchanged;
+# END just lets `run()` accept an unbounded iterator of slice specs
+# whose real extent only the fetch stage can discover.
+END = object()
 
 
 class StageError(Exception):
@@ -165,29 +185,46 @@ class ChunkPipeline:
         write,
         depth: int = DEFAULT_DEPTH,
         prefetch_pool=None,
+        boundary=None,
+        on_drained=None,
     ):
         self.fetch = fetch
         self.judge = judge
         self.write = write
         self.depth = max(1, int(depth))
         self.prefetch_pool = prefetch_pool
+        # `boundary` (ISSUE 15): a tick-thread hook invoked between
+        # chunks — after chunk N's judgment is handed to the writer and
+        # before chunk N+1's is started. The sliced sweep's PREEMPTION
+        # POINT: the worker drains pending dirty arrivals here while
+        # the writer flushes N and the prefetch pool prepares N+1. A
+        # boundary exception aborts the run exactly like a judge
+        # exception (clean drain, then re-raise).
+        self.boundary = boundary
+        # `on_drained(chunk, payload)` (tick thread, abort path only):
+        # invoked for every chunk whose fetch COMPLETED but whose
+        # judgment never ran when run() aborts. Fetch stages with side
+        # effects (the sliced sweep's prepare stage holds CLAIMED docs)
+        # use it to give that work back instead of leaving it to the
+        # stuck-claim takeover window.
+        self.on_drained = on_drained
         # stats of the most recent run(), including one that raised —
         # callers surface occupancy on the abort path from here
         self.last_stats: PipelineStats | None = None
 
-    def run(self, chunks: list) -> PipelineStats:
+    def run(self, chunks) -> PipelineStats:
         stats = PipelineStats(self.depth)
         self.last_stats = stats
-        stats.chunks = len(chunks)
-        stats.docs = sum(
-            len(c) if hasattr(c, "__len__") else 1 for c in chunks
-        )
+        sized = hasattr(chunks, "__len__")
+        if sized:
+            stats.chunks = len(chunks)
+            stats.docs = sum(
+                len(c) if hasattr(c, "__len__") else 1 for c in chunks
+            )
         t_wall = time.perf_counter()
         try:
-            if (
-                self.depth <= 1
-                or len(chunks) <= 1
-                or self.prefetch_pool is None
+            if self.depth <= 1 or self.prefetch_pool is None or (
+                sized and len(chunks) <= 1
             ):
                 self._run_serial(chunks, stats)
             else:
@@ -199,6 +236,7 @@ class ChunkPipeline:
         return stats
 
     def _run_serial(self, chunks, stats: PipelineStats) -> None:
+        sized = hasattr(chunks, "__len__")
         for chunk in chunks:
             t0 = time.perf_counter()
             payload = self.fetch(chunk)
@@ -206,6 +244,11 @@ class ChunkPipeline:
             # accumulated before judging so the abort-path snapshot
             # (completed=False) still carries the chunk's fetch cost
             stats.fetch_seconds += t1 - t0
+            if payload is END:
+                break
+            if not sized:
+                stats.chunks += 1
+                stats.docs += len(chunk) if hasattr(chunk, "__len__") else 1
             try:
                 result = self.judge(chunk, payload)
             except StageError as se:
@@ -218,6 +261,8 @@ class ChunkPipeline:
             stats.judge_seconds += t2 - t1
             self.write(chunk, result)
             stats.write_seconds += time.perf_counter() - t2
+            if self.boundary is not None:
+                self.boundary()
 
     def _run_pipelined(self, chunks, stats: PipelineStats) -> None:
         write_errors: list[BaseException] = []
@@ -262,28 +307,51 @@ class ChunkPipeline:
             payload = self.fetch(chunk)
             return time.perf_counter() - t0, payload
 
+        # one iterator serves lists and lazy sources alike; a lazy
+        # source's true extent surfaces as an END payload from fetch
+        sized = hasattr(chunks, "__len__")
+        it = iter(chunks)
         pending: collections.deque = collections.deque()
-        next_up = 0
+        exhausted = [False]
 
         def submit_next():
-            nonlocal next_up
-            if next_up < len(chunks):
-                pending.append(
-                    self.prefetch_pool.submit(timed_fetch, chunks[next_up])
-                )
-                next_up += 1
+            if exhausted[0]:
+                return
+            try:
+                chunk = next(it)
+            except StopIteration:
+                exhausted[0] = True
+                return
+            pending.append(
+                (chunk, self.prefetch_pool.submit(timed_fetch, chunk))
+            )
 
         try:
             for _ in range(self.depth - 1):
                 submit_next()
-            for chunk in chunks:
+            while pending:
                 if write_errors:
                     break  # writer failed; don't burn device time on
                     # a judgment whose result could never be written
+                chunk, fut = pending.popleft()
                 t0 = time.perf_counter()
-                fetch_s, payload = pending.popleft().result()
+                fetch_s, payload = fut.result()
                 stats.judge_stall_seconds += time.perf_counter() - t0
                 stats.fetch_seconds += fetch_s
+                if payload is END:
+                    # lazy source drained: stop SUBMITTING, but keep
+                    # consuming the deque — with 2+ prefetch workers
+                    # (depth >= 3) a fully prepared chunk can sit
+                    # QUEUED BEHIND the END that raced it for the
+                    # source's last items; abandoning it to the drain
+                    # path would un-do real work on a healthy run
+                    exhausted[0] = True
+                    continue
+                if not sized:
+                    stats.chunks += 1
+                    stats.docs += (
+                        len(chunk) if hasattr(chunk, "__len__") else 1
+                    )
                 submit_next()  # keep the lookahead window full
                 t1 = time.perf_counter()
                 try:
@@ -307,6 +375,8 @@ class ChunkPipeline:
                 stats.write_queue_peak = max(
                     stats.write_queue_peak, wq.qsize()
                 )
+                if self.boundary is not None:
+                    self.boundary()
         finally:
             # Clean drain, even when the try-body raised: the writer
             # finishes every queued chunk (or skips the rest after its
@@ -317,13 +387,24 @@ class ChunkPipeline:
             wq.put(_DONE)
             wt.join()
             stats.write_seconds += write_seconds[0]
-            for fut in pending:
-                if not fut.cancel():
+            for chunk, fut in pending:
+                if fut.cancel():
+                    continue
+                try:
+                    _, payload = fut.result()
+                except BaseException:  # noqa: BLE001 — the primary error propagates
+                    log.exception(
+                        "draining in-flight prefetch after pipeline abort"
+                    )
+                    continue
+                # a completed prefetch whose judgment never ran: let
+                # the caller give the work back (released claims)
+                if payload is not END and self.on_drained is not None:
                     try:
-                        fut.result()
+                        self.on_drained(chunk, payload)
                     except BaseException:  # noqa: BLE001 — the primary error propagates
                         log.exception(
-                            "draining in-flight prefetch after pipeline abort"
+                            "on_drained failed for an unjudged chunk"
                         )
         if write_errors:
             raise write_errors[0]

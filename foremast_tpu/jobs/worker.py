@@ -15,6 +15,7 @@ claims), but each worker saturates a chip instead of a 100m-CPU sliver.
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time
@@ -24,6 +25,7 @@ from typing import Callable
 import numpy as np
 
 from foremast_tpu.chaos.degrade import (
+    REASON_ABORT,
     REASON_DEADLINE,
     REASON_DEMOTED,
     REASON_FETCH,
@@ -80,6 +82,199 @@ _EMPTY_VALUES = np.zeros(0, np.float32)
 # counters attribute the release to the right cause.
 RELEASED = object()  # transient fetch failure
 RELEASED_DEADLINE = object()  # tick budget exceeded
+
+# Sliced, preemptible sweeps (ISSUE 15): a full sweep whose claim can
+# exceed FOREMAST_SWEEP_SLICE_DOCS (reactive/dirty.py:
+# sweep_slice_docs_from_env, default 2048, 0 = monolithic opt-out)
+# runs as a SEQUENCE of bounded slices through a warm-path pipeline
+# (claim-pool prepare / async columnar dispatch / gather+decode+
+# write), with a micro-tick preemption point at every slice boundary —
+# pushed-anomaly latency is bounded by one slice's wall clock, not the
+# sweep's.
+
+
+class _TickLedger:
+    """One judging cycle's arrival-attribution state (ISSUE 12/15):
+    the route keys this cycle owes a push→verdict latency observation
+    (``pending``: key → receiver arrival stamp) and the keys already
+    observed. A sliced sweep and the micro-ticks that PREEMPT it
+    mid-flight each carry their OWN ledger, so a nested cycle can never
+    clobber the outer one's attribution (the sweep's writer thread
+    reads its ledger while the tick thread runs the nested micro).
+    Individual dict/set operations are GIL-atomic; iteration happens
+    only after the cycle's pipeline threads are joined."""
+
+    __slots__ = ("path", "pending", "observed")
+
+    def __init__(self, path: str, pending=None):
+        self.path = path
+        self.pending: dict[str, float] = dict(pending) if pending else {}
+        self.observed: set[str] = set()
+
+
+class _SweepPool:
+    """The sliced sweep's claimed-but-unsliced document pool.
+
+    One leaf lock guards the queue, the route-key index, the promoted
+    front, and the in-flight key counts — three threads touch it: the
+    prefetch thread takes slices, the tick thread promotes dirty route
+    keys to the front at preemption points, and the writer thread
+    retires written slices. ``promote`` is how a pushed anomaly whose
+    document is claimed but NOT yet fetched jumps the queue: its slice
+    runs next, fetches post-arrival samples, and the sweep itself
+    delivers the verdict inside ~one slice."""
+
+    def __init__(self, docs):
+        self._lock = threading.Lock()
+        self._queue = collections.OrderedDict((d.id, d) for d in docs)
+        self._keys: dict[str, list[str]] = {}
+        for d in docs:
+            self._keys.setdefault(doc_route_key(d), []).append(d.id)
+        self._front: collections.deque = collections.deque()
+        self._inflight: dict[str, int] = {}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def take(self, n: int) -> list:
+        """Next slice: promoted docs first, then queue order. Taken
+        docs enter the in-flight set until `done` retires them."""
+        out = []
+        with self._lock:
+            while self._front and len(out) < n:
+                doc = self._queue.pop(self._front.popleft(), None)
+                if doc is not None:
+                    out.append(doc)
+            while self._queue and len(out) < n:
+                _, doc = self._queue.popitem(last=False)
+                out.append(doc)
+            for doc in out:
+                rk = doc_route_key(doc)
+                ids = self._keys.get(rk)
+                if ids:
+                    try:
+                        ids.remove(doc.id)
+                    except ValueError:
+                        pass
+                    if not ids:
+                        del self._keys[rk]
+                self._inflight[rk] = self._inflight.get(rk, 0) + 1
+        return out
+
+    def drain(self) -> list:
+        """Everything still pooled (deadline expiry / abort): one bulk
+        release instead of judging over budget."""
+        with self._lock:
+            out = list(self._queue.values())
+            self._queue.clear()
+            self._keys.clear()
+            return out
+
+    def done(self, docs) -> None:
+        """A slice's docs were written (or released): their route keys
+        leave the in-flight set, making them fair game for the next
+        boundary's micro-tick."""
+        with self._lock:
+            for doc in docs:
+                rk = doc_route_key(doc)
+                c = self._inflight.get(rk, 0)
+                if c <= 1:
+                    self._inflight.pop(rk, None)
+                else:
+                    self._inflight[rk] = c - 1
+
+    def promote(self, route_key: str) -> bool:
+        """Move every pooled doc of `route_key` to the front of the
+        slice order; False when none are pooled."""
+        with self._lock:
+            ids = self._keys.get(route_key)
+            if not ids:
+                return False
+            self._front.extend(ids)
+            return True
+
+    def inflight(self, route_key: str) -> bool:
+        with self._lock:
+            return route_key in self._inflight
+
+
+class _SlicePrep:
+    """One prepared slice: admission split + fetched windows + packed
+    columnar buffers, built on the prefetch thread. `release_all` marks
+    a deadline-expiry bundle (every doc releases un-judged)."""
+
+    __slots__ = (
+        "docs", "claim_mono", "slow", "ok_items", "ok_citems", "ok_joint",
+        "failed", "released", "uni_packed", "canary_packed", "release_all",
+        "slow_done",
+    )
+
+    def __init__(self, docs, claim_mono, release_all=False):
+        self.docs = docs
+        self.claim_mono = claim_mono
+        self.release_all = release_all
+        self.slow_done = False
+        self.slow = []
+        self.ok_items = []
+        self.ok_citems = []
+        self.ok_joint = []
+        self.failed = []
+        self.released = []
+        self.uni_packed = None
+        self.canary_packed = None
+
+
+class _UniPacked:
+    """One packed univariate/canary columnar bucket: the [B, tc]
+    buffers plus per-row operands, ready for `judge_columnar_async`.
+    `ok_items` is the (possibly canary-split) item list the decode
+    walks. Built on whichever thread packs (prefetch under the sliced
+    sweep); consumed by dispatch (tick thread) and decode (writer)."""
+
+    __slots__ = (
+        "ok_items", "values", "mask", "keys", "entries", "nidx",
+        "thr", "bnd", "mlb", "gaps", "tc", "canary",
+        "base_vals", "base_m",
+    )
+
+    def __init__(
+        self, ok_items, values, mask, keys, entries, nidx,
+        thr, bnd, mlb, gaps, tc, canary, base_vals, base_m,
+    ):
+        self.ok_items = ok_items
+        self.values = values
+        self.mask = mask
+        self.keys = keys
+        self.entries = entries
+        self.nidx = nidx
+        self.thr = thr
+        self.bnd = bnd
+        self.mlb = mlb
+        self.gaps = gaps
+        self.tc = tc
+        self.canary = canary
+        self.base_vals = base_vals
+        self.base_m = base_m
+
+
+class _SliceResult:
+    """A dispatched slice: pending (ungathered) columnar judgments plus
+    the synchronously-judged joint docs. `aborted` marks a StageError
+    partial — finish writes what was judged and releases the rest."""
+
+    __slots__ = (
+        "prep", "joint_updated", "joint_counts", "uni_pending",
+        "canary_pending", "aborted",
+    )
+
+    def __init__(self, prep):
+        self.prep = prep
+        self.joint_updated = []
+        self.joint_counts = None
+        self.uni_pending = None
+        self.canary_pending = None
+        self.aborted = False
 
 
 def _hist_end_epoch(url: str) -> float | None:
@@ -411,10 +606,21 @@ class BrainWorker:
 
         self.microtick_seconds = microtick_seconds_from_env()
         self.microtick_docs = microtick_docs_from_env()
-        self._pending_arrivals: dict[str, float] = {}
-        self._observed_keys: set[str] = set()
-        self._tick_path = "sweep"
+        self._ledger = _TickLedger("sweep")
         self._last_micro = {"at": 0.0, "docs": 0, "seconds": 0.0, "runs": 0}
+        # Sliced, preemptible sweeps (ISSUE 15): claims above this size
+        # run as bounded slices through the warm-path pipeline, with a
+        # dirty-drain preemption point between slices. 0 = monolithic
+        # (the parity arm). PodWorker forces 0 — slice control flow off
+        # local state would desync SPMD collectives, and LeaderSource
+        # fetches may not run on a prefetch thread.
+        from foremast_tpu.reactive.dirty import sweep_slice_docs_from_env
+
+        self.sweep_slice_docs = sweep_slice_docs_from_env()
+        self._last_sweep: dict | None = None
+        # True while a sliced sweep is in flight: pins _tick_claim_mono
+        # at the sweep's claim instant (see _claim_cycle)
+        self._sweep_active = False
 
     # -- preprocess: document -> MetricTasks ----------------------------
 
@@ -741,6 +947,10 @@ class BrainWorker:
                 v.alias: v.anomaly_pairs for v in verdicts if v.anomaly_pairs
             }
         self._decide_status(doc, job_verdict, values, now, end)
+        # write-behind stamps fall back to self._tick_claim_mono, which
+        # a sliced sweep PINS at its own claim instant for its whole
+        # duration (_claim_cycle's _sweep_active guard) — safe for this
+        # subclass-overridable seam to stay claim-context-free
         return self._store_update(doc)
 
     def warmup(self, hist_len: int = 10_080, cur_len: int = 30) -> None:
@@ -1127,11 +1337,17 @@ class BrainWorker:
 
     # -- degraded store writes (ISSUE 9) ---------------------------------
 
-    def _store_update(self, doc: Document) -> Document:
+    def _store_update(
+        self, doc: Document, claim_mono: float | None = None
+    ) -> Document:
         """`store.update` with write-behind degradation: a TRANSIENT
         store failure (connection/timeout, 429/5xx, breaker open) parks
         the doc in the bounded buffer for replay instead of failing the
-        tick; permanent errors propagate."""
+        tick; permanent errors propagate. `claim_mono` is the doc's
+        CLAIM instant for the write-behind age stamp — a sliced sweep
+        passes each slice's own claim time so a late slice can never
+        inherit a fresher stamp from a nested micro-tick's claim
+        (see _tick_claim_mono, the monolithic default)."""
         try:
             doc = self.store.update(doc)
             self._write_degraded = False
@@ -1142,11 +1358,18 @@ class BrainWorker:
             self._note_write_degraded(e)
             # stamped at the CLAIM instant (see _tick_claim_mono)
             self._degrade.write_behind.add(
-                [doc], now=self._tick_claim_mono
+                [doc],
+                now=(
+                    self._tick_claim_mono
+                    if claim_mono is None
+                    else claim_mono
+                ),
             )
             return doc
 
-    def _store_update_many(self, docs: list[Document]) -> None:
+    def _store_update_many(
+        self, docs: list[Document], claim_mono: float | None = None
+    ) -> None:
         """Batched `_store_update` (the fast tick's write-back path)."""
         if not docs:
             return
@@ -1158,7 +1381,12 @@ class BrainWorker:
                 raise
             self._note_write_degraded(e)
             self._degrade.write_behind.add(
-                docs, now=self._tick_claim_mono
+                docs,
+                now=(
+                    self._tick_claim_mono
+                    if claim_mono is None
+                    else claim_mono
+                ),
             )
 
     def _note_write_degraded(self, e: BaseException) -> None:
@@ -1206,26 +1434,33 @@ class BrainWorker:
             "recovered store", len(docs),
         )
 
-    def _release_docs(self, docs: list[Document], reason: str) -> None:
+    def _release_docs(
+        self,
+        docs: list[Document],
+        reason: str,
+        led: _TickLedger | None = None,
+        claim_mono: float | None = None,
+    ) -> None:
         """Partial-tick semantics: give docs back un-judged (status →
         preprocess_completed, claimable next tick) and count them —
         never wedge a tick behind a slow dependency, never terminally
         fail a doc for a dependency's transient sin."""
         if not docs:
             return
+        led = self._ledger if led is None else led
         for doc in docs:
             doc.status = STATUS_PREPROCESS_COMPLETED
-        self._store_update_many(docs)
+        self._store_update_many(docs, claim_mono=claim_mono)
         self._degrade.stats.count_docs(reason, len(docs))
         # reactive: a released doc's pending arrival goes BACK to the
         # dirty set with its ORIGINAL stamp — a brownout mid-micro-tick
         # must not lose the arrival, and the eventual verdict must
         # still measure from the push's receive instant (the latency
         # the operator actually suffered)
-        if self._pending_arrivals and self.dirty is not None:
+        if led.pending and self.dirty is not None:
             for doc in docs:
                 rk = doc_route_key(doc)
-                stamp = self._pending_arrivals.pop(rk, None)
+                stamp = led.pending.pop(rk, None)
                 if stamp is not None:
                     self.dirty.mark(rk, stamp, requeue=True)
         log.warning(
@@ -1599,7 +1834,65 @@ class BrainWorker:
         evictions) revalidates per row by entry identity instead of
         discarding the cache — see _revalidate.
         """
-        uni = self._uni
+        fast, fastc, fastj, slow = self._admit_fast(docs, now)
+        if not fast and not fastc and not fastj:
+            return 0, slow
+        ok_items, ok_citems, ok_joint, failed, released = self._fetch_fast(
+            fast, fastc, fastj
+        )
+        for doc in failed:
+            self._store_update(doc)
+        self._release_docs(released, REASON_FETCH)
+        if self.metrics:
+            for doc in failed:
+                self.metrics.observe_doc(doc.status, 0)
+        if not ok_items and not ok_citems and not ok_joint:
+            return len(failed) + len(released), slow
+        updated_all: list = []
+        n_joint = 0
+        kind_counts = {
+            "univariate": 0, "bivariate": 0, "lstm": 0, "baseline": 0,
+        }
+        if ok_joint:
+            j_updated, demoted, j_counts = self._judge_joint_fast(
+                ok_joint, now
+            )
+            updated_all.extend(j_updated)
+            n_joint = len(j_updated)
+            self._demote_to_slow(slow, demoted, "joint window bucket drift")
+            for kind, n in j_counts.items():
+                kind_counts[kind] += n
+        if ok_items:
+            updated_all.extend(self._judge_uni_fast(ok_items, now))
+            kind_counts["univariate"] += len(ok_items)
+        if ok_citems:
+            updated_all.extend(
+                self._judge_uni_fast(ok_citems, now, canary=True)
+            )
+            kind_counts["baseline"] += len(ok_citems)
+        self._account_fast_kinds(kind_counts)
+        with span(
+            "worker.write_back", stage="write_back", docs=len(updated_all)
+        ):
+            self._store_update_many(updated_all)
+        self._observe_verdicts(updated_all)
+        return (
+            len(ok_items)
+            + len(ok_citems)
+            + n_joint
+            + len(failed)
+            + len(released),
+            slow,
+        )
+
+    def _admit_fast(self, docs, now: float):
+        """The fast-tick admission walk — shared by the monolithic
+        `_fast_tick` and the sliced sweep's prepare stage (prefetch
+        thread: per-doc dict operations are GIL-atomic, the ModelCaches
+        are lock-guarded, and a sweep's slices and any preempting
+        micro-tick operate on DISJOINT claimed docs). Returns (fast,
+        fastc, fastj, slow) — the baseline-less, canary, joint, and
+        object-path doc groups."""
         fit_cache = self._fit_cache
         gap_sensitive = self._gap_sensitive
         token = (fit_cache.version, self._gap_meta.version)
@@ -1692,16 +1985,20 @@ class BrainWorker:
                 (fastc if has_base else fast).append(
                     (doc, end_epoch, rowsinfo, ops)
                 )
-        if not fast and not fastc and not fastj:
-            return 0, slow
+        return fast, fastc, fastj, slow
 
-        # fetch current windows (thread pool only for blocking sources):
-        # univariate, canary and joint docs share one pooled fan-out —
-        # a fetch entry is (kind, item, url list). Canary docs append
-        # their per-row baseline URLs after the current URLs (None for
-        # a baseline-less alias inside a canary doc: it fetches as an
-        # empty window, whose all-False mask gates every rank test off
-        # — the object path's exact semantics for that alias).
+    def _fetch_fast(self, fast, fastc, fastj):
+        """Fetch current windows for the admitted groups (thread pool
+        only for blocking sources): univariate, canary and joint docs
+        share one pooled fan-out — a fetch entry is (kind, item, url
+        list). Canary docs append their per-row baseline URLs after the
+        current URLs (None for a baseline-less alias inside a canary
+        doc: it fetches as an empty window, whose all-False mask gates
+        every rank test off — the object path's exact semantics for
+        that alias). Returns (ok_items, ok_citems, ok_joint, failed,
+        released); failed docs carry their terminal marks but are NOT
+        persisted here — the CALLER owns store writes (the sliced
+        sweep's writer thread, or `_fast_tick` inline)."""
         fetch_items = [
             ("uni", item, [r[1] for r in item[2]]) for item in fast
         ]
@@ -1763,7 +2060,6 @@ class BrainWorker:
                 doc.status = STATUS_PREPROCESS_FAILED
                 doc.status_code = "500"
                 doc.reason = "metric fetch failed"
-                self._store_update(doc)
                 failed.append(doc)
             elif s is RELEASED:
                 released.append(item[0])
@@ -1773,48 +2069,7 @@ class BrainWorker:
                 ok_citems.append((item, s))
             else:
                 ok_joint.append((item, s))
-        self._release_docs(released, REASON_FETCH)
-        if self.metrics:
-            for doc in failed:
-                self.metrics.observe_doc(doc.status, 0)
-        if not ok_items and not ok_citems and not ok_joint:
-            return len(failed) + len(released), slow
-        updated_all: list = []
-        n_joint = 0
-        kind_counts = {
-            "univariate": 0, "bivariate": 0, "lstm": 0, "baseline": 0,
-        }
-        if ok_joint:
-            j_updated, demoted, j_counts = self._judge_joint_fast(
-                ok_joint, now
-            )
-            updated_all.extend(j_updated)
-            n_joint = len(j_updated)
-            self._demote_to_slow(slow, demoted, "joint window bucket drift")
-            for kind, n in j_counts.items():
-                kind_counts[kind] += n
-        if ok_items:
-            updated_all.extend(self._judge_uni_fast(ok_items, now))
-            kind_counts["univariate"] += len(ok_items)
-        if ok_citems:
-            updated_all.extend(
-                self._judge_uni_fast(ok_citems, now, canary=True)
-            )
-            kind_counts["baseline"] += len(ok_citems)
-        self._account_fast_kinds(kind_counts)
-        with span(
-            "worker.write_back", stage="write_back", docs=len(updated_all)
-        ):
-            self._store_update_many(updated_all)
-        self._observe_verdicts(updated_all)
-        return (
-            len(ok_items)
-            + len(ok_citems)
-            + n_joint
-            + len(failed)
-            + len(released),
-            slow,
-        )
+        return ok_items, ok_citems, ok_joint, failed, released
 
     def _judge_uni_fast(self, ok_items, now: float, canary: bool = False) -> list:
         """Columnar warm judgment of admitted univariate rows: one
@@ -1826,8 +2081,38 @@ class BrainWorker:
         second [B, tc] buffer pair judged by the pairwise-active
         compiled variant — hook verdicts then carry the REAL device
         (p, differs) instead of the baseline-less constants. Returns
-        the decided docs; the caller persists."""
-        uni = self._uni
+        the decided docs; the caller persists.
+
+        Pack → dispatch → gather+decode are separate helpers so the
+        sliced sweep (ISSUE 15) can run them on different pipeline
+        stages; this monolithic wrapper composes the same pack and
+        decode around `judge_columnar` — itself the async dispatch +
+        wait pair — which is what pins sliced-vs-monolithic byte
+        parity by construction (and keeps `judge_columnar` the one
+        instrumentable judgment seam)."""
+        packed = self._pack_uni(ok_items, canary)
+        res = self._uni.judge_columnar(
+            packed.values,
+            packed.mask,
+            packed.keys,
+            packed.entries,
+            packed.nidx,
+            packed.thr,
+            packed.bnd,
+            packed.mlb,
+            gap_steps=packed.gaps,
+            with_bands=self.on_verdict is not None,
+            base_values=packed.base_vals,
+            base_mask=packed.base_m,
+        )
+        return self._decode_uni(packed, res, now)
+
+    def _pack_uni(self, ok_items, canary: bool):
+        """The host-side packing half (prefetch-thread-safe: pure numpy
+        + per-row reads of immutable admission tuples): fill the
+        [B, tc] buffer pair (plus the canary bucket's baseline pair),
+        gather per-row operands, keys, entries and gap steps. Returns a
+        `_UniPacked`."""
         gap_sensitive = self._gap_sensitive
         # columnar fill: one [B, tc] buffer pair, no per-row objects
         from foremast_tpu.engine.judge import bucket_length
@@ -1916,21 +2201,39 @@ class BrainWorker:
                         gaps[i] = max(k - 1, 0)
                     i += 1
 
-        with_bands = self.on_verdict is not None
-        v8, anoms, ub, lb, ps, difs = uni.judge_columnar(
-            values,
-            maskarr,
-            keys,
-            entries,
-            nidx,
-            thr,
-            bnd,
-            mlb,
-            gap_steps=gaps,
-            with_bands=with_bands,
-            base_values=base_vals,
-            base_mask=base_m,
+        return _UniPacked(
+            ok_items, values, maskarr, keys, entries, nidx,
+            thr, bnd, mlb, gaps, tc, canary, base_vals, base_m,
         )
+
+    def _dispatch_uni(self, packed: "_UniPacked"):
+        """The device-dispatch half (tick thread ONLY — arena
+        assignment order is load-bearing): one async columnar program,
+        returns the un-gathered `ColumnarPending`."""
+        return self._uni.judge_columnar_async(
+            packed.values,
+            packed.mask,
+            packed.keys,
+            packed.entries,
+            packed.nidx,
+            packed.thr,
+            packed.bnd,
+            packed.mlb,
+            gap_steps=packed.gaps,
+            with_bands=self.on_verdict is not None,
+            base_values=packed.base_vals,
+            base_mask=packed.base_m,
+        )
+
+    def _decode_uni(self, packed: "_UniPacked", res, now: float) -> list:
+        """The decode half (any single consumer thread — the sliced
+        sweep runs it on the writer after `ColumnarPending.wait()`):
+        segment-reduce per-doc verdicts and decide statuses off the
+        gathered result tuple. Returns the decided docs; the caller
+        persists."""
+        ok_items = packed.ok_items
+        tc = packed.tc
+        v8, anoms, ub, lb, ps, difs = res
 
         # decode: segment reductions over per-doc row ranges
         counts = np.fromiter(
@@ -2059,52 +2362,56 @@ class BrainWorker:
         with self.tracer.span("worker.microtick", worker=self.worker_id):
             return self._tick(now, micro=entries)
 
-    def _begin_pending(self, micro) -> None:
-        """Set up this tick's arrival-attribution state: a micro-tick
+    def _begin_pending(self, micro) -> _TickLedger:
+        """Set up this cycle's arrival-attribution ledger: a micro-tick
         owns exactly the entries it took; a full sweep drains the WHOLE
         dirty set (the catch-all — arrivals the micro-ticks missed,
-        dropped keys' documents, non-push docs attribute nothing)."""
-        self._tick_path = "micro" if micro is not None else "sweep"
+        dropped keys' documents, non-push docs attribute nothing).
+        Returns the ledger; `self._ledger` tracks the INNERMOST live
+        cycle (a sweep's preemption point save/restores it around the
+        nested micro-tick)."""
         if micro is not None:
-            self._pending_arrivals = dict(micro)
+            led = _TickLedger("micro", micro)
         elif self.dirty is not None:
-            self._pending_arrivals = dict(self.dirty.take_all())
+            led = _TickLedger("sweep", self.dirty.take_all())
         else:
-            self._pending_arrivals = {}
-        self._observed_keys = set()
+            led = _TickLedger("sweep")
+        self._ledger = led
+        return led
 
-    def _requeue_pending(self) -> None:
+    def _requeue_pending(self, led: _TickLedger) -> None:
         """Give every un-attributed arrival back to the dirty set with
         its original stamp (claim brownout: nothing was claimed, the
         docs stay claimable, the arrivals must survive)."""
-        if self._pending_arrivals and self.dirty is not None:
-            for rk, stamp in self._pending_arrivals.items():
+        if led.pending and self.dirty is not None:
+            for rk, stamp in led.pending.items():
                 self.dirty.mark(rk, stamp, requeue=True)
-        self._pending_arrivals = {}
+        led.pending = {}
 
-    def _finish_pending(self) -> None:
+    def _finish_pending(self, led: _TickLedger) -> None:
         """Close out arrival attribution: pending keys no judged doc
         matched (terminal docs, apps claimed by a peer, sweep claims
         past the limit) are DROPPED and counted — never re-queued,
         because a key with no claimable doc would cycle forever."""
-        pending = self._pending_arrivals
+        pending = led.pending
         if pending:
-            missed = sum(
-                1 for k in pending if k not in self._observed_keys
-            )
+            missed = sum(1 for k in pending if k not in led.observed)
             if missed and self.dirty is not None:
                 self.dirty.count("unattributed", missed)
-        self._pending_arrivals = {}
-        self._observed_keys = set()
+        led.pending = {}
+        led.observed = set()
 
-    def _observe_verdicts(self, docs) -> None:
+    def _observe_verdicts(
+        self, docs, led: _TickLedger | None = None
+    ) -> None:
         """Per-verdict latency: every just-written doc whose route key
         carries a pending arrival observes (now - receiver arrival
         stamp) on `foremast_verdict_latency_seconds{path}` — the
         push→verdict SLO. Called at the write-back points of both tick
         paths; a write-behind-buffered verdict observes too (the
         verdict exists; its persistence is the buffer's contract)."""
-        pending = self._pending_arrivals
+        led = self._ledger if led is None else led
+        pending = led.pending
         if not pending or not docs:
             return
         hist = (
@@ -2112,8 +2419,8 @@ class BrainWorker:
             if self.metrics
             else None
         )
-        observed = self._observed_keys
-        path = self._tick_path
+        observed = led.observed
+        path = led.path
         now = time.time()
         for doc in docs:
             rk = doc_route_key(doc)
@@ -2124,13 +2431,13 @@ class BrainWorker:
             if hist is not None:
                 hist.labels(path=path).observe(max(0.0, now - stamp))
 
-    def _micro_claim_filter(self, base):
+    def _micro_claim_filter(self, base, led: _TickLedger):
         """The micro-tick's claim restriction: only documents whose
         route key is in this tick's pending set, composed with the
         mesh partition filter (dirty routing respects partition
         ownership — a stale dirty key for a moved app can never steal
         a foreign doc; claim-CAS stays the net beneath both)."""
-        keys = self._pending_arrivals
+        keys = led.pending
 
         def claim_filter(doc) -> bool:
             if base is not None and not base(doc):
@@ -2142,26 +2449,441 @@ class BrainWorker:
     # -- main cycle ------------------------------------------------------
 
     def tick(self, now: float | None = None) -> int:
-        """One claim-fetch-judge-write cycle. Returns #docs processed."""
+        """One claim-fetch-judge-write cycle. Returns #docs processed.
+
+        Sweeps whose claim can exceed one slice run SLICED (ISSUE 15,
+        `_sweep_sliced`): bounded slices through the warm-path
+        pipeline with a dirty-drain preemption point between slices,
+        so a pushed anomaly's latency is bounded by slice wall clock.
+        Everything else — and `FOREMAST_SWEEP_SLICE_DOCS=0` — keeps
+        the monolithic body (`_tick`), the byte-parity arm."""
         if self.tracer is None:
-            return self._tick(now)
+            return self._cycle(now)
         # the root span mints the tick's trace ID: every stage span
         # below (and the engine/store spans nested inside them) shares
         # it, as do JSON log records emitted while the tick is open
         with self.tracer.span("worker.tick", worker=self.worker_id):
-            return self._tick(now)
+            return self._cycle(now)
 
-    def _tick(self, now: float | None = None, micro=None) -> int:
+    def _cycle(self, now: float | None) -> int:
+        if self._sweep_sliceable():
+            return self._sweep_sliced(now)
+        return self._tick(now)
+
+    def _sweep_sliceable(self) -> bool:
+        """Sliced sweeps engage when a claim can outgrow one slice
+        (FOREMAST_SWEEP_SLICE_DOCS > 0 and < claim_limit), the columnar
+        fast path exists, and `_fast_tick` has not been replaced on the
+        instance (tests/benches forcing the object path get exactly the
+        monolithic body they stubbed). PodWorker forces the knob to 0."""
+        return (
+            self.sweep_slice_docs > 0
+            and self.claim_limit > self.sweep_slice_docs
+            and self._uni is not None
+            and "_fast_tick" not in self.__dict__
+        )
+
+    # -- sliced, preemptible sweeps (ISSUE 15) ---------------------------
+
+    def _sweep_sliced(self, now: float | None = None) -> int:
+        """One full sweep as a sequence of bounded slices through a
+        warm-path pipeline: the prefetch thread CLAIM-POOL-takes and
+        packs slice N+1 while the tick thread async-dispatches slice
+        N's columnar programs and the writer thread gathers, decodes
+        and bulk-writes slice N−1 — steady-state wall clock approaches
+        max(prepare, dispatch, finish) per slice instead of their sum
+        (the round-15 roofline's host-plane fix). At every slice
+        boundary the reactive drain gets a PREEMPTION POINT
+        (`_preempt_between_slices`), so pushed-anomaly latency is
+        bounded by one slice's wall clock, not the sweep's.
+
+        Contract preservation: the claim is ONE store round trip (same
+        claim/lease semantics as the monolithic tick — per-slice
+        re-claiming would re-take re-check docs this sweep already
+        judged); each slice's write-behind stamps carry the sweep's
+        claim instant; the tick budget is checked per slice with the
+        still-pooled remainder released in one bulk write on expiry;
+        per-doc judgment is byte-identical to the monolithic tick
+        because both compose the same pack/dispatch/decode helpers."""
         t0 = time.perf_counter()
         self._tick_deadline = self._degrade.deadline(t0)
         now = time.time() if now is None else now
-        # replay any write-behind backlog FIRST: the store may have
-        # healed, and re-check docs buffered as preprocess_completed
-        # must become claimable before this tick's claim
         self._flush_write_behind()
-        # reactive (ISSUE 12): a micro-tick owns the dirty entries it
-        # took; a full sweep drains the rest as its catch-all
-        self._begin_pending(micro)
+        led = self._begin_pending(None)
+        docs = self._claim_cycle(led, None)
+        claim_mono = self._tick_claim_mono
+        if docs and self._deadline_exceeded():
+            self._release_docs(docs, REASON_DEADLINE, led, claim_mono)
+            docs = []
+        if not docs:
+            # idle sweep: same housekeeping as the monolithic idle tick
+            self._finish_pending(led)
+            self._refine_provisional(now)
+            self._maybe_persist()
+            if self.metrics:
+                self.metrics.tick_seconds.observe(time.perf_counter() - t0)
+            return 0
+
+        import itertools
+
+        from foremast_tpu.jobs import pipeline as _pl
+
+        pool = _SweepPool(docs)
+        counters = {
+            "slices": 0, "slow_docs": 0, "promoted": 0,
+            "inflight_requeued": 0, "preempt_microticks": 0,
+            "preempt_docs": 0,
+        }
+        totals = {"docs": 0, "fast": 0}
+        # the SWEEP's deadline, captured like claim_mono: prepare runs
+        # on the prefetch thread CONCURRENTLY with the boundary hook,
+        # and a nested preemption micro-tick temporarily points
+        # self._tick_deadline at its own (fresher) deadline — reading
+        # the instance attr there could let a budget-expired sweep
+        # keep taking slices through a micro's unexpired window
+        sweep_deadline = self._tick_deadline
+
+        def past_deadline() -> bool:
+            return (
+                sweep_deadline is not None
+                and time.perf_counter() > sweep_deadline
+            )
+
+        def prepare(_i):
+            # prefetch thread: deadline check FIRST — an expired sweep
+            # releases its pooled remainder in one bulk write instead
+            # of fetching work it may not judge (per-slice budget
+            # accounting, chaos/degrade.py)
+            if past_deadline():
+                rest = pool.drain()
+                if not rest:
+                    return _pl.END
+                return _SlicePrep(rest, claim_mono, release_all=True)
+            batch = pool.take(self.sweep_slice_docs)
+            if not batch:
+                return _pl.END
+            return self._prepare_slice(batch, now, claim_mono)
+
+        def judge(_i, prep):
+            if not prep.release_all:
+                # release bundles judge nothing: counting them would
+                # overstate foremast_sweep_slices_total and the varz
+                counters["slices"] += 1
+                counters["slow_docs"] += len(prep.slow)
+            return self._dispatch_slice(prep, now, led)
+
+        def write(_i, res):
+            n_docs, n_fast = self._finish_slice(res, now, led, pool)
+            totals["docs"] += n_docs
+            totals["fast"] += n_fast
+
+        def boundary():
+            self._preempt_between_slices(pool, led, now, counters)
+
+        # _sweep_active pins _tick_claim_mono for nested micro-ticks
+        # (see _claim_cycle); flipped back in the SAME finally that
+        # releases the pool, so no setup failure (pool materialization,
+        # a KeyboardInterrupt) can leave it stuck True — that would
+        # freeze write-behind claim stamps for every later tick
+        self._sweep_active = True
+        pipe = None
+        try:
+            use_threads = self.pipeline_depth > 1
+            if use_threads:
+                # materialize both pools on the tick thread (see the
+                # slow pipeline's rationale)
+                self._fetch_pool_get()
+            pipe = _pl.ChunkPipeline(
+                inherit_span(prepare),
+                judge,
+                inherit_span(write),
+                depth=self.pipeline_depth,
+                prefetch_pool=(
+                    self._prefetch_pool_get() if use_threads else None
+                ),
+                boundary=boundary,
+                on_drained=lambda _i, prep: self._abort_slice(prep, led),
+            )
+            pipe.run(itertools.count())
+        finally:
+            self._sweep_active = False
+            rest = pool.drain()
+            if rest:
+                # abort path: claimed docs whose slice never ran go
+                # back un-judged instead of waiting out stuck takeover
+                try:
+                    self._release_docs(rest, REASON_ABORT, led, claim_mono)
+                except Exception:  # noqa: BLE001 — the primary error propagates
+                    log.exception(
+                        "failed to release %d pooled doc(s) after sweep "
+                        "abort; stuck-claim takeover will net them",
+                        len(rest),
+                    )
+            stats = pipe.last_stats if pipe is not None else None
+            pipe_state = stats.as_dict() if stats else None
+            if pipe_state is not None:
+                # chunk specs are opaque slice indices; the honest doc
+                # count is what the writer actually retired
+                pipe_state["docs"] = totals["docs"]
+            self._last_sweep = {
+                "slice_docs": self.sweep_slice_docs,
+                **counters,
+                "pipeline": pipe_state,
+            }
+            if self.metrics and hasattr(self.metrics, "observe_sweep"):
+                self.metrics.observe_sweep(stats, counters)
+        if counters["slow_docs"] == 0:
+            # all-warm sweep: the cheap moment to upgrade provisional
+            # fits, exactly the monolithic tick's rule
+            self._refine_provisional(now)
+        if self.metrics:
+            if hasattr(self.metrics, "observe_arena"):
+                self.metrics.observe_arena(
+                    self._uni.device_state_counters()
+                )
+            self.metrics.tick_seconds.observe(time.perf_counter() - t0)
+        self._tick_done(totals["docs"], totals["fast"], t0, led=led)
+        return totals["docs"]
+
+    def _prepare_slice(
+        self, docs, now: float, claim_mono: float
+    ) -> _SlicePrep:
+        """Pipeline stage 1 (prefetch thread): admission split, window
+        fetch, and columnar packing for one slice. No store writes and
+        no device work — those belong to the writer and tick threads."""
+        prep = _SlicePrep(docs, claim_mono)
+        fast, fastc, fastj, prep.slow = self._admit_fast(docs, now)
+        if fast or fastc or fastj:
+            (
+                prep.ok_items,
+                prep.ok_citems,
+                prep.ok_joint,
+                prep.failed,
+                prep.released,
+            ) = self._fetch_fast(fast, fastc, fastj)
+            if prep.ok_items:
+                prep.uni_packed = self._pack_uni(prep.ok_items, False)
+            if prep.ok_citems:
+                prep.canary_packed = self._pack_uni(prep.ok_citems, True)
+        return prep
+
+    def _dispatch_slice(
+        self, prep: _SlicePrep, now: float, led: _TickLedger
+    ) -> _SliceResult:
+        """Pipeline stage 2 (tick thread, strict slice order — arena
+        assignment and device dispatch order are load-bearing): judge
+        the joint group synchronously (a minority; its own dispatch
+        merges internally), async-dispatch the univariate and canary
+        columnar programs, then run this slice's slow leftovers through
+        the existing chunk pipeline. A dispatch failure raises
+        StageError carrying the partial result so already-judged work
+        still persists through the writer."""
+        res = _SliceResult(prep)
+        if prep.release_all:
+            return res
+        from foremast_tpu.jobs.pipeline import StageError
+
+        try:
+            if prep.ok_joint:
+                j_updated, demoted, j_counts = self._judge_joint_fast(
+                    prep.ok_joint, now
+                )
+                res.joint_updated = j_updated
+                res.joint_counts = j_counts
+                self._demote_to_slow(
+                    prep.slow, demoted, "joint window bucket drift"
+                )
+            if prep.uni_packed is not None:
+                res.uni_pending = self._dispatch_uni(prep.uni_packed)
+            if prep.canary_packed is not None:
+                res.canary_pending = self._dispatch_uni(prep.canary_packed)
+        except BaseException as e:  # noqa: BLE001 — re-raised post-drain
+            res.aborted = True
+            raise StageError(e, res) from e
+        if prep.slow:
+            try:
+                self._run_slow_chunks(
+                    prep.slow, now, led, prep.claim_mono
+                )
+                prep.slow_done = True
+            except BaseException as e:  # noqa: BLE001 — re-raised post-drain
+                # the warm dispatches above still owe their writes:
+                # ship them through the writer before the error
+                # propagates (the slow pipeline released/persisted its
+                # own partial work already)
+                prep.slow_done = True
+                raise StageError(e, res) from e
+        return res
+
+    def _finish_slice(
+        self, res: _SliceResult, now: float, led: _TickLedger, pool
+    ) -> tuple[int, int]:
+        """Pipeline stage 3 (writer thread): gather + decode the
+        pending columnar judgments, persist everything, observe the
+        verdict latencies, and retire the slice's route keys from the
+        in-flight set. Returns (docs_processed, fast_docs)."""
+        prep = res.prep
+        try:
+            if prep.release_all:
+                self._release_docs(
+                    prep.docs, REASON_DEADLINE, led, prep.claim_mono
+                )
+                return len(prep.docs), 0
+            for doc in prep.failed:
+                self._store_update(doc, claim_mono=prep.claim_mono)
+                if self.metrics:
+                    self.metrics.observe_doc(doc.status, 0)
+            if prep.released:
+                self._release_docs(
+                    prep.released, REASON_FETCH, led, prep.claim_mono
+                )
+            updated = list(res.joint_updated)
+            kind_counts = {
+                "univariate": 0, "bivariate": 0, "lstm": 0, "baseline": 0,
+            }
+            if res.joint_counts:
+                for kind, n in res.joint_counts.items():
+                    kind_counts[kind] += n
+            drop: list = []
+            if res.uni_pending is not None:
+                updated += self._decode_uni(
+                    prep.uni_packed, res.uni_pending.wait(), now
+                )
+                kind_counts["univariate"] += len(prep.uni_packed.ok_items)
+            elif res.aborted and prep.uni_packed is not None:
+                drop += [it[0] for it, _ in prep.uni_packed.ok_items]
+            if res.canary_pending is not None:
+                updated += self._decode_uni(
+                    prep.canary_packed, res.canary_pending.wait(), now
+                )
+                kind_counts["baseline"] += len(
+                    prep.canary_packed.ok_items
+                )
+            elif res.aborted and prep.canary_packed is not None:
+                drop += [it[0] for it, _ in prep.canary_packed.ok_items]
+            if res.aborted:
+                if not res.joint_updated and prep.ok_joint:
+                    drop += [it[0] for it, _ in prep.ok_joint]
+                if not prep.slow_done:
+                    drop += list(prep.slow)
+                self._release_docs(
+                    drop, REASON_ABORT, led, prep.claim_mono
+                )
+            self._account_fast_kinds(kind_counts)
+            if updated:
+                with span(
+                    "worker.write_back",
+                    stage="write_back",
+                    docs=len(updated),
+                ):
+                    self._store_update_many(
+                        updated, claim_mono=prep.claim_mono
+                    )
+            self._observe_verdicts(updated, led)
+            n_fast = len(updated) + len(prep.failed) + len(prep.released)
+            return n_fast + len(prep.slow), n_fast
+        finally:
+            pool.done(prep.docs)
+
+    def _abort_slice(self, prep: _SlicePrep, led: _TickLedger) -> None:
+        """A prepared slice whose judgment never ran (pipeline abort):
+        persist the fetch-failure marks, give every other claimed doc
+        back un-judged. Best-effort — a store that is itself the abort
+        cause leaves the docs to stuck-claim takeover."""
+        try:
+            if prep.release_all:
+                self._release_docs(
+                    prep.docs, REASON_DEADLINE, led, prep.claim_mono
+                )
+                return
+            for doc in prep.failed:
+                self._store_update(doc, claim_mono=prep.claim_mono)
+            # fetch-released docs keep their honest reason — an abort
+            # coinciding with a dependency brownout must not hide the
+            # brownout from the fetch_released counter
+            self._release_docs(
+                list(prep.released), REASON_FETCH, led, prep.claim_mono
+            )
+            docs = [it[0] for it, _ in prep.ok_items]
+            docs += [it[0] for it, _ in prep.ok_citems]
+            docs += [it[0] for it, _ in prep.ok_joint]
+            docs += list(prep.slow)
+            self._release_docs(docs, REASON_ABORT, led, prep.claim_mono)
+        except Exception:  # noqa: BLE001 — the primary error propagates
+            log.exception(
+                "failed to release an unjudged slice after sweep abort; "
+                "stuck-claim takeover will net its docs"
+            )
+
+    def _preempt_between_slices(
+        self, pool, led: _TickLedger, now: float, counters: dict
+    ) -> None:
+        """The slice-boundary preemption point (ISSUE 15 tentpole).
+
+        Pending dirty arrivals are triaged against the sweep itself:
+
+          * key matches POOLED docs (claimed, not yet fetched) — the
+            docs are PROMOTED to the front of the slice order; their
+            slice fetches post-arrival samples, so the sweep's own
+            write delivers the verdict within ~one slice, attributed
+            through the sweep ledger (earliest stamp wins).
+          * key matches an IN-FLIGHT slice (fetched or fetching — its
+            windows may predate the arrival) — requeued at the front
+            of the dirty set with the ORIGINAL stamp; once the slice's
+            write releases the doc, the next boundary claims it.
+          * anything else (docs outside this sweep's claim: new jobs,
+            already-written re-check docs) — a NESTED micro-tick runs
+            between slices, the unchanged `_tick` body on its own
+            ledger, every degradation contract intact.
+        """
+        dirty = self.dirty
+        if dirty is None or not len(dirty):
+            return
+        entries = dirty.take(self.microtick_docs)
+        if not entries:
+            return
+        micro_entries = []
+        for rk, stamp in entries:
+            if pool.promote(rk):
+                cur = led.pending.get(rk)
+                if cur is None or stamp < cur:
+                    led.pending[rk] = stamp
+                counters["promoted"] += 1
+                dirty.count("promoted")
+            elif pool.inflight(rk):
+                dirty.mark(rk, stamp, requeue=True)
+                counters["inflight_requeued"] += 1
+                dirty.count("inflight_requeued")
+            else:
+                micro_entries.append((rk, stamp))
+        if not micro_entries:
+            return
+        counters["preempt_microticks"] += 1
+        # the nested cycle swaps the innermost-ledger pointer and the
+        # tick deadline; restore both so the sweep's remaining slices
+        # keep their budget and attribution. It takes a FRESH clock
+        # (micro_tick's contract), NOT the sweep's start `now`: a
+        # late-sweep preemption judging with a clock stale by the
+        # sweep's whole duration would miss endTimes that elapsed
+        # mid-sweep and treat just-settled histories as unsettled —
+        # demoting the latency-critical arrival to the slow path
+        saved_deadline = self._tick_deadline
+        saved_ledger = self._ledger
+        try:
+            counters["preempt_docs"] += self._tick(
+                None, micro=micro_entries
+            )
+        finally:
+            self._tick_deadline = saved_deadline
+            self._ledger = saved_ledger
+
+    def _claim_cycle(self, led: _TickLedger, micro) -> list[Document]:
+        """Shared cycle head for the monolithic tick and the sliced
+        sweep: renew the mesh lease, compose the claim filter (mesh
+        partition, plus the dirty-key restriction for micro-ticks),
+        stamp the claim instant, and claim — degrading a transient
+        store failure to an empty cycle with the pending arrivals
+        requeued un-spent."""
         claim_kw = {}
         if self.mesh is not None:
             # idle ticks renew too — the lease must outlive quiet
@@ -2171,12 +2893,21 @@ class BrainWorker:
             claim_kw["claim_filter"] = self.mesh.claim_filter
         if micro is not None:
             claim_kw["claim_filter"] = self._micro_claim_filter(
-                claim_kw.get("claim_filter")
+                claim_kw.get("claim_filter"), led
             )
-        self._tick_claim_mono = time.monotonic()
+        # Write-behind age stamps measure from this instant. A sliced
+        # sweep PINS it at its own claim time (`_sweep_active`): a
+        # nested preemption micro-tick must never move it FORWARD,
+        # because the sweep's writer threads stamp concurrently — a
+        # fresher stamp on an older claim would stretch the replay
+        # window past the stuck-takeover boundary (the exactly-once
+        # net). The micro's own entries getting the sweep's OLDER
+        # stamp is conservative: they age out earlier, never later.
+        if not self._sweep_active:
+            self._tick_claim_mono = time.monotonic()
         with span("worker.claim", stage="claim", limit=self.claim_limit):
             try:
-                docs = self.store.claim(
+                return self.store.claim(
                     self.worker_id,
                     self.config.max_stuck_seconds,
                     self.claim_limit,
@@ -2195,13 +2926,26 @@ class BrainWorker:
                     "claim degraded to empty tick (store transient "
                     "error: %s)", e,
                 )
-                self._requeue_pending()
-                docs = []
+                self._requeue_pending(led)
+                return []
+
+    def _tick(self, now: float | None = None, micro=None) -> int:
+        t0 = time.perf_counter()
+        self._tick_deadline = self._degrade.deadline(t0)
+        now = time.time() if now is None else now
+        # replay any write-behind backlog FIRST: the store may have
+        # healed, and re-check docs buffered as preprocess_completed
+        # must become claimable before this tick's claim
+        self._flush_write_behind()
+        # reactive (ISSUE 12): a micro-tick owns the dirty entries it
+        # took; a full sweep drains the rest as its catch-all
+        led = self._begin_pending(micro)
+        docs = self._claim_cycle(led, micro)
         if docs and self._deadline_exceeded():
             # the claim alone blew the tick budget (store brownout):
             # give everything back un-judged rather than start a fetch/
             # judge pass that is already over budget
-            self._release_docs(docs, REASON_DEADLINE)
+            self._release_docs(docs, REASON_DEADLINE, led)
             docs = []
         if not docs:
             # idle cycles still did the claim round-trip (real store I/O)
@@ -2209,9 +2953,9 @@ class BrainWorker:
             # is not an idle RING (receiver threads keep pushing), so
             # snapshot cadence and provisional-fit refinement run here
             # (sweeps only — micro-ticks stay lean)
-            self._finish_pending()
+            self._finish_pending(led)
             if micro is not None:
-                self._tick_done(0, 0, t0, micro=True)
+                self._tick_done(0, 0, t0, micro=True, led=led)
                 return 0
             self._refine_provisional(now)
             self._maybe_persist()
@@ -2241,22 +2985,43 @@ class BrainWorker:
                         self.metrics.tick_seconds.observe(
                             time.perf_counter() - t0
                         )
-                self._tick_done(n_fast, n_fast, t0, micro=micro is not None)
+                self._tick_done(
+                    n_fast, n_fast, t0, micro=micro is not None, led=led
+                )
                 return n_fast
 
-        # Progressive admission (VERDICT r4 #7): the slow path — cold
-        # fits, baselines, joint models — processes the claim set in
-        # bounded DOC CHUNKS, bounding time-to-first-verdict by one
-        # chunk's work (and bounding peak host memory for the packed
-        # histories the same way _FIT_CHUNK bounds device memory). The
-        # chunks run through a bounded-depth pipeline (jobs/pipeline.py,
-        # FOREMAST_PIPELINE_DEPTH): chunk N+1's windows are prefetched
-        # while chunk N's judgment is in flight on the device and chunk
-        # N-1's verdicts drain to the store on a writer thread, so a
-        # fleet-cold tick approaches max(fetch, judge, write) per chunk
-        # instead of their sum. Warm steady state is unaffected: the
-        # columnar fast path above already consumed the all-warm subset,
-        # so `docs` here is usually tiny (a single serial chunk).
+        self._run_slow_chunks(docs, now, led, self._tick_claim_mono)
+        if self.metrics:
+            if self._uni is not None and hasattr(
+                self.metrics, "observe_arena"
+            ):
+                self.metrics.observe_arena(self._uni.device_state_counters())
+            if micro is None:
+                self.metrics.tick_seconds.observe(time.perf_counter() - t0)
+        self._tick_done(
+            n_fast + len(docs), n_fast, t0, micro=micro is not None, led=led
+        )
+        return n_fast + len(docs)
+
+    def _run_slow_chunks(
+        self, docs, now: float, led: _TickLedger, claim_mono: float
+    ) -> None:
+        """Progressive admission (VERDICT r4 #7): the slow path — cold
+        fits, baselines, joint models — processes the claim set in
+        bounded DOC CHUNKS, bounding time-to-first-verdict by one
+        chunk's work (and bounding peak host memory for the packed
+        histories the same way _FIT_CHUNK bounds device memory). The
+        chunks run through a bounded-depth pipeline (jobs/pipeline.py,
+        FOREMAST_PIPELINE_DEPTH): chunk N+1's windows are prefetched
+        while chunk N's judgment is in flight on the device and chunk
+        N-1's verdicts drain to the store on a writer thread, so a
+        fleet-cold tick approaches max(fetch, judge, write) per chunk
+        instead of their sum. Warm steady state is unaffected: the
+        columnar fast path already consumed the all-warm subset, so
+        `docs` here is usually tiny (a single serial chunk). Under a
+        sliced sweep (ISSUE 15) each slice's leftovers run through
+        their own bounded pass, so cold docs persist within their own
+        slice's lifetime."""
         chunk_docs = self.cold_chunk_docs
         # Pool/pipeline only when the source actually blocks on I/O:
         # in-memory sources declare concurrent_fetch=False (threading
@@ -2287,7 +3052,14 @@ class BrainWorker:
             # records keep the tick's trace ID
             inherit_span(_partial(self._fetch_chunk, now=now, use_pool=use_pool)),
             self._judge_chunk,
-            inherit_span(_partial(self._write_chunk, now=now)),
+            inherit_span(
+                _partial(
+                    self._write_chunk,
+                    now=now,
+                    led=led,
+                    claim_mono=claim_mono,
+                )
+            ),
             depth=depth,
             prefetch_pool=(
                 self._prefetch_pool_get()
@@ -2306,15 +3078,6 @@ class BrainWorker:
             self._last_pipeline = stats.as_dict()
             if self.metrics and hasattr(self.metrics, "observe_pipeline"):
                 self.metrics.observe_pipeline(stats)
-        if self.metrics:
-            if self._uni is not None and hasattr(
-                self.metrics, "observe_arena"
-            ):
-                self.metrics.observe_arena(self._uni.device_state_counters())
-            if micro is None:
-                self.metrics.tick_seconds.observe(time.perf_counter() - t0)
-        self._tick_done(n_fast + len(docs), n_fast, t0, micro=micro is not None)
-        return n_fast + len(docs)
 
     # -- slow-path pipeline stages (jobs/pipeline.py) --------------------
 
@@ -2386,7 +3149,14 @@ class BrainWorker:
             by_job.setdefault(v.job_id, []).append(v)
         return ok_docs, failed, by_job, released
 
-    def _write_chunk(self, chunk, result, now: float) -> None:
+    def _write_chunk(
+        self,
+        chunk,
+        result,
+        now: float,
+        led: _TickLedger | None = None,
+        claim_mono: float | None = None,
+    ) -> None:
         """Pipeline stage 3 (single writer thread, FIFO): status
         transitions + per-doc persistence + hooks. `_write_back` keeps
         decide + store.update together so subclass overrides stay
@@ -2402,9 +3172,11 @@ class BrainWorker:
             for doc, reason in released:
                 by_reason.setdefault(reason, []).append(doc)
             for reason, docs_r in by_reason.items():
-                self._release_docs(docs_r, reason)
+                self._release_docs(
+                    docs_r, reason, led, claim_mono=claim_mono
+                )
         for doc in failed:
-            self._store_update(doc)
+            self._store_update(doc, claim_mono=claim_mono)
             if self.metrics:
                 self.metrics.observe_doc(doc.status, 0)
         with span("worker.decide", stage="decide", docs=len(ok_docs)):
@@ -2421,7 +3193,7 @@ class BrainWorker:
                         log.exception(
                             "on_verdict hook failed for %s", doc.id
                         )
-        self._observe_verdicts(ok_docs)
+        self._observe_verdicts(ok_docs, led)
 
     def _log_judged(self, doc) -> None:
         """One correlatable line per service-created judgment: emitted
@@ -2458,7 +3230,12 @@ class BrainWorker:
             )
 
     def _tick_done(
-        self, n_docs: int, n_fast: int, t0: float, micro: bool = False
+        self,
+        n_docs: int,
+        n_fast: int,
+        t0: float,
+        micro: bool = False,
+        led: _TickLedger | None = None,
     ) -> None:
         """Record the finished busy tick for /debug/state and emit one
         correlatable completion log (the tick's trace ID rides on the
@@ -2466,7 +3243,7 @@ class BrainWorker:
         ledger + counter and skip durability housekeeping (snapshot
         cadence and journal compaction belong to the sweeps — a
         sub-second judgment path must never absorb a snapshot pass)."""
-        self._finish_pending()
+        self._finish_pending(self._ledger if led is None else led)
         seconds = time.perf_counter() - t0
         if micro:
             self._last_micro = {
@@ -2670,6 +3447,18 @@ class BrainWorker:
             "pipeline": (
                 dict(self._last_pipeline) if self._last_pipeline else None
             ),
+            # sliced, preemptible sweeps (ISSUE 15,
+            # FOREMAST_SWEEP_SLICE_DOCS): whether sweeps run sliced,
+            # and the latest sliced sweep's ledger — slice count, slow
+            # docs, promoted/requeued/micro-ticked preemptions, and the
+            # WARM-path pipeline occupancy (the slow path's twin above)
+            "sweep": {
+                "slice_docs": self.sweep_slice_docs,
+                "sliced": self._sweep_sliceable(),
+                "last": (
+                    dict(self._last_sweep) if self._last_sweep else None
+                ),
+            },
             # durable data plane (FOREMAST_SNAPSHOT_DIR): per-journal
             # fit persistence counters + ring snapshot cadence/restore
             # stats; None when the worker runs ephemeral

@@ -307,26 +307,49 @@ class WorkerMetrics:
             "evictions": 0,
             "fallbacks": 0,
         }
-        # slow-path chunk-pipeline occupancy (jobs/pipeline.py): the
-        # idle counter answers "how long did the device sit waiting on
-        # Prometheus", the two gauges snapshot the latest slow-path tick
+        # chunk-pipeline occupancy (jobs/pipeline.py), by path: the
+        # "slow" path is the cold chunk pipeline (PR 3), the "warm"
+        # path is the sliced sweep's claim-pool pipeline (ISSUE 15) —
+        # warm-tick host stalls were invisible before the label. The
+        # idle counter answers "how long did the judge stage sit
+        # waiting on its inputs", the gauges snapshot the latest run.
         self.pipeline_idle = Counter(
             "foremast_worker_pipeline_idle_seconds_total",
             "seconds the judge stage (the device) sat stalled waiting "
-            "for a chunk's metric windows",
+            "for a chunk's inputs, by pipeline path (slow = cold chunk "
+            "pipeline, warm = sliced-sweep pipeline)",
+            ["path"],
             registry=reg,
         )
         self.pipeline_overlap = Gauge(
             "foremast_worker_pipeline_overlap_ratio",
-            "latest slow-path tick: fraction of stage-busy seconds "
+            "latest tick per path: fraction of stage-busy seconds "
             "hidden by fetch/judge/write overlap (0 = serial, ~0.67 = "
             "perfect three-stage overlap)",
+            ["path"],
             registry=reg,
         )
         self.pipeline_queue = Gauge(
             "foremast_worker_pipeline_write_queue_peak",
-            "latest slow-path tick: peak depth of the verdict "
+            "latest tick per path: peak depth of the verdict "
             "write-back queue",
+            ["path"],
+            registry=reg,
+        )
+        # sliced, preemptible sweeps (ISSUE 15)
+        self.sweep_slices = Counter(
+            "foremast_sweep_slices_total",
+            "bounded slices executed by sliced sweeps "
+            "(FOREMAST_SWEEP_SLICE_DOCS)",
+            registry=reg,
+        )
+        self.sweep_preempt = Counter(
+            "foremast_sweep_preempt_events_total",
+            "slice-boundary preemption outcomes (promoted = pooled "
+            "docs pulled into the next slice, inflight_requeued = "
+            "arrival retried behind an in-flight slice, microtick = "
+            "nested micro-tick ran between slices)",
+            ["action"],
             registry=reg,
         )
         # ring-first cold path (ISSUE 10): where each cold fit's
@@ -420,12 +443,30 @@ class WorkerMetrics:
             "gather_s": 0.0, "gather_b": 0,
         }
 
-    def observe_pipeline(self, stats) -> None:
-        """Feed one slow-path tick's ChunkPipeline stats
-        (jobs/pipeline.py PipelineStats)."""
-        self.pipeline_idle.inc(max(0.0, stats.judge_stall_seconds))
-        self.pipeline_overlap.set(stats.overlap_ratio())
-        self.pipeline_queue.set(stats.write_queue_peak)
+    def observe_pipeline(self, stats, path: str = "slow") -> None:
+        """Feed one ChunkPipeline run's stats (jobs/pipeline.py
+        PipelineStats) — path "slow" for the cold chunk pipeline,
+        "warm" for the sliced sweep's."""
+        self.pipeline_idle.labels(path=path).inc(
+            max(0.0, stats.judge_stall_seconds)
+        )
+        self.pipeline_overlap.labels(path=path).set(stats.overlap_ratio())
+        self.pipeline_queue.labels(path=path).set(stats.write_queue_peak)
+
+    def observe_sweep(self, stats, counters: dict) -> None:
+        """Feed one sliced sweep's pipeline stats + preemption
+        counters (BrainWorker._sweep_sliced)."""
+        if stats is not None:
+            self.observe_pipeline(stats, path="warm")
+        self.sweep_slices.inc(counters.get("slices", 0))
+        for action, key in (
+            ("promoted", "promoted"),
+            ("inflight_requeued", "inflight_requeued"),
+            ("microtick", "preempt_microticks"),
+        ):
+            n = counters.get(key, 0)
+            if n:
+                self.sweep_preempt.labels(action=action).inc(n)
 
     def observe_doc(self, status: str, n_windows: int) -> None:
         self.jobs.labels(status=status).inc()

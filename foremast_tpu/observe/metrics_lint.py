@@ -34,12 +34,16 @@ ALLOWED_LABELS: dict[str, frozenset[str]] = {
     "foremast_worker_tick_seconds": frozenset(),
     "foremast_worker_arena_events": frozenset({"event"}),
     "foremast_worker_fast_docs": frozenset({"kind"}),
-    # slow-path chunk pipeline (observe/gauges.py WorkerMetrics) — these
-    # predate the metrics-contract rule, which surfaced them missing
-    # from the registry (their label sets were unchecked)
-    "foremast_worker_pipeline_idle_seconds": frozenset(),
-    "foremast_worker_pipeline_overlap_ratio": frozenset(),
-    "foremast_worker_pipeline_write_queue_peak": frozenset(),
+    # chunk-pipeline occupancy (observe/gauges.py WorkerMetrics), by
+    # path since ISSUE 15: "slow" = the cold chunk pipeline (PR 3),
+    # "warm" = the sliced sweep's claim-pool pipeline
+    "foremast_worker_pipeline_idle_seconds": frozenset({"path"}),
+    "foremast_worker_pipeline_overlap_ratio": frozenset({"path"}),
+    "foremast_worker_pipeline_write_queue_peak": frozenset({"path"}),
+    # sliced, preemptible sweeps (ISSUE 15, observe/gauges.py
+    # WorkerMetrics)
+    "foremast_sweep_slices": frozenset(),
+    "foremast_sweep_preempt_events": frozenset({"action"}),
     # ring-first cold start + background refinement (ISSUE 10,
     # observe/gauges.py WorkerMetrics)
     "foremast_cold_hist_reads": frozenset({"source"}),
@@ -120,14 +124,24 @@ FAMILY_DOCS: dict[str, str] = {
         "pairwise-active columnar program)"
     ),
     "foremast_worker_pipeline_idle_seconds": (
-        "seconds the judge stage sat stalled waiting on a chunk's fetch"
+        "seconds the judge stage sat stalled waiting on a chunk's "
+        "inputs, by path (slow = cold chunk pipeline, warm = "
+        "sliced-sweep pipeline)"
     ),
     "foremast_worker_pipeline_overlap_ratio": (
-        "latest slow-path tick: fraction of stage-busy seconds hidden "
+        "latest tick per path: fraction of stage-busy seconds hidden "
         "by fetch/judge/write overlap"
     ),
     "foremast_worker_pipeline_write_queue_peak": (
-        "latest slow-path tick: peak verdict write-back queue depth"
+        "latest tick per path: peak verdict write-back queue depth"
+    ),
+    "foremast_sweep_slices": (
+        "bounded slices executed by sliced sweeps "
+        "(FOREMAST_SWEEP_SLICE_DOCS, ISSUE 15)"
+    ),
+    "foremast_sweep_preempt_events": (
+        "slice-boundary preemption outcomes (promoted / "
+        "inflight_requeued / microtick)"
     ),
     "foremast_cold_hist_reads": (
         "historical-range reads on the cold-fit path, by serving "
@@ -167,7 +181,7 @@ FAMILY_DOCS: dict[str, str] = {
     ),
     "foremast_microtick_dirty_events": (
         "dirty-set traffic (marked/coalesced/dropped/foreign/"
-        "requeued/unattributed)"
+        "requeued/unattributed/promoted/inflight_requeued)"
     ),
     "foremast_microtick_dirty_pending": (
         "route keys currently pending in the dirty set"
@@ -320,6 +334,13 @@ def default_registry_families():
     for path in ("micro", "sweep"):
         metrics.verdict_latency.labels(path=path).observe(0.1)
     metrics.microtick_docs.inc()
+    for path in ("slow", "warm"):
+        metrics.pipeline_idle.labels(path=path).inc(0.0)
+        metrics.pipeline_overlap.labels(path=path).set(0.0)
+        metrics.pipeline_queue.labels(path=path).set(0)
+    metrics.sweep_slices.inc()
+    for action in ("promoted", "inflight_requeued", "microtick"):
+        metrics.sweep_preempt.labels(action=action).inc()
     tracer = Tracer(service="lint", registry=registry, trace_dir=None)
     from foremast_tpu.observe.spans import TICK_STAGES
 

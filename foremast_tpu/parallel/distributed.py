@@ -349,6 +349,13 @@ class PodWorker(BrainWorker):
         # pod mode runs unbudgeted (the pod watchdog still bounds a
         # wedged collective via FOREMAST_POD_TIMEOUT_SECONDS).
         self._degrade.tick_budget_seconds = 0.0
+        # Sliced sweeps (ISSUE 15) stay OFF in pod mode for the same
+        # class of reason: slice boundaries, dirty promotion, and the
+        # warm pipeline's prefetch-thread fetches are process-local
+        # control flow (and LeaderSource fetches are ordered
+        # collectives that must never run off the tick thread). Every
+        # process runs the monolithic tick body.
+        self.sweep_slice_docs = 0
         if knobs is not None and not is_leader():
             self.cold_chunk_docs = knobs[0]
             # pipeline depth/pool size are broadcast for completeness:

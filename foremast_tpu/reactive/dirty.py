@@ -54,10 +54,21 @@ from foremast_tpu.mesh.routing import DEFAULT_ROUTE_LABEL, series_route_key
 
 DEFAULT_DIRTY_MAX = 8_192
 DEFAULT_MICROTICK_DOCS = 256
+# Sliced, preemptible sweeps (ISSUE 15): the slice size that bounds a
+# sweep's preemption latency; 0 = monolithic sweeps. Resolved here —
+# next to the other reactive-plane pacing knobs — so the worker and
+# the cli's startup log share one warn-and-default parser.
+DEFAULT_SWEEP_SLICE_DOCS = 2_048
 
 _EVENTS = (
     "marked", "coalesced", "dropped", "foreign", "requeued",
     "unattributed",
+    # sliced-sweep preemption outcomes (ISSUE 15, worker-side via
+    # count()): an arrival triaged at a slice boundary either PROMOTED
+    # its pooled documents to the next slice, or was requeued because
+    # its document's slice was already in flight (windows possibly
+    # pre-arrival — retried once the slice's write releases the doc)
+    "promoted", "inflight_requeued",
 )
 
 log = logging.getLogger("foremast_tpu.reactive")
@@ -92,6 +103,17 @@ def microtick_docs_from_env() -> int:
     return _num(
         os.environ.get("FOREMAST_MICROTICK_DOCS", ""),
         DEFAULT_MICROTICK_DOCS, int, "FOREMAST_MICROTICK_DOCS",
+    )
+
+
+def sweep_slice_docs_from_env() -> int:
+    """THE resolution of FOREMAST_SWEEP_SLICE_DOCS (ISSUE 15: sweep
+    slice size, 0 = monolithic) — warn-and-default like every reactive
+    knob, so an empty templated value degrades instead of killing
+    worker startup."""
+    return _num(
+        os.environ.get("FOREMAST_SWEEP_SLICE_DOCS", ""),
+        DEFAULT_SWEEP_SLICE_DOCS, int, "FOREMAST_SWEEP_SLICE_DOCS",
     )
 
 
@@ -234,7 +256,9 @@ class ReactiveCollector:
             "dirty-set traffic (marked=new key, coalesced=key already "
             "pending, dropped=evicted past FOREMAST_MICROTICK_DIRTY_MAX, "
             "foreign=owned by another mesh member, requeued=given back "
-            "un-judged, unattributed=arrival no judged doc matched)",
+            "un-judged, unattributed=arrival no judged doc matched, "
+            "promoted=sweep slice pulled forward for the arrival, "
+            "inflight_requeued=arrival retried behind an in-flight slice)",
             labels=["event"],
         )
         for event in _EVENTS:

@@ -2,6 +2,8 @@
 
 import json
 
+import pytest
+
 import benchmarks.suite as suite
 
 
@@ -312,6 +314,12 @@ def test_latency_bench_small_smoke(capsys):
     assert out["deploy_to_first_verdict_seconds"] is not None
     assert out["anomaly_latency_p99_seconds"] is not None
     assert out["parity"] == "byte-identical (asserted)"
+    # ISSUE 15: the sliced-vs-monolithic parity arm ran (the sharded
+    # child arm is full-run only), and the warm-throughput phase
+    # actually exercised the sliced warm pipeline (slices > 1)
+    assert out["sliced_parity"].startswith("byte-identical")
+    assert out["warm_throughput"]["slices"] > 1
+    assert out["warm_throughput"]["warm_windows_per_sec"] > 0
 
 
 def test_elastic_bench_small_smoke(capsys):
@@ -432,6 +440,66 @@ def test_mixed_bench_scenario_matrix_small():
             assert canary["f1"] >= by[(other, regime)]["f1"] - 0.1, (
                 canary, by[(other, regime)],
             )
+
+
+def test_mixed_bench_label_shape_routing_small():
+    """Label-shape routing cells (ISSUE 15 satellite / ROADMAP item
+    4's generator gap): multi-cluster and multi-tenant label shapes
+    must leave doc↔series co-location AND ownership spread invariant —
+    the mesh routes by the `app` label value alone, so extra
+    cluster/tenant labels can never move a series off its document's
+    worker (asserted inside the cell)."""
+    from benchmarks.scenarios import LABEL_SHAPES, label_shape_routing_cell
+
+    rows = [
+        label_shape_routing_cell(shape, services=64, workers=4)
+        for shape in LABEL_SHAPES
+    ]
+    assert [r["label_shape"] for r in rows] == list(LABEL_SHAPES)
+    for row in rows:
+        assert row["co_located"] is True
+        assert sum(row["owners"].values()) == 64
+    # ownership is a function of the ROUTE KEY alone: identical
+    # distributions across shapes is the invariance made visible
+    assert rows[0]["owners"] == rows[1]["owners"] == rows[2]["owners"]
+
+
+def test_bench_report_round_and_merge(tmp_path, monkeypatch):
+    """BENCH_rNN.json emission (ISSUE 15 satellite): summaries merge
+    per bench under one round file, --small runs never write, and the
+    round resolves from BENCHMARKS.md's highest pinned round + 1."""
+    from benchmarks import report
+
+    # the env override must not leak into the resolution assertions
+    monkeypatch.delenv("FOREMAST_BENCH_ROUND", raising=False)
+
+    path = str(tmp_path / "BENCH_r99.json")
+    assert report.write_summary("latency", {"p99": 0.4}, small=True) is None
+    out = report.write_summary("latency", {"p99": 0.4}, path=path)
+    assert out == path
+    report.write_summary("mixed", {"wps": 1.0}, path=path)
+    with open(path) as f:
+        doc = json.load(f)
+    assert set(doc["results"]) == {"latency", "mixed"}
+    assert doc["results"]["latency"]["asserts_passed"] is True
+    assert doc["results"]["latency"]["p99"] == 0.4
+    # round resolution: highest pinned round + 1, in BOTH heading
+    # spellings ("## Round N" and "## <title> (round N, ...)")
+    md = tmp_path / "BENCHMARKS.md"
+    md.write_text(
+        "## Round 3\n\nstuff\n\n"
+        "## Columnar canary: fast path (round 12, `make bench-mixed`)\n"
+    )
+    assert report.current_round(str(tmp_path)) == 13
+    # the REAL BENCHMARKS.md resolves to a round past every pinned one
+    assert report.current_round() >= 17
+    # a foreign-schema artifact (e.g. the driver's own BENCH_rNN.json)
+    # is never clobbered — loud failure, not silent overwrite
+    foreign = tmp_path / "BENCH_r01.json"
+    foreign.write_text('{"n": 1, "cmd": "x"}')
+    with pytest.raises(ValueError):
+        report.write_summary("latency", {"p99": 1}, path=str(foreign))
+    assert json.loads(foreign.read_text())["n"] == 1
 
 
 def test_mixed_bench_fanin_small():
