@@ -92,9 +92,13 @@ native:
 deploy-render:
 	$(PY) -m foremast_tpu.deploy deploy
 
-# Unified static analysis (docs/static-analysis.md): jit-hygiene,
-# async-blocking, lock-discipline, env-contract + the metric naming
-# lint, gated against analysis_baseline.json.
+# Unified static analysis (docs/static-analysis.md): the per-module
+# rules (jit-hygiene, async-blocking, lock-discipline, env-contract,
+# metrics-contract), the whole-program rules (lock-order,
+# thread-escape, blocking-under-lock, device-flow, recompile-hazard,
+# sharding-contract, status-machine), the generated-artifact gates
+# (env table, metric families, lock graph, status graph) and the
+# metric naming lint, gated against analysis_baseline.json.
 check:
 	$(PY) -m foremast_tpu.analysis
 
@@ -117,6 +121,12 @@ metrics-docs:
 lockgraph:
 	$(PY) -m foremast_tpu.analysis --write-lockgraph
 
+# recompute + commit the doc status transition graph
+# (analysis_statusgraph.json; rule: status-machine — `make check` fails
+# when the committed artifact drifts from the computed graph)
+statusgraph:
+	$(PY) -m foremast_tpu.analysis --write-statusgraph
+
 docker-build:
 	docker build -t foremast/foremast-tpu:0.1.0 .
 
@@ -124,4 +134,4 @@ clean:
 	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 
-.PHONY: test test-fast ci bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest bench-scaleout bench-cold bench-restart bench-chaos bench-elastic native deploy-render check metrics-lint env-docs metrics-docs lockgraph docker-build clean
+.PHONY: test test-fast ci bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest bench-scaleout bench-cold bench-restart bench-chaos bench-elastic native deploy-render check metrics-lint env-docs metrics-docs lockgraph statusgraph docker-build clean

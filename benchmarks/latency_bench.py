@@ -337,14 +337,35 @@ def run_warm_throughput(
     )
     if small:
         worker.sweep_slice_docs = 32  # slices engage at smoke shape too
-    t0 = time.perf_counter()
-    assert worker.tick(now=now + 150) == n
-    cold_s = time.perf_counter() - t0
-    rates = []
-    for k in range(ticks):
-        t0 = time.perf_counter()
-        assert worker.tick(now=now + 160 + 10 * k) == n
-        rates.append(windows / (time.perf_counter() - t0))
+    # backend-compile witness: the cold tick owns every compile; a warm
+    # tick that recompiles has a dispatch cache-key leak (the static
+    # recompile-hazard rule's runtime twin, docs/static-analysis.md)
+    from foremast_tpu.analysis.recompile_witness import RecompileWitness
+
+    wit = RecompileWitness()
+    wit.install()
+    try:
+        with wit.phase("cold"):
+            t0 = time.perf_counter()
+            assert worker.tick(now=now + 150) == n
+            cold_s = time.perf_counter() - t0
+        rates = []
+        # the FIRST warm tick owns the pipelined warm path's one-time
+        # compiles (the cold sweep runs the monolithic program, so its
+        # tick cannot warm them); every tick after it must run entirely
+        # from the dispatch cache
+        with wit.phase("pipeline_warmup"):
+            t0 = time.perf_counter()
+            assert worker.tick(now=now + 160) == n
+            rates.append(windows / (time.perf_counter() - t0))
+        with wit.phase("warm"):
+            for k in range(1, ticks):
+                t0 = time.perf_counter()
+                assert worker.tick(now=now + 160 + 10 * k) == n
+                rates.append(windows / (time.perf_counter() - t0))
+        wit.assert_zero("warm")
+    finally:
+        wit.uninstall()
     wps = float(np.median(rates))
     sweep = dict(worker._last_sweep or {})
     pipe = sweep.get("pipeline") or {}
@@ -359,6 +380,7 @@ def run_warm_throughput(
         "warm_overlap_ratio": pipe.get("overlap_ratio"),
         "warm_device_idle_seconds": pipe.get("device_idle_seconds"),
         "warm_write_queue_peak": pipe.get("write_queue_peak"),
+        "recompiles": wit.snapshot(),
     }
     assert sweep.get("slices", 0) > 1, sweep  # the sliced path ran
     if not small:
@@ -632,7 +654,12 @@ def main(argv=None):
     print(json.dumps(result), flush=True)
     from benchmarks.report import write_summary
 
-    write_summary("latency", result, small=args.small)
+    write_summary(
+        "latency",
+        result,
+        small=args.small,
+        recompiles=result["warm_throughput"].get("recompiles"),
+    )
 
 
 if __name__ == "__main__":

@@ -70,13 +70,19 @@ def write_summary(
     small: bool = False,
     asserts_passed: bool = True,
     path: str | None = None,
+    recompiles: dict | None = None,
 ) -> str | None:
     """Fold one bench's headline result into the round's JSON artifact.
 
     Returns the file path written, or None for smoke runs. `result`
     must already be JSON-serializable (every bench prints it as a JSON
-    line — this is the same dict). Failures to write are raised: a CI
-    lane asking for the artifact must not silently get prose only."""
+    line — this is the same dict). `recompiles` is the bench's
+    RecompileWitness snapshot ({"total": N, "<phase>": n, ...}) when it
+    ran one — benches assert zero WARM-phase backend compiles in-run
+    (docs/static-analysis.md, rule recompile-hazard); the artifact pins
+    the counts so a cache-key leak shows up as a diff even where no
+    phase asserts. Failures to write are raised: a CI lane asking for
+    the artifact must not silently get prose only."""
     if small:
         return None
     if path is None:
@@ -112,7 +118,10 @@ def write_summary(
         doc["round"] = rnd
         if not isinstance(doc.get("results"), dict):
             doc["results"] = {}
-    doc["results"][bench] = dict(result, asserts_passed=asserts_passed)
+    entry = dict(result, asserts_passed=asserts_passed)
+    if recompiles is not None:
+        entry["recompiles"] = recompiles
+    doc["results"][bench] = entry
     tmp = f"{path}.tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)

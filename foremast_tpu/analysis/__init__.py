@@ -24,13 +24,19 @@ exits non-zero on any new finding, which a tier-1 test enforces.
 
 Rules: jit-hygiene, async-blocking, lock-discipline, env-contract,
 metrics-contract (per-module); lock-order, thread-escape,
-blocking-under-lock (whole-program, over `interproc.Program`'s
-cross-module call resolution — they model the threaded data plane the
-per-class rules cannot see); metrics-lint (registry fold-in). The
-static lock-acquisition graph is committed as
-``analysis_lockgraph.json`` and cross-checked at runtime by
-``analysis/witness.py`` (FOREMAST_LOCK_WITNESS). See
-docs/static-analysis.md.
+blocking-under-lock, device-flow, recompile-hazard, sharding-contract,
+status-machine (whole-program, over `interproc.Program`'s cross-module
+call resolution — they model the threaded data plane and the device
+boundary the per-class rules cannot see); metrics-lint (registry
+fold-in). Two graphs are committed and drift-gated: the static
+lock-acquisition graph (``analysis_lockgraph.json``, cross-checked at
+runtime by ``analysis/witness.py`` / FOREMAST_LOCK_WITNESS) and the doc
+status transition graph (``analysis_statusgraph.json``, rule
+status-machine). The device-side twin of the lock witness is
+``analysis/recompile_witness.py`` (FOREMAST_RECOMPILE_WITNESS): it
+counts actual backend compiles per phase so the benches can assert the
+warm path never recompiles — the runtime witness for what
+recompile-hazard checks statically. See docs/static-analysis.md.
 """
 
 from __future__ import annotations

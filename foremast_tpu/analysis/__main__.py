@@ -57,15 +57,21 @@ def metrics_lint_findings() -> list[Finding]:
 
 
 def program_findings(root: str, modules) -> list[Finding]:
-    """The whole-program concurrency rules (full-scan only): static
-    lock graph + cycle/staleness gate, thread-escape, and
-    blocking-under-lock, with per-line suppressions applied."""
+    """The whole-program rules (full-scan only): the concurrency set
+    (static lock graph + cycle/staleness gate, thread-escape,
+    blocking-under-lock) and the v3 device/state set (device-flow,
+    recompile-hazard, sharding-contract, status-machine + statusgraph
+    drift gate), with per-line suppressions applied."""
     from foremast_tpu.analysis.blocking_under_lock import (
         apply_suppressions,
         check_blocking_under_lock,
     )
+    from foremast_tpu.analysis.device_flow import check_device_flow
     from foremast_tpu.analysis.interproc import Program
     from foremast_tpu.analysis.lock_order import check_lock_order
+    from foremast_tpu.analysis.recompile_hazard import check_recompile_hazard
+    from foremast_tpu.analysis.sharding_contract import check_sharding_contract
+    from foremast_tpu.analysis.status_machine import check_status_machine
     from foremast_tpu.analysis.thread_escape import check_thread_escape
 
     pkg = [m for m in modules if m.relpath.startswith("foremast_tpu/")]
@@ -74,6 +80,10 @@ def program_findings(root: str, modules) -> list[Finding]:
         check_lock_order(root, program)
         + check_thread_escape(program)
         + check_blocking_under_lock(program)
+        + check_device_flow(program)
+        + check_recompile_hazard(program)
+        + check_sharding_contract(program)
+        + check_status_machine(root, program)
     )
     return apply_suppressions(findings, pkg)
 
@@ -140,7 +150,9 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m foremast_tpu.analysis",
         description="foremast-check: jit-hygiene, async-blocking, "
         "lock-discipline, env-contract, metrics-contract, lock-order, "
-        "thread-escape, blocking-under-lock, metrics-lint",
+        "thread-escape, blocking-under-lock, device-flow, "
+        "recompile-hazard, sharding-contract, status-machine, "
+        "metrics-lint",
     )
     p.add_argument(
         "paths",
@@ -187,6 +199,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="recompute the static lock-acquisition graph, write "
         "analysis_lockgraph.json, and exit",
     )
+    p.add_argument(
+        "--write-statusgraph",
+        action="store_true",
+        help="recompute the doc status transition graph, write "
+        "analysis_statusgraph.json, and exit",
+    )
     return p
 
 
@@ -229,6 +247,30 @@ def main(argv: list[str] | None = None) -> int:
         print(
             f"wrote {GRAPH_NAME}: {len(graph['nodes'])} lock(s), "
             f"{len(graph['edges'])} edge(s)"
+        )
+        return 0
+    if args.write_statusgraph:
+        from foremast_tpu.analysis.interproc import Program
+        from foremast_tpu.analysis.status_machine import (
+            GRAPH_NAME as STATUS_GRAPH,
+            build_graph as build_status_graph,
+            write_graph as write_status_graph,
+        )
+
+        pkg = [
+            m
+            for m in collect_modules(root)
+            if m.relpath.startswith("foremast_tpu/")
+        ]
+        graph = build_status_graph(Program(pkg))
+        if graph is None:
+            print("no status registry found (jobs/models.py)", file=sys.stderr)
+            return 2
+        write_status_graph(root, graph)
+        print(
+            f"wrote {STATUS_GRAPH}: {len(graph['statuses'])} status(es), "
+            f"{len(graph['transitions'])} transition(s), "
+            f"{len(graph['writes'])} write site(s)"
         )
         return 0
 

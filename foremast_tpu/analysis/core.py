@@ -30,6 +30,15 @@ _SUPPRESS_RE = re.compile(
 )
 _ALL_RULES = "*"
 
+# Positive annotations: `# foremast: device-boundary` marks a function
+# as a sanctioned gather/decode stage (rule device-flow allows host
+# syncs inside it), `# foremast: replicated-arena` marks sharded code
+# that touches arena rows under the replicated-placement contract
+# (rule sharding-contract). Unlike `ignore[...]` these are CONTRACT
+# declarations, not finding suppressions: they change what the rule
+# checks, and the docs inventory them (docs/static-analysis.md).
+_MARKER_RE = re.compile(r"#\s*foremast:\s*(?P<marker>[a-z][a-z-]+)")
+
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
@@ -115,6 +124,31 @@ class Module:
             if _ALL_RULES in rules or rule in rules:
                 return True
         return False
+
+    def marked(self, line: int, marker: str) -> bool:
+        """True when `line` or the comment line right above it carries
+        the `# foremast: <marker>` annotation (same placement rules as
+        suppressions: the declaration lives next to the code it
+        covers)."""
+        for candidate in (line, line - 1):
+            if candidate < 1 or candidate > len(self.lines):
+                continue
+            text = self.lines[candidate - 1]
+            if candidate == line - 1 and not text.strip().startswith("#"):
+                continue
+            for m in _MARKER_RE.finditer(text):
+                if m.group("marker") == marker:
+                    return True
+        return False
+
+    def marked_def(self, fn_node: ast.AST, marker: str) -> bool:
+        """True when a function definition carries the marker: on the
+        `def` line, the line above it, or above its first decorator."""
+        lines = [fn_node.lineno]
+        decos = getattr(fn_node, "decorator_list", [])
+        if decos:
+            lines.append(min(d.lineno for d in decos))
+        return any(self.marked(ln, marker) for ln in lines)
 
     def finding(
         self, rule: str, node: ast.AST | int, message: str, hint: str = ""

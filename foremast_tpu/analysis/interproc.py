@@ -567,6 +567,35 @@ class Program:
                 )
         return out
 
+    def resolve_call_direct(
+        self, call: ast.Call, fn: FunctionInfo
+    ) -> list[FunctionInfo]:
+        """`resolve_call` minus the callback-table spread: only callees
+        the call site NAMES directly (same simple name, or a
+        constructor call naming the class). The lock/blocking
+        summaries WANT the over-approximation (a superset of runtime
+        behavior is the conservative answer there); taint- and
+        ownership-style analyses (device-flow, status-machine) must
+        not — resolving a generic driver's `cb(...)` to every
+        registered callback marries the driver's call sites to every
+        callback's parameters and floods the whole package."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+        else:
+            return []
+        out = []
+        for callee in self.resolve_call(call, fn):
+            if callee.name == name:
+                out.append(callee)
+            elif callee.name == "__init__" and callee.class_key is not None:
+                cls = self.classes.get(callee.class_key)
+                if cls is not None and cls.name == name:
+                    out.append(callee)
+        return out
+
     # -- lock identification ---------------------------------------------
 
     def lock_for_with_item(

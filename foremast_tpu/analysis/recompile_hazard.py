@@ -1,0 +1,416 @@
+"""recompile-hazard: dispatch-cache blowups at jit call sites.
+
+jit-hygiene (PR 2) guards the INSIDE of jitted functions; this rule
+guards their CALL SITES — the other half of the dispatch-cache
+contract. XLA keys the compiled-program cache on (shapes, static
+values): a caller that feeds raw data-dependent sizes or unbounded
+statics compiles a fresh program per distinct value, and the warm tick
+becomes a recompile storm that the runtime witness
+(`analysis/recompile_witness.py`) counts and the benches gate on.
+
+Three checks, interprocedural over `interproc.Program`:
+
+  * SHAPE — a buffer argument at a call site of a jit entry point or a
+    columnar dispatch root (`judge_columnar`/`judge_columnar_async`)
+    whose trailing dimension does not come from the pow2/bucket
+    helpers (`bucket_length`, `_pow2`, `pad_to_multiple`,
+    `_batch_multiple`) is a finding: the LEADING (batch) axis is
+    re-bucketed by the callee, but trailing axes key the program —
+    `np.zeros((n_rows, len(vals)))` compiles per distinct series
+    length, `np.zeros((n_rows, bucket_length(n)))` compiles once per
+    pow2 bucket;
+  * STATIC — a ``static_argnames``/``static_argnums`` value at a call
+    site must come from a bounded domain: constants, module constants,
+    config/spec attribute reads, or bucket-helper results. A value
+    derived from ``len(...)`` (or arithmetic over one) is a finding —
+    every distinct size is a distinct cache entry;
+  * PER-CALL JIT — ``jax.jit(...)`` / ``partial(jax.jit, ...)``
+    evaluated inside a function body (other than ``__init__``, where
+    caching the wrapper per instance is the idiom) builds a NEW
+    callable with an empty cache on every call.
+
+Resolution is name-based across the package (the same
+over-approximation the concurrency rules use): a call whose bare or
+attribute name matches a jitted def anywhere in the package is checked
+against that def's statics.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from foremast_tpu.analysis.core import Finding
+from foremast_tpu.analysis.interproc import (
+    FunctionInfo,
+    Program,
+    dotted,
+    own_body_walk,
+)
+from foremast_tpu.analysis.jit_hygiene import (
+    _is_jax_jit,
+    _jit_call_statics,
+    _param_names,
+)
+
+RULE = "recompile-hazard"
+
+DISPATCH_ENTRIES = frozenset({"judge_columnar", "judge_columnar_async"})
+BUCKET_HELPERS = frozenset(
+    {"bucket_length", "_pow2", "pad_to_multiple", "_batch_multiple"}
+)
+_NP_CONSTRUCTORS = frozenset({"zeros", "ones", "empty", "full"})
+_ARITH_CALLS = frozenset({"max", "min", "int", "round", "abs"})
+
+# classification lattice for size/static expressions
+BOUNDED = "bounded"
+RAW = "raw"
+UNKNOWN = "unknown"
+
+
+@dataclasses.dataclass
+class _JitEntry:
+    fn: FunctionInfo
+    params: list[str]
+    statics: frozenset[str]
+
+
+def _module_consts(tree: ast.Module) -> dict[str, ast.AST]:
+    consts: dict[str, ast.AST] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            t = stmt.targets[0]
+            if isinstance(t, ast.Name):
+                consts[t.id] = stmt.value
+    return consts
+
+
+def collect_jit_entries(program: Program) -> dict[str, list[_JitEntry]]:
+    """Every jit-decorated def in the package, by simple name, with its
+    static parameter set (``static_argnames`` + ``static_argnums``
+    mapped through the parameter list, following the module-constant
+    indirection jit-hygiene handles)."""
+    out: dict[str, list[_JitEntry]] = {}
+    consts_by_mod = {
+        m.relpath: _module_consts(m.tree) for m in program.modules
+    }
+    for fn in program.functions:
+        consts = consts_by_mod.get(fn.module.relpath, {})
+        statics: set[str] = set()
+        jitted = False
+        for deco in fn.node.decorator_list:
+            if _is_jax_jit(deco):
+                jitted = True
+            elif isinstance(deco, ast.Call):
+                st = _jit_call_statics(deco, consts)
+                if st is not None:
+                    jitted = True
+                    names, nums = st
+                    params = _param_names(fn.node)
+                    statics.update(names)
+                    statics.update(
+                        params[i] for i in nums if i < len(params)
+                    )
+        if jitted:
+            out.setdefault(fn.name, []).append(
+                _JitEntry(
+                    fn=fn,
+                    params=_param_names(fn.node),
+                    statics=frozenset(statics),
+                )
+            )
+    return out
+
+
+class _SizeFlow:
+    """Per-function classification of size-bearing expressions."""
+
+    def __init__(self, fn: FunctionInfo, consts: dict[str, ast.AST]):
+        self.fn = fn
+        self.consts = consts
+        self.bucketed: set[str] = set()
+        self.raw: set[str] = set()
+        # name -> the np constructor call it was assigned from
+        self.constructed: dict[str, ast.Call] = {}
+        self._scan()
+
+    def _scan(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for node in own_body_walk(self.fn.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                names = [
+                    t.id for t in node.targets if isinstance(t, ast.Name)
+                ]
+                if not names:
+                    continue
+                if _np_constructor(node.value) is not None:
+                    for n in names:
+                        self.constructed.setdefault(n, node.value)
+                cls = self.classify(node.value)
+                target_set = (
+                    self.bucketed
+                    if cls == BOUNDED
+                    else self.raw if cls == RAW else None
+                )
+                if target_set is not None:
+                    for n in names:
+                        if n not in target_set:
+                            target_set.add(n)
+                            changed = True
+
+    def classify(self, expr: ast.AST) -> str:
+        """BOUNDED (constant / config attr / bucket-derived), RAW
+        (data-dependent size: len() and arithmetic over one), or
+        UNKNOWN (parameters, unresolved calls — never flagged)."""
+        if isinstance(expr, ast.Constant):
+            return BOUNDED
+        if isinstance(expr, ast.Attribute):
+            # config/spec field reads are bounded domains by contract
+            return BOUNDED if dotted(expr) is not None else UNKNOWN
+        if isinstance(expr, ast.Name):
+            if expr.id in self.bucketed:
+                return BOUNDED
+            if expr.id in self.raw:
+                return RAW
+            if expr.id in self.consts:
+                return BOUNDED
+            return UNKNOWN
+        if isinstance(expr, ast.Call):
+            name = None
+            if isinstance(expr.func, ast.Name):
+                name = expr.func.id
+            elif isinstance(expr.func, ast.Attribute):
+                name = expr.func.attr
+            if name in BUCKET_HELPERS:
+                return BOUNDED
+            if name == "len":
+                return RAW
+            if name in _ARITH_CALLS:
+                kinds = {self.classify(a) for a in expr.args}
+                if RAW in kinds:
+                    return RAW
+                if kinds <= {BOUNDED}:
+                    return BOUNDED
+                return UNKNOWN
+            return UNKNOWN
+        if isinstance(expr, ast.BinOp):
+            kinds = {self.classify(expr.left), self.classify(expr.right)}
+            if RAW in kinds:
+                return RAW
+            if kinds <= {BOUNDED}:
+                return BOUNDED
+            return UNKNOWN
+        if isinstance(expr, ast.UnaryOp):
+            return self.classify(expr.operand)
+        if isinstance(expr, ast.IfExp):
+            kinds = {self.classify(expr.body), self.classify(expr.orelse)}
+            if RAW in kinds:
+                return RAW
+            if kinds <= {BOUNDED}:
+                return BOUNDED
+            return UNKNOWN
+        if isinstance(expr, (ast.Compare, ast.BoolOp)):
+            return BOUNDED  # bools: a two-value domain
+        if isinstance(expr, ast.Subscript):
+            # x.shape[i] is the shape of an already-bucketed value
+            if (
+                isinstance(expr.value, ast.Attribute)
+                and expr.value.attr == "shape"
+            ):
+                return BOUNDED
+            return UNKNOWN
+        return UNKNOWN
+
+
+def _np_constructor(expr: ast.AST) -> ast.Call | None:
+    if not isinstance(expr, ast.Call):
+        return None
+    d = dotted(expr.func)
+    if (
+        d is not None
+        and "." in d
+        and d.split(".", 1)[0] in ("np", "numpy")
+        and d.rsplit(".", 1)[1] in _NP_CONSTRUCTORS
+    ):
+        return expr
+    return None
+
+
+def _trailing_dim(ctor: ast.Call) -> ast.AST | None:
+    """The last element of a multi-dim shape tuple, or None for 1-D
+    constructions (the leading/batch axis is the callee's to bucket)."""
+    if not ctor.args:
+        return None
+    shape = ctor.args[0]
+    if isinstance(shape, (ast.Tuple, ast.List)) and len(shape.elts) >= 2:
+        return shape.elts[-1]
+    return None
+
+
+def check_recompile_hazard(program: Program) -> list[Finding]:
+    entries = collect_jit_entries(program)
+    findings: list[Finding] = []
+    for fn in program.functions:
+        consts = _module_consts(fn.module.tree)
+        flow = _SizeFlow(fn, consts)
+        findings.extend(_check_call_sites(fn, flow, entries))
+    for module in program.modules:
+        findings.extend(_check_per_call_jit(module))
+    return findings
+
+
+def _check_call_sites(
+    fn: FunctionInfo,
+    flow: _SizeFlow,
+    entries: dict[str, list[_JitEntry]],
+) -> list[Finding]:
+    module = fn.module
+    out: list[Finding] = []
+    for node in own_body_walk(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        name = None
+        is_attr_call = isinstance(node.func, ast.Attribute)
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+        elif is_attr_call:
+            name = node.func.attr
+        if name is None:
+            continue
+        for entry in entries.get(name, ()):
+            if entry.fn is fn:
+                continue  # a jitted wrapper calling itself recursively
+            params = entry.params
+            offset = 1 if is_attr_call and params[:1] == ["self"] else 0
+            for i, arg in enumerate(node.args):
+                pi = i + offset
+                if pi < len(params) and params[pi] in entry.statics:
+                    out.extend(
+                        _static_finding(module, fn, node, params[pi], arg, flow)
+                    )
+            for kw in node.keywords:
+                if kw.arg in entry.statics:
+                    out.extend(
+                        _static_finding(module, fn, node, kw.arg, kw.value, flow)
+                    )
+        if name in entries or name in DISPATCH_ENTRIES:
+            out.extend(_shape_findings(module, fn, node, name, flow))
+    return out
+
+
+def _static_finding(module, fn, call, param, value, flow) -> list[Finding]:
+    if flow.classify(value) != RAW:
+        return []
+    return [
+        module.finding(
+            RULE,
+            call,
+            f"unbounded static: `{param}` at this jit call site in "
+            f"`{fn.name}` is a data-dependent size (len()/arithmetic) — "
+            "every distinct value compiles a fresh program",
+            hint="statics must come from bounded domains (constants, "
+            "config fields, enum-like module constants) or through the "
+            "bucket helpers (`bucket_length`/`_pow2`) so the dispatch "
+            "cache stays finite",
+        )
+    ]
+
+
+def _shape_findings(module, fn, call, callee, flow) -> list[Finding]:
+    out: list[Finding] = []
+    for arg in [*call.args, *[kw.value for kw in call.keywords]]:
+        ctor = _np_constructor(arg)
+        if ctor is None and isinstance(arg, ast.Name):
+            ctor = flow.constructed.get(arg.id)
+        if ctor is None:
+            continue
+        dim = _trailing_dim(ctor)
+        if dim is not None and flow.classify(dim) == RAW:
+            out.append(
+                module.finding(
+                    RULE,
+                    call,
+                    f"unbucketed trailing dimension: a buffer passed to "
+                    f"`{callee}` from `{fn.name}` has a data-dependent "
+                    "trailing axis — the program recompiles per distinct "
+                    "size",
+                    hint="round trailing axes through `bucket_length`/"
+                    "`_pow2` before building the buffer; only the "
+                    "LEADING batch axis is re-bucketed by the callee",
+                )
+            )
+    return out
+
+
+def _check_per_call_jit(module) -> list[Finding]:
+    """`jax.jit(...)` evaluated inside a function body (including a
+    nested def's decorator): a fresh callable — and a fresh empty
+    dispatch cache — per enclosing call. `__init__` is the sanctioned
+    cache-per-instance site."""
+    out: list[Finding] = []
+
+    def wraps_jit(call: ast.Call) -> bool:
+        if _is_jax_jit(call.func):
+            return True
+        d = dotted(call.func)
+        if d in ("partial", "functools.partial"):
+            return bool(call.args) and _is_jax_jit(call.args[0])
+        return False
+
+    def scan_expr(expr: ast.AST, inside: str) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and wraps_jit(node):
+                out.append(_per_call_finding(module, node, inside))
+
+    def visit(body, inside: str | None):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if inside not in (None, "__init__"):
+                    # a jitted nested def: the decorator runs per
+                    # enclosing call
+                    for deco in stmt.decorator_list:
+                        if _is_jax_jit(deco) or (
+                            isinstance(deco, ast.Call) and wraps_jit(deco)
+                        ):
+                            out.append(
+                                _per_call_finding(module, deco, inside)
+                            )
+                visit(stmt.body, stmt.name)
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                visit(stmt.body, inside)
+                continue
+            if inside not in (None, "__init__"):
+                for _f, value in ast.iter_fields(stmt):
+                    if isinstance(value, ast.AST):
+                        scan_expr(value, inside)
+            for _f, value in ast.iter_fields(stmt):
+                if isinstance(value, list) and value:
+                    if isinstance(value[0], ast.stmt):
+                        visit(value, inside)
+                    elif isinstance(value[0], ast.excepthandler):
+                        for h in value:
+                            visit(h.body, inside)
+                    elif hasattr(value[0], "body") and isinstance(
+                        getattr(value[0], "body", None), list
+                    ):  # match cases
+                        for case in value:
+                            visit(case.body, inside)
+
+    visit(module.tree.body, None)
+    return sorted(set(out), key=Finding.sort_key)
+
+
+def _per_call_finding(module, node, fn_name: str) -> Finding:
+    return module.finding(
+        RULE,
+        node,
+        f"per-call `jax.jit` inside `{fn_name}`: every call builds a new "
+        "callable with an empty dispatch cache — a recompile per "
+        "invocation",
+        hint="hoist the jit to module scope (decorator) or cache the "
+        "wrapper once in `__init__`",
+    )

@@ -1,0 +1,609 @@
+"""status-machine: the doc status transition graph, pinned as an artifact.
+
+The exactly-once ledger every chaos/sweep test asserts DYNAMICALLY
+(zero lost, zero duplicated verdicts) rests on a static invariant
+nobody had written down: every `.status` write in the worker/store path
+is one of a small set of legal transitions, and every claim path ends
+— even through its exception edges — at a terminal write or a release
+back to claimable. This module extracts that machine from the code the
+way `lock_order.py` extracts the lock graph, and commits it:
+
+  * the STATUS REGISTRY comes from `jobs/models.py` (the ``STATUS_*``
+    constants plus the ``TERMINAL_STATUSES`` / ``INPROGRESS_STATUSES``
+    / ``CLAIMABLE_STATUSES`` classification sets — byte-compatible
+    with the reference service's converter.go, so the registry IS the
+    wire contract);
+  * the LEGAL TRANSITIONS derive from the classification sets: every
+    claimable status may move to ``preprocess_inprogress`` (the claim
+    CAS, stuck takeover included), and an in-progress status may move
+    to any terminal status (judged / failed / aborted) or to
+    ``preprocess_completed`` (the release — REASON_* sentinels in
+    `chaos/degrade.py` stamp WHY, the status write itself is always
+    the same re-claimable state);
+  * every WRITE SITE (``<recv>.status = <expr>`` in the jobs/ modules
+    and `chaos/degrade.py`) is recorded with its guard-derived
+    from-set: a write dominated by an ``x.status in (A, B)`` test
+    contributes the edges ``A -> to`` and ``B -> to``; an unguarded
+    write must target a status that is a legal transition TARGET;
+  * the whole machine is COMMITTED as ``analysis_statusgraph.json``
+    with the same drift gate as the lock graph (`make statusgraph`
+    regenerates; a stale artifact is a finding), so a new status,
+    write site, or transition is a reviewable diff;
+  * findings: a write of a raw string literal (drift the registry
+    cannot see), a DYNAMIC write (computed status values defeat the
+    whole analysis), a write outside the legal transition set, and —
+    the static form of exactly-once — a CLAIM PATH whose exception
+    edges reach neither a terminal write nor a release: a function
+    that (transitively) claims and settles must either contain a
+    ``try`` whose handler/finally settles (the `_sweep_sliced` shape:
+    ``finally: _release_docs(rest, REASON_ABORT, ...)``) or delegate
+    the claim-to-settle span to a callee that does (the `_tick` →
+    `_run_slow_chunks` shape).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+
+from foremast_tpu.analysis.core import Finding
+from foremast_tpu.analysis.interproc import (
+    FunctionInfo,
+    Program,
+    own_body_walk,
+)
+
+RULE = "status-machine"
+GRAPH_NAME = "analysis_statusgraph.json"
+GRAPH_VERSION = 1
+
+# modules whose `.status` writes are DOC status writes (mesh membership
+# and chaos-plan objects have their own unrelated status fields)
+WRITE_SCOPE = ("foremast_tpu/jobs/", "foremast_tpu/chaos/degrade.py")
+REGISTRY_SETS = (
+    "TERMINAL_STATUSES",
+    "INPROGRESS_STATUSES",
+    "CLAIMABLE_STATUSES",
+)
+
+
+class StatusRegistry:
+    """STATUS_* constants + classification sets, parsed from the module
+    that defines ``TERMINAL_STATUSES`` (jobs/models.py in the real
+    tree, a fixture module in tests)."""
+
+    def __init__(self, names: dict[str, str], sets: dict[str, set[str]]):
+        self.names = names              # STATUS_X -> "value"
+        self.values = set(names.values())
+        self.terminal = sets.get("TERMINAL_STATUSES", set())
+        self.inprogress = sets.get("INPROGRESS_STATUSES", set())
+        self.claimable = sets.get("CLAIMABLE_STATUSES", set())
+
+    @classmethod
+    def from_program(cls, program: Program) -> "StatusRegistry | None":
+        for module in program.modules:
+            names: dict[str, str] = {}
+            set_nodes: dict[str, ast.AST] = {}
+            for stmt in module.tree.body:
+                if not (
+                    isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                ):
+                    continue
+                t = stmt.targets[0]
+                if not isinstance(t, ast.Name):
+                    continue
+                if (
+                    t.id.startswith("STATUS_")
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)
+                ):
+                    names[t.id] = stmt.value.value
+                elif t.id in REGISTRY_SETS:
+                    set_nodes[t.id] = stmt.value
+            if "TERMINAL_STATUSES" not in set_nodes:
+                continue
+            sets = {
+                key: _resolve_status_set(node, names, sets_so_far={})
+                for key, node in set_nodes.items()
+            }
+            # second pass for starred references between the sets
+            # (CLAIMABLE = (INITIAL, COMPLETED, *INPROGRESS))
+            sets = {
+                key: _resolve_status_set(node, names, sets_so_far=sets)
+                for key, node in set_nodes.items()
+            }
+            return cls(names, sets)
+        return None
+
+    def legal_transitions(self) -> list[dict]:
+        """Edges derived from the classification sets (see module
+        docstring): claim edges + judge/fail/abort edges + release."""
+        edges: list[dict] = []
+        inprog = "preprocess_inprogress"
+        for s in sorted(self.claimable):
+            edges.append({"from": s, "to": inprog, "via": "claim"})
+        for s in sorted(self.inprogress):
+            for t in sorted(self.terminal):
+                edges.append({"from": s, "to": t, "via": "judge"})
+            edges.append(
+                {"from": s, "to": "preprocess_completed", "via": "release"}
+            )
+        return edges
+
+    def legal_pairs(self) -> set[tuple[str, str]]:
+        return {(e["from"], e["to"]) for e in self.legal_transitions()}
+
+
+def _resolve_status_set(
+    node: ast.AST, names: dict[str, str], sets_so_far: dict
+) -> set[str]:
+    """frozenset({...}) / tuple / set / list of STATUS_* names, string
+    constants, and `*OTHER_SET` splices."""
+    if isinstance(node, ast.Call) and node.args:
+        return _resolve_status_set(node.args[0], names, sets_so_far)
+    out: set[str] = set()
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Starred) and isinstance(
+                elt.value, ast.Name
+            ):
+                out |= sets_so_far.get(elt.value.id, set())
+            elif isinstance(elt, ast.Name) and elt.id in names:
+                out.add(names[elt.id])
+            elif isinstance(elt, ast.Constant) and isinstance(
+                elt.value, str
+            ):
+                out.add(elt.value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# write-site extraction
+# ---------------------------------------------------------------------------
+
+
+def _status_value(expr: ast.AST, registry: StatusRegistry) -> list[str] | None:
+    """The status value(s) an assignment RHS denotes: a STATUS_* name,
+    a raw string, or a conditional over those. None = dynamic."""
+    if isinstance(expr, ast.Name) and expr.id in registry.names:
+        return [registry.names[expr.id]]
+    if isinstance(expr, ast.Attribute) and expr.attr in registry.names:
+        return [registry.names[expr.attr]]  # models.STATUS_X
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [expr.value]
+    if isinstance(expr, ast.IfExp):
+        a = _status_value(expr.body, registry)
+        b = _status_value(expr.orelse, registry)
+        if a is not None and b is not None:
+            return a + b
+    return None
+
+
+def _guard_statuses(
+    test: ast.AST, registry: StatusRegistry
+) -> list[str] | None:
+    """`x.status in (A, B)` -> [a, b]; `x.status == A` -> [a]."""
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1):
+        return None
+    left = test.left
+    if not (isinstance(left, ast.Attribute) and left.attr == "status"):
+        return None
+    comp = test.comparators[0]
+    if isinstance(test.ops[0], ast.In):
+        vals = _resolve_status_set(comp, registry.names, {})
+        return sorted(vals) if vals else None
+    if isinstance(test.ops[0], ast.Eq):
+        v = _status_value(comp, registry)
+        return v
+    return None
+
+
+def collect_writes(program: Program, registry: StatusRegistry) -> list[dict]:
+    """Every `.status = <expr>` write in scope, with its site, target
+    value(s) ("?" = dynamic, the finding pass flags it) and guard-
+    derived from-set (["*"] = unguarded)."""
+    writes: list[dict] = []
+    for fn in program.functions:
+        if not fn.module.relpath.startswith(WRITE_SCOPE):
+            continue
+        _walk_writes(fn, fn.node.body, ["*"], registry, writes)
+    writes.sort(key=lambda w: (w["site"], w["status"]))
+    return writes
+
+
+def _walk_writes(
+    fn: FunctionInfo, body, fromset: list[str], registry, writes
+) -> None:
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "status":
+                    vals = _status_value(stmt.value, registry)
+                    for v in vals if vals is not None else ["?"]:
+                        writes.append(
+                            {
+                                "site": fn.site(stmt),
+                                "fn": fn.qualname,
+                                "status": v,
+                                "from": list(fromset),
+                            }
+                        )
+        inner = fromset
+        if isinstance(stmt, (ast.If, ast.While)):
+            guard = _guard_statuses(stmt.test, registry)
+            if guard is not None:
+                inner = guard
+        for field, value in ast.iter_fields(stmt):
+            if not (isinstance(value, list) and value):
+                continue
+            if isinstance(value[0], ast.stmt):
+                # the guard narrows only the THEN branch
+                scope = inner if field == "body" else fromset
+                _walk_writes(fn, value, scope, registry, writes)
+            elif isinstance(value[0], ast.excepthandler):
+                for h in value:
+                    _walk_writes(fn, h.body, fromset, registry, writes)
+            elif hasattr(value[0], "body") and isinstance(
+                getattr(value[0], "body", None), list
+            ):
+                for case in value:
+                    _walk_writes(fn, case.body, fromset, registry, writes)
+
+
+# ---------------------------------------------------------------------------
+# claim-path protection (the exactly-once exception edge)
+# ---------------------------------------------------------------------------
+
+
+class _ClaimAnalysis:
+    """Fixpoint summaries: which functions (transitively) CLAIM and
+    which (transitively) SETTLE (write a terminal status or release to
+    preprocess_completed)."""
+
+    def __init__(self, program: Program, registry: StatusRegistry):
+        self.program = program
+        self.registry = registry
+        self.claims: set[int] = set()
+        self.settles: set[int] = set()
+        self._settle_values = registry.terminal | {"preprocess_completed"}
+        self._compute()
+
+    def _writes_settle(self, fn: FunctionInfo) -> bool:
+        for node in own_body_walk(fn.node):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and t.attr == "status":
+                        vals = _status_value(node.value, self.registry)
+                        if vals and set(vals) & self._settle_values:
+                            return True
+        return False
+
+    @staticmethod
+    def _calls_claim(fn: FunctionInfo) -> bool:
+        for node in own_body_walk(fn.node):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "claim"
+            ):
+                return True
+        return False
+
+    def _compute(self) -> None:
+        for fn in self.program.functions:
+            if self._calls_claim(fn):
+                self.claims.add(id(fn))
+            if self._writes_settle(fn):
+                self.settles.add(id(fn))
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.program.functions:
+                for node in own_body_walk(fn.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    for callee in self.program.resolve_call_direct(node, fn):
+                        if id(callee) in self.claims and id(fn) not in self.claims:
+                            self.claims.add(id(fn))
+                            changed = True
+                        if (
+                            id(callee) in self.settles
+                            and id(fn) not in self.settles
+                        ):
+                            self.settles.add(id(fn))
+                            changed = True
+
+    def _try_protected(self, fn: FunctionInfo) -> bool:
+        """A `try` in `fn` whose finally/handler (transitively)
+        settles."""
+
+        def body_settles(body) -> bool:
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Assign):
+                        for t in node.targets:
+                            if (
+                                isinstance(t, ast.Attribute)
+                                and t.attr == "status"
+                            ):
+                                vals = _status_value(
+                                    node.value, self.registry
+                                )
+                                if vals and set(vals) & self._settle_values:
+                                    return True
+                    elif isinstance(node, ast.Call):
+                        for callee in self.program.resolve_call_direct(node, fn):
+                            if id(callee) in self.settles:
+                                return True
+            return False
+
+        for node in own_body_walk(fn.node):
+            if not isinstance(node, ast.Try):
+                continue
+            if node.finalbody and body_settles(node.finalbody):
+                return True
+            for h in node.handlers:
+                if body_settles(h.body):
+                    return True
+        return False
+
+    def _owns_span(self, fn: FunctionInfo) -> bool:
+        """True when `fn` is the frame where a BARE claim meets the
+        settle obligation: it settles (transitively) and either calls
+        `.claim` itself or calls a callee that claims without settling.
+        A frame whose claiming callees all settle too merely wraps a
+        lower owner — reporting every frame of the call cone (or of a
+        tick/preemption cycle, where each member trivially inherits
+        claims AND settles from the next) would turn one contract gap
+        into a dozen findings at frames that cannot fix it."""
+        if id(fn) not in self.settles:
+            return False
+        if self._calls_claim(fn):
+            return True
+        for node in own_body_walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            for c in self.program.resolve_call_direct(node, fn):
+                if id(c) in self.claims and id(c) not in self.settles:
+                    return True
+        return False
+
+    def unprotected_owners(self) -> list[FunctionInfo]:
+        """Span owners (see `_owns_span`) with no protected exception
+        edge: no settling try of their own and no call into a
+        compliant callee to delegate the span to."""
+        compliant: set[int] = set()
+        for fn in self.program.functions:
+            if id(fn) in self.settles and self._try_protected(fn):
+                compliant.add(id(fn))
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.program.functions:
+                if id(fn) in compliant:
+                    continue
+                for node in own_body_walk(fn.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if any(
+                        id(c) in compliant
+                        for c in self.program.resolve_call_direct(node, fn)
+                    ):
+                        compliant.add(id(fn))
+                        changed = True
+                        break
+        return [
+            fn
+            for fn in self.program.functions
+            if self._owns_span(fn)
+            and id(fn) not in compliant
+            and fn.module.relpath.startswith(WRITE_SCOPE)
+        ]
+
+
+# ---------------------------------------------------------------------------
+# the artifact + the gate
+# ---------------------------------------------------------------------------
+
+
+def build_graph(program: Program) -> dict | None:
+    registry = StatusRegistry.from_program(program)
+    if registry is None:
+        return None
+    return {
+        "version": GRAPH_VERSION,
+        "comment": (
+            "Doc status transition graph (rule: status-machine). "
+            "`statuses` is the jobs/models.py registry with its "
+            "classification flags; `transitions` is the legal edge set "
+            "derived from it (claim/judge/release); `writes` is every "
+            "`.status =` site in the worker/store/degrade path with its "
+            "guard-derived from-set. Regenerate with `make statusgraph`; "
+            "the default run fails when this drifts from the computed "
+            "graph. docs/static-analysis.md"
+        ),
+        "statuses": [
+            {
+                "name": name,
+                "value": value,
+                "terminal": value in registry.terminal,
+                "claimable": value in registry.claimable,
+                "inprogress": value in registry.inprogress,
+            }
+            for name, value in sorted(registry.names.items())
+        ],
+        "transitions": registry.legal_transitions(),
+        "writes": collect_writes(program, registry),
+    }
+
+
+def graph_path(root: str) -> str:
+    return os.path.join(root, GRAPH_NAME)
+
+
+def write_graph(root: str, graph: dict) -> None:
+    with open(graph_path(root), "w", encoding="utf-8") as f:
+        json.dump(graph, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def load_graph(root: str) -> dict | None:
+    path = graph_path(root)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _normalize(graph: dict) -> tuple:
+    return (
+        graph.get("version"),
+        tuple(
+            (s["name"], s["value"], s["terminal"], s["claimable"],
+             s["inprogress"])
+            for s in sorted(
+                graph.get("statuses", ()), key=lambda s: s["name"]
+            )
+        ),
+        tuple(
+            (e["from"], e["to"], e["via"])
+            for e in sorted(
+                graph.get("transitions", ()),
+                key=lambda e: (e["from"], e["to"], e["via"]),
+            )
+        ),
+        tuple(
+            (w["site"], w["fn"], w["status"], tuple(w["from"]))
+            for w in sorted(
+                graph.get("writes", ()),
+                key=lambda w: (w["site"], w["status"]),
+            )
+        ),
+    )
+
+
+def check_status_machine(root: str, program: Program) -> list[Finding]:
+    registry = StatusRegistry.from_program(program)
+    if registry is None:
+        return []  # no status registry in scope (path-scoped fixture run)
+    findings: list[Finding] = []
+    legal = registry.legal_pairs()
+    legal_targets = {to for _f, to in legal}
+
+    for w in collect_writes(program, registry):
+        path, _, line = w["site"].partition(":")
+        line = int(line or 1)
+        if w["status"] == "?":
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=path,
+                    line=line,
+                    message=f"dynamic status write in `{w['fn']}` — a "
+                    "computed status value defeats the transition-graph "
+                    "analysis (and the exactly-once ledger it encodes)",
+                    hint="assign one of the STATUS_* constants (branch on "
+                    "the condition, not on the value)",
+                )
+            )
+            continue
+        if w["status"] not in registry.values:
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=path,
+                    line=line,
+                    message=f"unknown status `{w['status']}` written in "
+                    f"`{w['fn']}` — not in the jobs/models.py registry "
+                    "(the wire contract with the reference service)",
+                    hint="use a STATUS_* constant; new statuses must be "
+                    "added to models.py and `make statusgraph` re-run",
+                )
+            )
+            continue
+        _check_write_legality(w, registry, legal, legal_targets, findings,
+                              path, line)
+
+    for fn in _ClaimAnalysis(program, registry).unprotected_owners():
+        findings.append(
+            Finding(
+                rule=RULE,
+                path=fn.module.relpath,
+                line=fn.node.lineno,
+                message=f"claim path `{fn.qualname}` has an exception edge "
+                "that reaches neither a terminal status write nor a "
+                "release — a crash mid-path strands claimed docs until "
+                "the stuck-takeover window",
+                hint="wrap the claim-to-settle span in try/finally (or an "
+                "except edge) that releases unjudged docs "
+                "(`_release_docs` -> preprocess_completed), or delegate "
+                "to a helper that does — the `_sweep_sliced` shape",
+            )
+        )
+
+    findings.extend(_artifact_findings(root, program))
+    return findings
+
+
+def _check_write_legality(
+    w, registry, legal, legal_targets, findings, path, line
+) -> None:
+    if w["status"] == "initial":
+        # doc (re)creation — constructors set it, `.status =` never
+        # legally does: nothing transitions BACK to fresh work
+        froms = ["(any)"]
+    elif w["from"] == ["*"]:
+        froms = [] if w["status"] in legal_targets else ["(unguarded)"]
+    else:
+        froms = [s for s in w["from"] if (s, w["status"]) not in legal]
+    if froms:
+        findings.append(
+            Finding(
+                rule=RULE,
+                path=path,
+                line=line,
+                message=f"illegal status transition {froms} -> "
+                f"`{w['status']}` written in `{w['fn']}` — outside the "
+                "legal set (claim -> in-progress -> judged/released/"
+                "terminal)",
+                hint="see `transitions` in analysis_statusgraph.json; if "
+                "the machine legitimately grew, change jobs/models.py's "
+                "classification sets and re-run `make statusgraph`",
+            )
+        )
+
+
+def _artifact_findings(root: str, program: Program) -> list[Finding]:
+    computed = build_graph(program)
+    if computed is None:
+        return []
+    committed = load_graph(root)
+    if committed is None:
+        return [
+            Finding(
+                rule=RULE,
+                path=GRAPH_NAME,
+                line=1,
+                message=f"{GRAPH_NAME} missing — the status transition "
+                "graph must be committed so state-machine changes are "
+                "reviewable diffs",
+                hint="run `make statusgraph` and commit the artifact",
+            )
+        ]
+    if _normalize(committed) != _normalize(computed):
+        return [
+            Finding(
+                rule=RULE,
+                path=GRAPH_NAME,
+                line=1,
+                message=f"committed {GRAPH_NAME} is stale vs the computed "
+                "status graph (statuses, transitions or write sites "
+                "changed)",
+                hint="run `make statusgraph`, review the diff, and commit "
+                "it",
+            )
+        ]
+    return []

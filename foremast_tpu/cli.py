@@ -377,6 +377,15 @@ def cmd_worker(args: argparse.Namespace) -> int:
     from foremast_tpu.analysis.witness import install_from_env
 
     install_from_env()
+    # FOREMAST_RECOMPILE_WITNESS=1: count actual XLA backend compiles
+    # for the life of the worker and log the total at exit — the
+    # zero-warm-recompile contract's runtime witness (installed before
+    # the first dispatch so the cold compiles are attributed too)
+    from foremast_tpu.analysis.recompile_witness import (
+        install_from_env as install_recompile_witness,
+    )
+
+    install_recompile_witness()
 
     from foremast_tpu import native
     from foremast_tpu.config import BrainConfig

@@ -1006,6 +1006,17 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "(docs/static-analysis.md)",
     ),
     EnvKnob(
+        "FOREMAST_RECOMPILE_WITNESS",
+        None,
+        "bool",
+        "`1` counts actual XLA backend compiles via `jax.monitoring` "
+        "and logs the total at exit — a warm fleet whose count keeps "
+        "growing has a dispatch cache-key leak; the benches use the "
+        "same witness to assert zero warm-phase recompiles in-run "
+        "(the static recompile-hazard rule's runtime twin, "
+        "docs/static-analysis.md)",
+    ),
+    EnvKnob(
         "FOREMAST_SERVICE_ENDPOINT",
         "http://localhost:8099",
         "str",

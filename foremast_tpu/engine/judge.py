@@ -1073,6 +1073,10 @@ class HealthJudge:
         ):
             return self._decode_bucket(tasks, res, tc)
 
+    # The object path's designated gather stage: one overlapped
+    # device_get of the whole result tuple, then pure-host verdict
+    # construction.
+    # foremast: device-boundary
     def _decode_bucket(
         self, tasks: list[MetricTask], res, tc: int
     ) -> list[MetricVerdict]:

@@ -188,6 +188,10 @@ def _pack(rows: list[np.ndarray], length: int) -> tuple[jnp.ndarray, jnp.ndarray
     return jnp.asarray(out), jnp.asarray(mask)
 
 
+# Checkpoint-blob coercion: rebuilds device params (jnp) and host MVN
+# arrays from whatever layout Orbax restored; the H2D uploads and scalar
+# reads here are the rehydration contract.
+# foremast: device-boundary
 def _coerce_entry(entry) -> tuple:
     """Normalize a cache entry to (AEParams, float, float, mvn | None).
 
@@ -419,6 +423,9 @@ class MultivariateJudge:
         ct, cv = _align(job_tasks, "cur")
         return _JointJob(job_tasks, ht, hv, ct, cv)
 
+    # Pairwise decode stage: gathers the jitted rank-test program's
+    # (p, differs) result for host emission.
+    # foremast: device-boundary
     def _pairwise(
         self, joints: list[_JointJob]
     ) -> list[tuple[np.ndarray, np.ndarray]]:
@@ -555,6 +562,10 @@ class MultivariateJudge:
 
     # -- bivariate -------------------------------------------------------
 
+    # Slow-path bivariate stage: fit + dispatch + gather + verdict
+    # decode in one body (cold-fit latency regime; the warm path is
+    # joint_columnar).
+    # foremast: device-boundary
     def _judge_bivariate(self, jobs: list[list[MetricTask]]) -> list[MetricVerdict]:
         threshold = self.config.anomaly.rule_for(None).threshold
         min_pts = self.config.min_historical_points
@@ -661,6 +672,10 @@ class MultivariateJudge:
             )
         return out
 
+    # Slow-path LSTM/MVN group stage: fit + dispatch + gather + verdict
+    # decode in one body (cold-fit latency regime; the warm path is
+    # joint_columnar).
+    # foremast: device-boundary
     def _judge_lstm_group(
         self,
         joints: list[_JointJob],
@@ -884,6 +899,9 @@ class MultivariateJudge:
             )
         return out
 
+    # Cold MVN fit stage: uploads aligned histories, runs the jitted
+    # fit, gathers the state tuple to host numpy for the cache entry.
+    # foremast: device-boundary
     def _fit_mvn_batch(
         self,
         need: list[_JointJob],
@@ -1193,6 +1211,10 @@ class MultivariateJudge:
             "valid": np.bool_(mvn[6]),
         }
 
+    # The warm joint gather stage: arrays in, jitted from-rows programs
+    # dispatched, flags gathered to host numpy out (the joint counterpart
+    # of the worker's _decode_uni).
+    # foremast: device-boundary
     def joint_columnar(
         self,
         mode: str,

@@ -2225,6 +2225,10 @@ class BrainWorker:
             base_mask=packed.base_m,
         )
 
+    # The uni fast path's designated decode stage: consumes the gathered
+    # columnar result tuple; everything it hands on (verdicts, decided
+    # docs) is host.
+    # foremast: device-boundary
     def _decode_uni(self, packed: "_UniPacked", res, now: float) -> list:
         """The decode half (any single consumer thread — the sliced
         sweep runs it on the writer after `ColumnarPending.wait()`):
@@ -2929,6 +2933,16 @@ class BrainWorker:
                 self._requeue_pending(led)
                 return []
 
+    # An unexpected exception mid-judgment deliberately leaves this
+    # cycle's claims to the stuck-claim takeover (the window is a
+    # first-class claim parameter — `store.claim(..., max_stuck_seconds,
+    # ...)` in _claim_cycle). A blanket release edge here would be WRONG:
+    # it could reset docs whose terminal status the chunk pipeline's
+    # writer already persisted, breaking the exactly-once ledger. The
+    # detectable failures all have protected edges already (claim
+    # brownout -> empty cycle, deadline -> _release_docs, pipeline abort
+    # -> _abort_slice, judge error -> _judge_chunk's failure write).
+    # foremast: ignore[status-machine]
     def _tick(self, now: float | None = None, micro=None) -> int:
         t0 = time.perf_counter()
         self._tick_deadline = self._degrade.deadline(t0)

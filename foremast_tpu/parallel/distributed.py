@@ -317,6 +317,10 @@ class PodWorker(BrainWorker):
     peers use the arena) and deadlock the collectives.
     """
 
+    # Knob-level arena interaction only (budget read on the leader,
+    # identical set on every host) — honors the replicated placement,
+    # no row access involved.
+    # foremast: replicated-arena
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         from foremast_tpu.engine.arena import (

@@ -181,6 +181,9 @@ def assert_partitioned(arr, n_data: int) -> None:
         )
     shards = arr.addressable_shards
     want = rows // n_data
+    # Iterates addressable_shards and reads shard SHAPES only ("no
+    # data read" is this assert's contract).
+    # foremast: ignore[device-flow]
     got = sorted(s.data.shape[0] for s in shards)
     n_local = len(shards)
     if any(g != want for g in got):
