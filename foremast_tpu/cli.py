@@ -344,7 +344,15 @@ def _mount_ingest(
     if gauge_port:
         from prometheus_client import REGISTRY
 
-        REGISTRY.register(IngestCollector(ring, book=source.book))
+        REGISTRY.register(
+            IngestCollector(
+                ring,
+                book=source.book,
+                # per-codec stage breakdown, live only when a receiver
+                # is (ISSUE 18: the wire families come from the wire)
+                wire=getattr(srv, "_foremast_wire_stats", None),
+            )
+        )
     return source, ring, srv, snapshotter
 
 

@@ -822,6 +822,27 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "`0` disables shedding",
     ),
     EnvKnob(
+        "FOREMAST_INGEST_DECODE_WORKERS",
+        "4",
+        "int",
+        "pooled decode worker threads on the ingest receiver "
+        "(docs/wire-protocol.md): handler threads do socket I/O only "
+        "while decompress/decode/apply run on this many pool threads, "
+        "bounding decode CPU however many pusher connections pile up; "
+        "a full decode queue sheds 429. `0` decodes inline on the "
+        "handler thread",
+    ),
+    EnvKnob(
+        "FOREMAST_INGEST_MAX_DECODED_BYTES",
+        "33554432",
+        "int",
+        "decoded-size ceiling for the binary wire path (default "
+        "32 MiB): the DECLARED size in the snappy preamble / FMW1 "
+        "frame header past it answers 413 before the body is read or "
+        "decompressed — the snappy-bomb mirror of "
+        "FOREMAST_INGEST_MAX_BODY_BYTES's no-buffering contract",
+    ),
+    EnvKnob(
         "FOREMAST_ES_CONNECT_DEADLINE_SECONDS",
         "0",
         "float",

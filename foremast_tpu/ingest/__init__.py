@@ -9,7 +9,8 @@ columnar gather — the same shape as serving an inference stack from a
 resident feature store instead of a remote database.
 
 Modules:
-    wire      push payload codec + query_range key resolution
+    wire      push payload codecs (JSON compat + FMW1 binary columnar
+              frame + pure-python snappy) + query_range key resolution
     ring      per-series pow2 (int64, float32) ring buffers
     shards    sharded, byte-budgeted, LRU-evicting RingStore
     backfill  cold-miss subscriptions + fallback-result backfill
@@ -25,6 +26,7 @@ and upgrades").
 from foremast_tpu.ingest.backfill import SubscriptionBook, backfill
 from foremast_tpu.ingest.receiver import (
     IngestCollector,
+    WireStats,
     start_ingest_server,
     stop_ingest_server,
 )
@@ -37,13 +39,20 @@ from foremast_tpu.ingest.snapshot import (
 )
 from foremast_tpu.ingest.source import RingSource
 from foremast_tpu.ingest.wire import (
+    BINARY_CONTENT_TYPE,
+    WireError,
     canonical_series,
+    decode_frame,
+    encode_frame,
     parse_push,
     resolve_query_range,
     series_key,
+    snappy_compress,
+    snappy_decompress,
 )
 
 __all__ = [
+    "BINARY_CONTENT_TYPE",
     "IngestCollector",
     "RingShard",
     "RingSnapshotter",
@@ -52,12 +61,18 @@ __all__ = [
     "SeriesRing",
     "SnapshotCollector",
     "SubscriptionBook",
+    "WireError",
+    "WireStats",
     "backfill",
     "canonical_series",
+    "decode_frame",
+    "encode_frame",
     "lock_snapshot_dir",
     "parse_push",
     "resolve_query_range",
     "series_key",
+    "snappy_compress",
+    "snappy_decompress",
     "start_ingest_server",
     "stop_ingest_server",
 ]

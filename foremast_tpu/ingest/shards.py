@@ -100,32 +100,59 @@ class RingShard:
         receiver's handler threads. The cost is a page-cache write +
         flush inside the lock hold — microseconds, and only when
         durability is mounted."""
+        return self.push_many(
+            [(key, times, values, start, end)], slack=slack, journal=journal
+        )[0]
+
+    def push_many(
+        self,
+        items: list[tuple[str, np.ndarray, np.ndarray, float | None, float | None]],
+        slack: float = 0.0,
+        journal=None,
+    ) -> list[int]:
+        """Striped batch append (ISSUE 18): apply every ``(key, times,
+        values, start, end)`` in ``items`` under ONE lock acquisition
+        instead of one per series — the wire decode path groups a whole
+        frame by shard and lands each group in a single hold (`push` is
+        the one-item case). Returns per-item accepted counts, aligned
+        with ``items``. Budget eviction runs per item (identical
+        semantics to N push() calls); the journal hook fires per item
+        IN APPLY ORDER, still under the lock (the PR-7 replay-order
+        contract is per-apply, not per-acquisition)."""
+        out = []
         with self._lock:
-            ring = self._series.get(key)
-            prev = 0
-            if ring is None:
-                ring = SeriesRing(max_points=self.max_points)
-                self._series[key] = ring
-            else:
-                prev = ring.nbytes
-            n = ring.append(times, values, start=start, end=end, slack=slack)
-            self._bytes += ring.nbytes - prev
-            self._series.move_to_end(key)
-            self._counts["samples"] += n
-            while self._bytes > self.budget_bytes and len(self._series) > 1:
-                _, old = self._series.popitem(last=False)
-                self._bytes -= old.nbytes
-                self._counts["evictions"] += 1
-            if journal is not None and (
-                n or start is not None or end is not None
-            ):
-                # empty backfills still carry an authority claim worth
-                # persisting; pure no-op pushes do not. DELIBERATELY
-                # under the shard lock (PR-7 replay-order contract, see
-                # the docstring above): journaling outside it let two
-                # racing same-timestamp revisions restore stale.
-                journal(key, times, values, start, end)  # foremast: ignore[blocking-under-lock]
-            return n
+            for key, times, values, start, end in items:
+                ring = self._series.get(key)
+                prev = 0
+                if ring is None:
+                    ring = SeriesRing(max_points=self.max_points)
+                    self._series[key] = ring
+                else:
+                    prev = ring.nbytes
+                n = ring.append(
+                    times, values, start=start, end=end, slack=slack
+                )
+                self._bytes += ring.nbytes - prev
+                self._series.move_to_end(key)
+                self._counts["samples"] += n
+                while (
+                    self._bytes > self.budget_bytes and len(self._series) > 1
+                ):
+                    _, old = self._series.popitem(last=False)
+                    self._bytes -= old.nbytes
+                    self._counts["evictions"] += 1
+                if journal is not None and (
+                    n or start is not None or end is not None
+                ):
+                    # empty backfills still carry an authority claim
+                    # worth persisting; pure no-op pushes do not.
+                    # DELIBERATELY under the shard lock (PR-7
+                    # replay-order contract, see the docstring above):
+                    # journaling outside it let two racing
+                    # same-timestamp revisions restore stale.
+                    journal(key, times, values, start, end)  # foremast: ignore[blocking-under-lock]
+                out.append(n)
+        return out
 
     def query(
         self,
@@ -391,6 +418,59 @@ class RingStore:
                 self._lag["receiver_lag_seconds"] = max(0.0, now - newest)
                 self._lag["last_push_at"] = now
         return n
+
+    def push_batch(
+        self,
+        entries: list[tuple[str, np.ndarray, np.ndarray, float | None]],
+        now: float | None = None,
+        record_lag: bool = True,
+        canonical: bool = False,
+    ) -> list[int]:
+        """Batch push for decoded wire payloads: ``(key, times, values,
+        start)`` tuples (exactly what ``wire.parse_push`` and
+        ``wire.decode_frame`` return) are grouped by shard and applied
+        with ONE lock acquisition per touched shard (`RingShard.
+        push_many`) — a 4k-series frame takes ~`shards` acquisitions
+        instead of 4k. Returns per-entry accepted counts aligned with
+        ``entries``. ``canonical=True`` skips `canonical_series` (the
+        binary codec's keys are canonical by contract; the JSON path
+        passes False). One lag sample is recorded for the whole batch."""
+        journal = self.journal
+        keys = (
+            [k for k, _, _, _ in entries]
+            if canonical
+            else [canonical_series(k) for k, _, _, _ in entries]
+        )
+        by_shard: dict[int, list[int]] = {}
+        for i, key in enumerate(keys):
+            by_shard.setdefault(self._shard_index(key), []).append(i)
+        counts = [0] * len(entries)
+        newest = None
+        for idx in sorted(by_shard):
+            order = by_shard[idx]
+            got = self._shards[idx].push_many(
+                [
+                    (keys[i], entries[i][1], entries[i][2], entries[i][3], None)
+                    for i in order
+                ],
+                slack=self.stale_seconds,
+                journal=(
+                    None
+                    if journal is None
+                    else lambda k, t, v, s, e, _i=idx: journal(_i, k, t, v, s, e)
+                ),
+            )
+            for i, n in zip(order, got):
+                counts[i] = n
+                if n:
+                    m = float(np.max(np.asarray(entries[i][1], np.int64)))
+                    newest = m if newest is None else max(newest, m)
+        if newest is not None and record_lag:
+            now = time.time() if now is None else now
+            with self._lock:
+                self._lag["receiver_lag_seconds"] = max(0.0, now - newest)
+                self._lag["last_push_at"] = now
+        return counts
 
     def query(
         self,

@@ -74,6 +74,8 @@ ALLOWED_LABELS: dict[str, frozenset[str]] = {
     "foremast_ingest_series_resident": frozenset(),
     "foremast_ingest_bytes_resident": frozenset(),
     "foremast_ingest_receiver_lag_seconds": frozenset(),
+    "foremast_ingest_requests": frozenset({"codec"}),
+    "foremast_ingest_stage_seconds": frozenset({"codec", "stage"}),
     # worker mesh (foremast_tpu/mesh/node.py MeshCollector)
     "foremast_mesh_members": frozenset({"state"}),
     "foremast_mesh_rebalances": frozenset(),
@@ -219,6 +221,14 @@ FAMILY_DOCS: dict[str, str] = {
     "foremast_ingest_receiver_lag_seconds": (
         "now minus the newest sample timestamp of the latest push"
     ),
+    "foremast_ingest_requests": (
+        "push requests decoded by the receiver, by wire codec "
+        "(json=compat codec, binary=columnar frame)"
+    ),
+    "foremast_ingest_stage_seconds": (
+        "wall-clock seconds per receiver pipeline stage "
+        "(read/decompress/decode/apply), by wire codec"
+    ),
     "foremast_mesh_members": (
         "live mesh members (fresh leases, including this worker), by "
         "lifecycle state (active/draining/joining)"
@@ -360,13 +370,22 @@ def default_registry_families():
         registry,
     ).labels(phase="Healthy").inc()
     # ingest plane: exercise every outcome so each label value appears
-    from foremast_tpu.ingest import IngestCollector, RingStore
+    from foremast_tpu.ingest import IngestCollector, RingStore, WireStats
 
     ring = RingStore(budget_bytes=1 << 20, shards=1)
     ring.push("lint_series", [60, 120], [1.0, 2.0], start=0.0, now=180.0)
     ring.query("lint_series", 0.0, 120.0, now=180.0)  # hit
     ring.query("lint_absent", 0.0, 120.0, now=180.0)  # miss
-    registry.register(IngestCollector(ring))
+    wire = WireStats()  # both codecs, every stage label
+    for codec in ("json", "binary"):
+        wire.record(
+            codec,
+            {"read": 0.001, "decompress": 0.0, "decode": 0.002,
+             "apply": 0.001},
+            samples=2,
+            ok=True,
+        )
+    registry.register(IngestCollector(ring, wire=wire))
     # worker mesh: a one-member node with both claim outcomes exercised
     from foremast_tpu.jobs.models import Document
     from foremast_tpu.jobs.store import InMemoryStore

@@ -1,0 +1,489 @@
+"""ISSUE 18 — binary remote-write codec: frame/snappy negative paths,
+codec negotiation on the shared POST route, the pre-read decoded-size
+413 guard, striped batch appends, and the decode-pool half of the
+shutdown drain (a push at shutdown is fully applied or cleanly 503'd,
+never half-appended).
+
+Every malformed payload here must come back as a clean 400/WireError —
+a handler traceback (500) is a test failure, not a flavor of rejection.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from foremast_tpu.ingest import (
+    BINARY_CONTENT_TYPE,
+    RingStore,
+    WireError,
+    decode_frame,
+    encode_frame,
+    parse_push,
+    snappy_compress,
+    snappy_decompress,
+    start_ingest_server,
+    stop_ingest_server,
+)
+from foremast_tpu.ingest.receiver import _DecodePool, _PoolClosed
+from foremast_tpu.ingest.wire import snappy_uncompressed_len
+from foremast_tpu.reactive import DirtySet
+
+NOW = 1_760_000_000.0
+
+
+def _entries(n_series=3, n_samples=4, base_ts=60):
+    out = []
+    for i in range(n_series):
+        ts = np.arange(n_samples, dtype=np.int64) * 30 + base_ts + i
+        vs = (np.arange(n_samples, dtype=np.float32) + i) * 0.5
+        out.append((f'm{{app="a{i}"}}', ts, vs, 10.0 * i if i else None))
+    return out
+
+
+def _push(port, body, ctype="application/json", enc=None, path="/api/v1/write"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body, method="POST"
+    )
+    req.add_header("Content-Type", ctype)
+    if enc:
+        req.add_header("Content-Encoding", enc)
+    try:
+        resp = urllib.request.urlopen(req, timeout=10)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---------------------------------------------------------------- frame codec
+
+
+def test_frame_roundtrip_zero_copy():
+    entries = _entries()
+    buf = encode_frame(entries)
+    out = decode_frame(buf)
+    assert len(out) == len(entries)
+    for (k0, t0, v0, s0), (k1, t1, v1, s1) in zip(entries, out):
+        assert k1 == k0
+        np.testing.assert_array_equal(t1, t0)
+        np.testing.assert_array_equal(v1, v0.astype(np.float32))
+        assert s1 == s0
+        # zero-copy contract: the decoded arrays are views over the
+        # frame buffer, not materialized copies
+        assert t1.base is not None and v1.base is not None
+    # empty frame is legal (a heartbeat push)
+    assert decode_frame(encode_frame([])) == []
+
+
+def test_frame_interning_and_canonicalization():
+    # non-canonical spelling: label order + whitespace normalize once at
+    # intern-miss, then every repeat frame hits the cache
+    entries = [('m{ b="2", a="1" }', np.array([60], np.int64),
+                np.array([1.0], np.float32), None)]
+    cache: dict = {}
+    out1 = decode_frame(encode_frame(entries), cache, canonicalize=True)
+    out2 = decode_frame(encode_frame(entries), cache, canonicalize=True)
+    assert out1[0][0] == 'm{a="1",b="2"}'
+    assert out2[0][0] is out1[0][0]  # same interned str object
+    assert len(cache) == 1
+
+
+@pytest.mark.parametrize(
+    "mangle, reason_match",
+    [
+        (lambda b: b[:20], "shorter than its 32-byte header"),
+        (lambda b: b"XXXX" + b[4:], "bad frame magic"),
+        (lambda b: b[:4] + b"\x09" + b[5:], "unsupported frame version"),
+        (lambda b: b[:5] + b"\x01" + b[6:], "reserved"),
+        (lambda b: b[:-3], "length mismatch"),
+        (lambda b: b + b"\x00\x00", "length mismatch"),
+    ],
+)
+def test_frame_truncations_and_header_damage(mangle, reason_match):
+    buf = encode_frame(_entries())
+    with pytest.raises(WireError, match=reason_match):
+        decode_frame(mangle(buf))
+
+
+def test_frame_internal_inconsistencies():
+    buf = bytearray(encode_frame(_entries(n_series=2, n_samples=3)))
+    # n_samples in the header no longer matches the counts section (the
+    # frame_len check fires first — sections are sized from the header)
+    bad = bytearray(buf)
+    bad[12:20] = (7).to_bytes(8, "little")
+    with pytest.raises(WireError):
+        decode_frame(bytes(bad))
+    # corrupt a counts entry so counts.sum() != n_samples
+    n_samples = int.from_bytes(buf[12:20], "little")
+    off = 32 + 8 * n_samples + 8 * 2 + 4 * n_samples  # counts offset
+    bad = bytearray(buf)
+    bad[off : off + 4] = (99).to_bytes(4, "little")
+    with pytest.raises(WireError, match="counts do not sum"):
+        decode_frame(bytes(bad))
+
+
+def test_frame_rejects_nonfinite_values():
+    for poison in (np.nan, np.inf, -np.inf):
+        entries = [("m", np.array([60, 90], np.int64),
+                    np.array([1.0, poison], np.float32), None)]
+        with pytest.raises(WireError, match="non-finite"):
+            decode_frame(encode_frame(entries))
+
+
+def test_frame_rejects_out_of_order_timestamps():
+    entries = [("m", np.array([120, 60], np.int64),
+                np.array([1.0, 2.0], np.float32), None)]
+    with pytest.raises(WireError, match="out-of-order"):
+        decode_frame(encode_frame(entries))
+    # duplicates are NOT out of order (last-write-wins merge path), and
+    # time may reset between series (per-series order only)
+    ok = [
+        ("a", np.array([60, 60, 90], np.int64),
+         np.array([1, 2, 3], np.float32), None),
+        ("b", np.array([30], np.int64), np.array([4], np.float32), None),
+    ]
+    assert len(decode_frame(encode_frame(ok))) == 2
+
+
+def test_frame_rejects_invalid_utf8_key():
+    buf = bytearray(encode_frame([("mm", np.array([60], np.int64),
+                                   np.array([1.0], np.float32), None)]))
+    buf[-2:] = b"\xff\xfe"  # key blob is the final section
+    with pytest.raises(WireError, match="not valid utf-8"):
+        decode_frame(bytes(buf))
+
+
+def test_json_parse_push_negatives_match_binary_contract():
+    # non-finite values are rejected by BOTH codecs (cross-codec parity)
+    with pytest.raises(WireError, match="non-finite"):
+        parse_push({"timeseries": [{"labels": {"__name__": "m"},
+                                    "samples": [[60, float("nan")]]}]})
+    # ... but out-of-order timestamps stay legal JSON: the compat codec
+    # keeps accepting what it always accepted
+    out = parse_push({"timeseries": [{"labels": {"__name__": "m"},
+                                      "samples": [[120, 2.0], [60, 1.0]]}]})
+    assert len(out) == 1 and len(out[0][1]) == 2
+
+
+# -------------------------------------------------------------------- snappy
+
+
+def test_snappy_roundtrip_and_rle():
+    for payload in (b"", b"x", b"abc" * 40000, bytes(range(256)) * 7):
+        assert snappy_decompress(snappy_compress(payload)) == payload
+    # overlapping-copy RLE stream (offset < length), hand-built:
+    # literal "ab" then a copy-1 of length 6 at offset 2 -> "abababab"
+    stream = bytes([8]) + bytes([(2 - 1) << 2]) + b"ab" + bytes(
+        [0b01 | ((6 - 4) << 2), 2]
+    )
+    assert snappy_decompress(stream) == b"abababab"
+
+
+@pytest.mark.parametrize(
+    "stream",
+    [
+        b"",  # no preamble
+        b"\xff" * 11,  # unterminated varint
+        bytes([5]) + bytes([(10 - 1) << 2]) + b"ab",  # literal overruns input
+        bytes([4]) + bytes([0b01, 9]),  # copy offset beyond output
+        bytes([9]) + bytes([(2 - 1) << 2]) + b"ab",  # declared len mismatch
+    ],
+)
+def test_snappy_malformed_streams(stream):
+    with pytest.raises(WireError):
+        snappy_decompress(stream)
+
+
+def test_snappy_max_len_guard():
+    comp = snappy_compress(b"z" * 4096)
+    assert snappy_uncompressed_len(comp) == 4096
+    with pytest.raises(WireError, match="cap"):
+        snappy_decompress(comp, max_len=1024)
+
+
+# ----------------------------------------------------- striped batch appends
+
+
+def test_push_batch_matches_sequential_push():
+    seq, bat = RingStore(shards=4), RingStore(shards=4)
+    entries = _entries(n_series=8, n_samples=16)
+    for key, ts, vs, start in entries:
+        seq.push(key, ts, vs, start=start)
+    counts = bat.push_batch(entries)
+    assert counts == [16] * 8
+    assert seq.stats()["samples"] == bat.stats()["samples"]
+    for key, ts, _vs, _start in entries:
+        a = seq.query(key, int(ts[0]), int(ts[-1]), now=float(ts[-1]))
+        b = bat.query(key, int(ts[0]), int(ts[-1]), now=float(ts[-1]))
+        assert a[0] == b[0] == "hit"
+        for x, y in zip(a[1:], b[1:]):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_push_many_journal_fires_in_apply_order():
+    store = RingStore(shards=1)
+    shard = store._shards[0]
+    items = [(k, t, v, s, None) for k, t, v, s in _entries(n_series=4)]
+    journaled = []
+    counts = shard.push_many(
+        items, journal=lambda key, *rest: journaled.append(key)
+    )
+    assert counts == [4] * 4
+    assert journaled == [k for k, *_ in items]  # replay order == apply order
+    assert shard.push_many([]) == []
+
+
+# --------------------------------------------------------- HTTP negotiation
+
+
+def test_receiver_binary_codec_negotiation_and_parity():
+    entries = _entries(n_series=2, n_samples=3, base_ts=60)
+    frame = encode_frame(entries)
+    js = json.dumps(
+        {
+            "timeseries": [
+                {
+                    "labels": {"__name__": "m", "app": f"a{i}"},
+                    "samples": [[int(t), float(v)] for t, v in zip(ts, vs)],
+                    **({"start": start} if start is not None else {}),
+                }
+                for i, (_k, ts, vs, start) in enumerate(entries)
+            ]
+        }
+    ).encode()
+    store = RingStore(shards=2)
+    dirty = DirtySet(max_keys=1024)
+    srv, _ = start_ingest_server(0, store, host="127.0.0.1", dirty=dirty)
+    try:
+        port = srv.server_address[1]
+        code, out = _push(port, frame, ctype=BINARY_CONTENT_TYPE)
+        assert (code, out["accepted_samples"], out["series"]) == (200, 6, 2)
+        # snappy rides on either codec
+        code, out2 = _push(
+            port, snappy_compress(frame), ctype=BINARY_CONTENT_TYPE,
+            enc="snappy",
+        )
+        assert (code, out2) == (200, out)
+        code, out3 = _push(port, snappy_compress(js), enc="snappy")
+        assert (code, out3["accepted_samples"]) == (200, 6)
+        # dirty-set marks are codec-independent (route key = app label)
+        marked = {k for k, _stamp in dirty.take_all()}
+        assert {"a0", "a1"} <= marked
+        # per-codec, per-stage wire stats surface in /debug/state
+        state = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/state", timeout=10
+            ).read()
+        )
+        for codec in ("json", "binary"):
+            w = state["wire"][codec]
+            assert w["requests"] >= 1 and w["samples"] >= 6
+            assert set(w["stage_seconds"]) == {
+                "read", "decompress", "decode", "apply"
+            }
+        # unsupported Content-Encoding → 400 before any body parse
+        code, out = _push(port, frame, ctype=BINARY_CONTENT_TYPE, enc="gzip")
+        assert code == 400 and "Content-Encoding" in out["reason"]
+    finally:
+        stop_ingest_server(srv)
+
+
+@pytest.mark.parametrize(
+    "body_fn, enc",
+    [
+        (lambda f: f[:20], None),  # truncated header
+        (lambda f: f[:-5], None),  # truncated sections
+        (lambda f: b"XXXX" + f[4:], None),  # bad magic
+        (lambda f: bytes([200]) + b"\x00garbage", "snappy"),  # bad snappy
+        (lambda f: snappy_compress(f)[:-3], "snappy"),  # truncated snappy
+    ],
+)
+def test_receiver_binary_negatives_are_clean_400(body_fn, enc):
+    """Malformed binary payloads answer 400 with a reason — never a 500
+    (which would mean a traceback escaped the codec's own checks)."""
+    frame = encode_frame(_entries())
+    store = RingStore(shards=1)
+    srv, _ = start_ingest_server(0, store, host="127.0.0.1")
+    try:
+        port = srv.server_address[1]
+        code, out = _push(
+            port, body_fn(frame), ctype=BINARY_CONTENT_TYPE, enc=enc
+        )
+        assert code == 400 and out["reason"]
+        assert store.stats()["samples"] == 0
+        # out-of-order inside a binary frame: 400, with the JSON-codec
+        # escape hatch named in the reason
+        bad = encode_frame(
+            [("m", np.array([120, 60], np.int64),
+              np.array([1, 2], np.float32), None)]
+        )
+        code, out = _push(port, bad, ctype=BINARY_CONTENT_TYPE)
+        assert code == 400 and "out-of-order" in out["reason"]
+        # NaN via JSON: same 400 contract on the compat codec
+        code, out = _push(
+            port,
+            b'{"timeseries": [{"labels": {"__name__": "m"},'
+            b' "samples": [[60, NaN]]}]}',
+        )
+        assert code == 400
+        # receiver still healthy afterwards
+        good = encode_frame(_entries(n_series=1, n_samples=2))
+        code, out = _push(port, good, ctype=BINARY_CONTENT_TYPE)
+        assert (code, out["accepted_samples"]) == (200, 2)
+    finally:
+        stop_ingest_server(srv)
+
+
+# ------------------------------------------------- pre-read 413 bomb guard
+
+
+def _raw_post_expect(port, headers: dict, payload: bytes) -> int:
+    """POST with a Content-Length larger than what we actually send —
+    the status can only come back if the receiver answered BEFORE
+    reading the full declared body (the no-buffering guard)."""
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+        head = "POST /api/v1/write HTTP/1.1\r\nHost: x\r\n" + "".join(
+            f"{k}: {v}\r\n" for k, v in headers.items()
+        ) + "\r\n"
+        s.sendall(head.encode() + payload)
+        s.settimeout(10)
+        status = s.recv(4096).split(b"\r\n", 1)[0]
+        return int(status.split()[1])
+
+
+def test_binary_413_from_frame_header_before_read():
+    store = RingStore(shards=1)
+    srv, _ = start_ingest_server(
+        0, store, host="127.0.0.1", max_decoded_bytes=4096,
+        max_body_bytes=8 << 20,
+    )
+    try:
+        port = srv.server_address[1]
+        # a frame header declaring 1 MiB decoded, but we transmit ONLY
+        # the 32 header bytes of the claimed 1 MiB body: a 413 proves
+        # the guard fired off the peek, without buffering the body
+        declared = 1 << 20
+        header = (
+            b"FMW1" + bytes((1, 0, 0, 0))
+            + (1).to_bytes(4, "little") + (100).to_bytes(8, "little")
+            + (10).to_bytes(4, "little") + declared.to_bytes(8, "little")
+        )
+        code = _raw_post_expect(
+            port,
+            {"Content-Type": BINARY_CONTENT_TYPE,
+             "Content-Length": str(declared)},
+            header,
+        )
+        assert code == 413
+        # snappy bomb: a TINY body whose varint preamble declares
+        # 256 MiB decoded — 413 off the preamble, before decompressing
+        bomb = bytes([0x80, 0x80, 0x80, 0x80, 0x01]) + b"\x00\x00"  # 2**28
+        code, out = _push(port, bomb, enc="snappy")
+        assert code == 413 and "declared decoded size" in out["reason"]
+        assert store.stats()["samples"] == 0
+        # an honest small frame still lands afterwards
+        frame = encode_frame(_entries(n_series=1, n_samples=2))
+        code, out = _push(port, frame, ctype=BINARY_CONTENT_TYPE)
+        assert (code, out["accepted_samples"]) == (200, 2)
+    finally:
+        stop_ingest_server(srv)
+
+
+# ------------------------------------------------------ shutdown drain
+
+
+class _SlowApplyStore(RingStore):
+    """RingStore whose batch apply stalls long enough for the test to
+    land a shutdown mid-decode."""
+
+    def __init__(self, *a, delay=0.4, **kw):
+        super().__init__(*a, **kw)
+        self._delay = delay
+        self.apply_started = threading.Event()
+
+    def push_batch(self, entries, **kw):
+        self.apply_started.set()
+        time.sleep(self._delay)
+        return super().push_batch(entries, **kw)
+
+
+def test_shutdown_drains_pooled_decode_never_half_applies():
+    """ISSUE 18 satellite: a binary push that is mid-decode when
+    stop_ingest_server runs is either FULLY applied (200, all samples
+    queryable) or cleanly 503'd with nothing appended — the drain must
+    wait for the pooled worker, not just the handler thread."""
+    store = _SlowApplyStore(shards=2, delay=0.4)
+    srv, _ = start_ingest_server(0, store, host="127.0.0.1",
+                                 decode_workers=2)
+    port = srv.server_address[1]
+    frame = encode_frame(_entries(n_series=3, n_samples=5))
+    result: dict = {}
+
+    def pusher():
+        result["resp"] = _push(port, frame, ctype=BINARY_CONTENT_TYPE)
+
+    t = threading.Thread(target=pusher)
+    t.start()
+    assert store.apply_started.wait(5.0)  # decode worker is mid-apply
+    clean = stop_ingest_server(srv, drain_seconds=5.0)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    code, out = result["resp"]
+    assert clean is True
+    if code == 200:
+        assert out["accepted_samples"] == 15
+        assert store.stats()["samples"] == 15
+    else:  # cleanly shed: nothing half-appended
+        assert code == 503
+        assert store.stats()["samples"] == 0
+
+
+def test_closed_pool_sheds_503_with_nothing_applied():
+    store = RingStore(shards=1)
+    srv, _ = start_ingest_server(0, store, host="127.0.0.1")
+    try:
+        port = srv.server_address[1]
+        # simulate the drain window: pool already closed, socket still up
+        srv._foremast_decode_pool.close(time.monotonic())
+        code, out = _push(
+            port, encode_frame(_entries()), ctype=BINARY_CONTENT_TYPE
+        )
+        assert code == 503 and "draining" in out["reason"]
+        assert store.stats()["samples"] == 0
+    finally:
+        stop_ingest_server(srv)
+
+
+def test_decode_pool_close_refuses_then_drains():
+    pool = _DecodePool(workers=2)
+    release = threading.Event()
+    started = threading.Event()
+
+    def job():
+        started.set()
+        release.wait(5.0)
+        return "done"
+
+    results = []
+    t = threading.Thread(target=lambda: results.append(pool.run(job)))
+    t.start()
+    assert started.wait(5.0)
+    closer = threading.Thread(
+        target=lambda: results.append(
+            ("clean", pool.close(time.monotonic() + 5.0))
+        )
+    )
+    closer.start()
+    # admission is refused the moment close begins ...
+    with pytest.raises(_PoolClosed):
+        pool.run(lambda: "late")
+    # ... but the started job runs to completion and close reports clean
+    release.set()
+    t.join(timeout=5)
+    closer.join(timeout=5)
+    assert "done" in results and ("clean", True) in results
