@@ -787,6 +787,190 @@ def run_child(args) -> int:
 # ---------------------------------------------------------------------------
 
 
+def run_arena_check_child(args) -> int:
+    """`--arena-child`: the ISSUE 19 sharded-arena capacity claims,
+    demonstrated on real arenas under the sharded variant's exact
+    device topology (forced virtual devices on CPU hosts, real chips
+    on TPU). Three in-run asserts, one JSON verdict line for the
+    parent:
+
+      1. OOM-replicated-fits-sharded — a fleet whose row count blows
+         the per-device budget hard-cap REFUSES on a replicated arena
+         (assign -> None) and FITS a sharded arena under the identical
+         per-device budget;
+      2. linear capacity — aggregate sharded rows == devices x the
+         replicated capacity the same budget buys;
+      3. no cross-device gather leg — the compiled warm-tick program
+         (`score_from_arena_sharded`, the real judgment jit) contains
+         ZERO collectives: the roofline's gather leg is device-local.
+    """
+    n = args.device_mesh
+    plat = os.environ.get("JAX_PLATFORMS", "")  # foremast: ignore[env-contract]
+    flags = os.environ.get("XLA_FLAGS", "")  # foremast: ignore[env-contract]
+    if (
+        (not plat or plat.startswith("cpu"))
+        and "xla_force_host_platform_device_count" not in flags
+    ):
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+    import re
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from foremast_tpu.engine import arena as ar
+    from foremast_tpu.engine import scoring
+    from foremast_tpu.ops.windows import MetricWindows
+    from foremast_tpu.parallel import mesh as meshlib
+
+    mesh = meshlib.make_mesh(n_data=n)
+    data_spec = NamedSharding(mesh, P(meshlib.DATA_AXIS))
+    season = 16
+    row_bytes = 20 + 4 * season
+    per_device_rows = 64
+    budget = per_device_rows * row_bytes
+    fleet = n * per_device_rows
+    keys = [f"svc{i}" for i in range(fleet)]
+    ar.set_arena_budget(budget, budget)
+    try:
+        # replicated layout: every device must host the WHOLE fleet, so
+        # the per-device budget hard-caps and admission refuses
+        rep = ar.StateArena(
+            season, sharding=NamedSharding(mesh, P()), shards=1
+        )
+        oom_replicated = rep.assign(keys, []) is None
+        # the same budget DOES buy per_device_rows replicated rows...
+        assert rep.assign(keys[:per_device_rows], []) is not None
+        rep_cap = rep.cap
+
+        # ...and the sharded layout turns that per-device budget into
+        # devices x the rows: the whole fleet fits
+        sha = ar.StateArena(season, sharding=data_spec, shards=n)
+        res = sha.assign(keys, [])
+        fits_sharded = res is not None
+        assert oom_replicated, (
+            "replicated arena admitted a fleet past its hard cap — "
+            "the capacity comparison is broken"
+        )
+        assert fits_sharded, (
+            "sharded arena refused a fleet that fits its aggregate "
+            "capacity"
+        )
+        assert sha.cap == n * rep_cap, (sha.cap, n, rep_cap)
+
+        rows_g, scat = res
+        sha.scatter(
+            rows_g,
+            scat,
+            [
+                (1.0, 0.0, np.zeros(season, np.float32), 3, 1.0, 100)
+                for _ in scat
+            ],
+        )
+
+        # compile the REAL warm-tick judgment at the fleet shape and
+        # prove the gather leg is device-local: zero collectives
+        tc = 16
+        local = jax.device_put(
+            (np.asarray(rows_g) % sha.cap_s).astype(np.int32), data_spec
+        )
+        batch = scoring.ScoreBatch(
+            historical=MetricWindows(
+                values=jax.device_put(
+                    np.zeros((fleet, 0), np.float32), data_spec
+                ),
+                mask=jax.device_put(np.zeros((fleet, 0), bool), data_spec),
+                times=None,
+            ),
+            current=MetricWindows(
+                values=jax.device_put(
+                    np.ones((fleet, tc), np.float32), data_spec
+                ),
+                mask=jax.device_put(np.ones((fleet, tc), bool), data_spec),
+                times=None,
+            ),
+            baseline=MetricWindows(
+                values=jax.device_put(
+                    np.zeros((fleet, tc), np.float32), data_spec
+                ),
+                mask=jax.device_put(np.zeros((fleet, tc), bool), data_spec),
+                times=None,
+            ),
+            threshold=jax.device_put(
+                np.full(fleet, 3.0, np.float32), data_spec
+            ),
+            bound=jax.device_put(np.zeros(fleet, np.int32), data_spec),
+            min_lower_bound=jax.device_put(
+                np.zeros(fleet, np.float32), data_spec
+            ),
+            min_points=jax.device_put(
+                np.full(fleet, 10, np.int32), data_spec
+            ),
+        )
+        hlo = (
+            scoring.score_from_arena_sharded.lower(
+                batch, *sha.state, local, mesh=mesh
+            )
+            .compile()
+            .as_text()
+        )
+        collectives = sorted(
+            set(
+                re.findall(
+                    r"all-gather|all-reduce-start|all-to-all"
+                    r"|collective-permute",
+                    hlo,
+                )
+            )
+        )
+        assert not collectives, (
+            "warm sharded program grew a cross-device leg: "
+            f"{collectives}"
+        )
+        print(
+            json.dumps(
+                {
+                    "devices": n,
+                    "per_device_row_budget": per_device_rows,
+                    "fleet_rows": fleet,
+                    "oom_replicated": oom_replicated,
+                    "fits_sharded": fits_sharded,
+                    "replicated_capacity_rows": rep_cap,
+                    "sharded_capacity_rows": sha.cap,
+                    "linear_scaling": sha.cap == n * rep_cap,
+                    "warm_gather_collectives": collectives,
+                }
+            ),
+            flush=True,
+        )
+    finally:
+        ar.set_arena_budget(None, None)
+    return 0
+
+
+def run_arena_check(device_mesh: int, env: dict) -> dict:
+    """Spawn the `--arena-child` capacity check and return its verdict
+    (the child owns the forced-device topology; keeping it out of the
+    parent keeps virtual devices away from the parent's jax)."""
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "benchmarks.scaleout_bench",
+            "--arena-child", "--device-mesh", str(device_mesh),
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, (
+        f"arena capacity check failed:\n{out.stdout}\n{out.stderr}"
+    )
+    verdict = json.loads(out.stdout.strip().splitlines()[-1])
+    assert verdict["oom_replicated"] and verdict["fits_sharded"], verdict
+    assert verdict["linear_scaling"], verdict
+    assert verdict["warm_gather_collectives"] == [], verdict
+    return verdict
+
+
 def _worker_log(i: int) -> str:
     try:
         with open(
@@ -1104,6 +1288,8 @@ def run(
             "padded_row_fraction": (
                 round(pad / rows, 5) if rows else None
             ),
+            "arena_layout": dms[-1].get("arena_layout"),
+            "arena_capacity_rows": dms[-1].get("arena_capacity_rows"),
             "arena_replica_bytes": dms[-1]["arena_replica_bytes"],
             "arena_total_device_bytes": dms[-1][
                 "arena_total_device_bytes"
@@ -1171,6 +1357,10 @@ def main(argv=None):
     )
     # child-mode flags (internal)
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument(
+        "--arena-child", dest="arena_child", action="store_true",
+        help=argparse.SUPPRESS,
+    )
     ap.add_argument("--store-url", help=argparse.SUPPRESS)
     ap.add_argument("--index", type=int, default=0, help=argparse.SUPPRESS)
     ap.add_argument("--victim", action="store_true", help=argparse.SUPPRESS)
@@ -1194,6 +1384,8 @@ def main(argv=None):
         "--ring-points", type=int, default=64, help=argparse.SUPPRESS
     )
     args = ap.parse_args(argv)
+    if args.arena_child:
+        return run_arena_check_child(args)
     if args.child:
         return run_child(args)
     if args.small:
@@ -1210,6 +1402,17 @@ def main(argv=None):
         cpus_per_worker = max(
             1, (os.cpu_count() or 8) // max(worker_counts)
         )
+    arena_capacity = None
+    if args.device_mesh > 1:
+        # ISSUE 19 capacity claims, asserted in-run before the fleet
+        # spins up: OOM-replicated-fits-sharded, linear aggregate
+        # capacity, zero collectives in the compiled warm gather
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = (
+            os.environ.get("JAX_PLATFORMS") or "cpu"  # foremast: ignore[env-contract]
+        )
+        arena_capacity = run_arena_check(args.device_mesh, env)
+        print(json.dumps({"arena_capacity": arena_capacity}), flush=True)
     rows = []
     for i, w in enumerate(worker_counts):
         kill = (not args.no_kill) and i == len(worker_counts) - 1
@@ -1231,6 +1434,7 @@ def main(argv=None):
         "services": args.services,
         "windows": args.services * args.aliases,
         "device_mesh": args.device_mesh or None,
+        "arena_capacity": arena_capacity,
         "roofline": rows[-1]["roofline"],
         "worker_counts": worker_counts,
         "fleet_warm_windows_per_sec": {

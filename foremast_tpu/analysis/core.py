@@ -32,9 +32,9 @@ _ALL_RULES = "*"
 
 # Positive annotations: `# foremast: device-boundary` marks a function
 # as a sanctioned gather/decode stage (rule device-flow allows host
-# syncs inside it), `# foremast: replicated-arena` marks sharded code
-# that touches arena rows under the replicated-placement contract
-# (rule sharding-contract). Unlike `ignore[...]` these are CONTRACT
+# syncs inside it), `# foremast: sharded-arena` marks sharded code
+# that touches arena rows under the data-axis row-placement contract
+# (rule sharding-contract, ISSUE 19). Unlike `ignore[...]` these are CONTRACT
 # declarations, not finding suppressions: they change what the rule
 # checks, and the docs inventory them (docs/static-analysis.md).
 _MARKER_RE = re.compile(r"#\s*foremast:\s*(?P<marker>[a-z][a-z-]+)")

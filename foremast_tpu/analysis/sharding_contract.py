@@ -7,9 +7,9 @@ with the mesh `device_put`. A direct `jnp.asarray`/`jax.device_put` in
 warm-path code commits the buffer to the DEFAULT device first, turning
 the sharded placement into a second copy (engine/judge.py's host-buffer
 comment pins this), or — worse — silently bypassing the partition and
-breaking byte parity across arms. ROADMAP item 2 (arena re-partition)
-will rewrite exactly this seam; this rule turns drift into a finding
-instead of a parity break.
+breaking byte parity across arms. ISSUE 19 rewrote the arena seam
+(replicated rows -> data-axis-sharded row blocks); this rule keeps the
+NEW layout's invariants from drifting into a parity break.
 
 Two checks, both scoped to the warm-path modules:
 
@@ -21,13 +21,17 @@ Two checks, both scoped to the warm-path modules:
     finding. `parallel/mesh.py` is the placement LIBRARY (the hooks
     call into it) and `parallel/seqparallel.py`/`distributed.py` are
     jit-interior collective code, so they are out of scope by design.
-  * REPLICATED ARENA — arena references from sharded code
+  * SHARDED ARENA — arena references from sharded code
     (``foremast_tpu/parallel/``) must sit in a function annotated
-    ``# foremast: replicated-arena``: the arena REPLICATES over the
-    mesh (`ShardedJudge._arena_sharding` — every device gathers rows
-    from its local replica), and any new arena touchpoint in parallel/
-    must declare it honors that placement, because a row sharded over
-    the mesh would turn every warm gather into an all-to-all. The
+    ``# foremast: sharded-arena``: the arena block-partitions its ROW
+    space over the data axis (`ShardedJudge._arena_sharding`, ISSUE
+    19) with row placement tied to batch position (position i of a
+    B-row batch lives on shard ``i // (B / shards)``), so warm gathers
+    take LOCAL indices inside shard_map and never cross chips. Any new
+    arena touchpoint in parallel/ must declare it honors that
+    contract — global indices fed to the local gather, or a
+    concatenate/reshape that re-blocks the row axis, silently turns
+    the device-local gather into garbage rows or an all-gather. The
     annotation inventory lives in docs/static-analysis.md.
 """
 
@@ -39,7 +43,7 @@ from foremast_tpu.analysis.core import Finding
 from foremast_tpu.analysis.interproc import Program, dotted, own_body_walk
 
 RULE = "sharding-contract"
-ARENA_MARKER = "replicated-arena"
+ARENA_MARKER = "sharded-arena"
 
 PLACEMENT_HOOKS = frozenset({"_place", "_place_cols"})
 PLACEMENT_SCOPE = ("foremast_tpu/jobs/", "foremast_tpu/parallel/batch.py")
@@ -105,14 +109,16 @@ def _arena_findings(fn) -> list[Finding]:
                 RULE,
                 node,
                 f"arena reference `{name}` in sharded code (`{fn.name}`) "
-                "without the replicated-arena annotation — arena rows "
-                "REPLICATE over the mesh (ShardedJudge._arena_sharding); "
+                "without the sharded-arena annotation — arena rows "
+                "block-shard over the data axis with position-tied "
+                "placement (ShardedJudge._arena_sharding, ISSUE 19); "
                 "code that touches them from parallel/ must declare it "
-                "honors that placement",
+                "honors that layout",
                 hint="annotate the enclosing def (or this line) with "
-                "`# foremast: replicated-arena` after checking the access "
-                "works against a replicated (not sharded) arena — "
-                "docs/static-analysis.md",
+                "`# foremast: sharded-arena` after checking the access "
+                "keeps row placement aligned with batch position (local "
+                "indices into shard_map gathers, no row-axis re-blocking) "
+                "— docs/static-analysis.md",
             )
         )
         break  # one finding per function is enough signal

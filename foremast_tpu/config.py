@@ -536,11 +536,27 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "placement entirely; `N` puts N devices on the data axis; "
         "`NxM` is an explicit (data, model) grid. The warm columnar "
         "paths (univariate + joint from-rows) shard their batch "
-        "leading axis over `data` with state arenas REPLICATED per "
-        "device (HBM cost = arena bytes × devices, accounted on "
-        "`/debug/state device_mesh`). Malformed values warn and fall "
+        "leading axis over `data` with state-arena ROW SPACE "
+        "block-sharded over the same axis by default (ISSUE 19; "
+        "aggregate capacity = per-device budget × devices, accounted "
+        "on `/debug/state device_mesh`; set FOREMAST_ARENA_SHARDED=0 "
+        "to replicate instead). Malformed values warn and fall "
         "back to `auto`. Pod mode (`--sharded`) spans the GLOBAL mesh "
         "instead and ignores this knob",
+    ),
+    EnvKnob(
+        "FOREMAST_ARENA_SHARDED",
+        "1",
+        "int",
+        "shard the device state arenas' row space over the mesh data "
+        "axis (default on, ISSUE 19): each device holds only its "
+        "block of rows — placement tied to batch position, so warm "
+        "gathers stay device-local with zero cross-chip transfer — "
+        "and the per-device FOREMAST_ARENA_BYTES budget buys "
+        "devices× aggregate rows instead of one replica per chip. "
+        "`0` restores the ISSUE-13 replicated layout. Ignored (forced "
+        "replicated) on a 1-device judge and in pod mode, where "
+        "per-process meshes already partition the fleet",
     ),
     EnvKnob(
         "FOREMAST_DEVICE_MESH_MODEL",

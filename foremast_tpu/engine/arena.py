@@ -94,6 +94,14 @@ def _row_bytes(m: int) -> int:
     return 20 + 4 * m
 
 
+def _is_pad_key(k) -> bool:
+    """Batch-padding keys (judge/joint columnar "__pad__*" strings) —
+    resident arena machinery that must never read as fleet state in the
+    operator counters (models.cache.is_pad_fit_key is the fit-cache
+    twin; arena keys that are pads are always plain strings)."""
+    return isinstance(k, str) and k.startswith("__pad__")
+
+
 def _pow2(n: int) -> int:
     b = 8
     while b < n:
@@ -140,18 +148,29 @@ class RowArena:
         row_bytes: int,
         max_bytes: int | None = None,
         sharding=None,
+        shards: int = 1,
     ):
         """`sharding` (optional jax.sharding.Sharding) places the arena's
-        device buffers explicitly — a ShardedJudge passes its mesh's
-        fully-REPLICATED NamedSharding so the warm-tick gather runs
-        locally on every device instead of pulling rows from wherever
-        jnp.zeros happened to commit them (VERDICT r4 weak #4: the
-        arena's placement under GSPMD was inherited by accident).
-        Replication is correct because row assignment is deterministic:
-        every process derives identical (key -> row) maps from identical
-        broadcast inputs (parallel/distributed.py)."""
+        device buffers explicitly — a ShardedJudge passes a mesh
+        NamedSharding so the warm-tick gather runs locally on every
+        device instead of pulling rows from wherever jnp.zeros happened
+        to commit them (VERDICT r4 weak #4: the arena's placement under
+        GSPMD was inherited by accident).
+
+        `shards` > 1 (ISSUE 19) partitions the row space along the same
+        data axis as the batch: the [capacity] leading axis splits into
+        `shards` contiguous blocks of `cap_s` rows each (global row
+        g = shard * cap_s + local), `sharding` must block-shard the
+        leading axis over that axis, and `assign` places position i of a
+        B-row batch ONLY in shard i // (B / shards) — the batch's own
+        block placement — so the warm gather is device-local by
+        construction. Byte budgets (`max_rows` / `hard_rows`) are
+        PER-SHARD: each device hosts its block within the same budget,
+        so aggregate capacity scales linearly with the mesh
+        (`device_bytes()` stays per-device in both modes)."""
         self.row_bytes = max(int(row_bytes), 1)
         self.sharding = sharding
+        self.shards = max(int(shards), 1)
         budget = _arena_bytes() if max_bytes is None else max_bytes
         self.max_rows = min(_MAX_ROWS, max(budget // self.row_bytes, 8))
         # soft budget: a batch larger than max_rows auto-grows toward the
@@ -161,7 +180,8 @@ class RowArena:
             _MAX_ROWS,
             max(_arena_max_bytes() // self.row_bytes, 8),
         )
-        self.cap = 0
+        self.cap = 0  # TOTAL rows (= shards * cap_s when sharded)
+        self.cap_s = 0  # per-shard rows (== cap when shards == 1)
         self.state = None  # layout owned by the subclass
         self.rows: dict = {}  # fit key -> row index
         self.row_key: list = []  # row index -> fit key | None
@@ -172,13 +192,22 @@ class RowArena:
         # callers that scatter whole-entry rows (TreeArena users);
         # evictions prune it so it never outgrows the row count.
         self.row_entry: dict = {}
-        self.free: list[int] = []  # unassigned row indices
+        self.free: list[int] = []  # unassigned row indices (shards == 1)
+        # sharded mode: per-shard free lists of LOCAL indices (local
+        # indices are stable across growth; global ones renumber)
+        self._free_s: list[list[int]] = [[] for _ in range(self.shards)]
         self._transients: list[int] = []  # last call's unkeyed rows
         self.stamp = np.zeros(0, np.int64)  # per-row last-use tick
         self.tick = 0
         self.hits = 0
         self.misses = 0  # rows scattered (new or refreshed)
         self.evictions = 0
+        self.shard_moves = 0  # rows migrated between shards (sharded)
+        # resident rows held by batch-padding keys ("__pad__*"): arena
+        # machinery, not fleet state — subtracted from rows_live so the
+        # operator counters report documents, and their hits/misses are
+        # never counted (positions >= assign()'s n_real are pads)
+        self.pad_live = 0
 
     # -- layout hooks (subclass-owned) ------------------------------------
 
@@ -234,7 +263,87 @@ class RowArena:
         )
         self.free.extend(range(self.cap, new_cap))
         self.cap = new_cap
+        self.cap_s = new_cap
         return True
+
+    # Growth IS a sanctioned host round-trip (rare — pow2 doubling,
+    # warn-logged): _grow_sharded device_gets every leaf so the row
+    # blocks survive the resize (see its docstring), and the index
+    # renumbering below is host metadata work on that boundary.
+    # foremast: device-boundary
+    def _ensure_capacity_sharded(self, need_s: int) -> bool:
+        """Sharded-mode `_ensure_capacity`: `need_s` is the PER-SHARD row
+        count this call must host. Same soft-budget auto-grow / hard-cap
+        refusal rules as the replicated path, applied per shard."""
+        if need_s > self.max_rows:
+            if need_s > self.hard_rows:
+                return False
+            self.max_rows = min(self.hard_rows, _pow2(need_s))
+            log.warning(
+                "sharded arena grown past FOREMAST_ARENA_BYTES soft "
+                "budget: %d rows/shard x %d shards x %d B = %.0f MB "
+                "aggregate; set FOREMAST_ARENA_BYTES>=%d to silence",
+                need_s,
+                self.shards,
+                self.row_bytes,
+                need_s * self.shards * self.row_bytes / 1e6,
+                need_s * self.row_bytes,
+            )
+        if need_s <= self.cap_s:
+            return True
+        new_s = min(
+            self.max_rows,
+            max(_pow2(need_s), max(self._min_rows() // self.shards, 8)),
+        )
+        old_s = self.cap_s
+        if self.state is None:
+            self.state = self._alloc(self.shards * new_s)
+        else:
+            self.state = self._grow_sharded(old_s, new_s)
+        if self.sharding is not None:
+            self.state = jax.device_put(self.state, self.sharding)
+        # host-side renumbering: global row g = shard * cap_s + local, so
+        # growing cap_s moves every existing global index
+        if old_s:
+            def remap(g: int) -> int:
+                return (g // old_s) * new_s + (g % old_s)
+
+            self.rows = {k: remap(g) for k, g in self.rows.items()}
+            new_keys: list = [None] * (self.shards * new_s)
+            for g, k in enumerate(self.row_key):
+                if k is not None:
+                    new_keys[remap(g)] = k
+            self.row_key = new_keys
+            st = np.full((self.shards, new_s), -1, np.int64)
+            st[:, :old_s] = self.stamp.reshape(self.shards, old_s)
+            self.stamp = st.ravel()
+            self._transients = [remap(g) for g in self._transients]
+        else:
+            self.row_key = [None] * (self.shards * new_s)
+            self.stamp = np.full(self.shards * new_s, -1, np.int64)
+        for s in range(self.shards):
+            self._free_s[s].extend(range(old_s, new_s))
+        self.cap_s = new_s
+        self.cap = self.shards * new_s
+        return True
+
+    def _grow_sharded(self, old_s: int, new_s: int):
+        """Per-shard zero-padding of every state leaf. Growth is RARE
+        (pow2 doubling, warn-logged), so it round-trips through the
+        host: a plain `jnp.concatenate` on a data-axis-sharded leaf
+        would RE-BLOCK the layout under GSPMD — existing rows silently
+        migrate devices and their global indices stop matching the block
+        rule — while the host reshape keeps every row in its shard."""
+        shards = self.shards
+
+        def pad_leaf(x):
+            h = np.asarray(jax.device_get(x))
+            h = h.reshape(shards, old_s, *h.shape[1:])
+            widths = [(0, 0), (0, new_s - old_s)] + [(0, 0)] * (h.ndim - 2)
+            h = np.pad(h, widths)
+            return h.reshape(shards * new_s, *h.shape[2:])
+
+        return jax.tree.map(pad_leaf, self.state)
 
     def _min_rows(self) -> int:
         """Initial-allocation floor (subclasses with fat rows lower it:
@@ -245,17 +354,22 @@ class RowArena:
     def clear(self) -> None:
         """Release device buffers and all row assignments."""
         self.cap = 0
+        self.cap_s = 0
         self.state = None
         self.rows.clear()
         self.row_entry.clear()
         self.row_key = []
         self.stamp = np.zeros(0, np.int64)
         self.free = []
+        self._free_s = [[] for _ in range(self.shards)]
         self._transients = []
+        self.pad_live = 0
 
     # -- assignment ------------------------------------------------------
 
-    def assign(self, keys, force) -> tuple[np.ndarray, list[int]] | None:
+    def assign(
+        self, keys, force, n_real: int | None = None
+    ) -> tuple[np.ndarray, list[int]] | None:
         """Map a batch's fit keys onto arena rows.
 
         keys:  per-task cache keys (None => transient row, scattered and
@@ -264,6 +378,11 @@ class RowArena:
                rows must be scattered even if the key already has a row
                (a fit-cache miss means the host entry was refreshed; the
                old device row is stale).
+        n_real: positions >= this are batch-padding keys ("__pad__*"):
+               they get rows and scatters like any key (stable pad rows
+               keep warm ticks scatter-free) but are excluded from the
+               hit/miss/rows_live counters — operators count documents,
+               not padding. Default: every position is real.
 
         Returns (rows [B] int64, scatter_positions) or None when the
         batch cannot fit in the byte budget.
@@ -275,7 +394,13 @@ class RowArena:
         carry stamp == tick and are never eviction candidates; last
         call's transient rows are aged to stamp -1 up front, making them
         the preferred recycling pool.
+
+        Sharded arenas (`shards` > 1) route to `_assign_sharded`: the
+        same surface, with rows constrained to each position's data-axis
+        block.
         """
+        if self.shards > 1:
+            return self._assign_sharded(keys, force, n_real)
         # age out the previous call's transient rows (unless a keyed
         # assignment has since claimed the row)
         for r in self._transients:
@@ -284,6 +409,7 @@ class RowArena:
         self._transients.clear()
         self.tick += 1
         n = len(keys)
+        nr = n if n_real is None else n_real
         if not self._ensure_capacity(n):
             return None
         getrow = self.rows.get
@@ -293,9 +419,9 @@ class RowArena:
             count=n,
         )
         hit = rows >= 0
-        nhits = int(hit.sum())
-        if nhits:
+        if hit.any():
             self.stamp[rows[hit]] = self.tick
+        nhits = int(hit[:nr].sum())
         scatter: list[int] = []
         if force:
             for i in force:
@@ -394,9 +520,13 @@ class RowArena:
                         del self.rows[old]
                         self.row_entry.pop(old, None)
                         self.evictions += 1
+                        if _is_pad_key(old):
+                            self.pad_live -= 1
                 if k is not None:
                     self.rows[k] = r
                     self.row_key[r] = k
+                    if i >= nr:
+                        self.pad_live += 1
                 else:
                     # transient: recyclable at the next assign
                     self.row_key[r] = None
@@ -404,23 +534,189 @@ class RowArena:
                 self.stamp[r] = self.tick
                 rows[i] = r
                 scatter.append(i)
-                self.misses += 1
+                if i < nr:
+                    self.misses += 1
+        return rows, scatter
+
+    def _assign_sharded(
+        self, keys, force, n_real: int | None = None
+    ) -> tuple[np.ndarray, list[int]] | None:
+        """`assign` under the data-axis block placement rule (ISSUE 19):
+        position i of a B-row batch lives in shard i // (B / shards) and
+        its row must belong to that shard's block (global row
+        g = shard * cap_s + local), so the warm gather never crosses a
+        device boundary. Differences from the replicated path, all
+        bounded and counted:
+
+          * a key whose position moved to a different block since last
+            tick MIGRATES — old row freed, fresh row scattered in the
+            new shard (`shard_moves` counts these; claim-order jitter is
+            self-healing, one re-scatter per moved row);
+          * a key already claimed by one position this call but ALSO
+            appearing at a position of another shard (duplicate keys —
+            shard-qualified pad keys never collide) scores that position
+            from a transient row;
+          * ALL growth happens before rows are handed out (growing
+            renumbers global indices, which would corrupt positions
+            already assigned this call), using the same 8-call idle
+            window the replicated path's in-loop backstop uses — at
+            worst it grows a little earlier, never thrashes.
+        """
+        n = len(keys)
+        nr = n if n_real is None else n_real
+        shards = self.shards
+        if n % shards:
+            log.warning(
+                "sharded arena assign: batch of %d rows is not a "
+                "multiple of %d shards — stacked fallback", n, shards,
+            )
+            return None
+        for r in self._transients:
+            if self.row_key[r] is None:
+                self.stamp[r] = -1
+        self._transients.clear()
+        self.tick += 1
+        per = n // shards
+        if not self._ensure_capacity_sharded(per):
+            return None
+        getrow = self.rows.get
+
+        def sweep() -> np.ndarray:
+            return np.fromiter(
+                ((getrow(k, -1) if k is not None else -1) for k in keys),
+                np.int64,
+                count=n,
+            )
+
+        rows = sweep()
+        shard_of = np.repeat(np.arange(shards, dtype=np.int64), per)
+        hit = (rows >= 0) & ((rows // self.cap_s) == shard_of)
+        miss_shard = shard_of[~hit]
+        if len(miss_shard):
+            counts = np.bincount(miss_shard, minlength=shards)
+            st2 = self.stamp.reshape(shards, self.cap_s)
+            idle = ((st2 >= 0) & (st2 < self.tick - 8)).sum(axis=1)
+            free_n = np.asarray([len(f) for f in self._free_s])
+            short = int((counts - idle - free_n).max())
+            if short > 0 and self.cap_s + short <= self.hard_rows:
+                self._ensure_capacity_sharded(self.cap_s + short)
+                rows = sweep()  # growth renumbered every global index
+                hit = (rows >= 0) & ((rows // self.cap_s) == shard_of)
+        if hit.any():
+            self.stamp[rows[hit]] = self.tick
+        nhits = int(hit[:nr].sum())
+        scatter: list[int] = []
+        if force:
+            for i in force:
+                if hit[i]:
+                    scatter.append(i)
+            nhits -= len(scatter)
+            self.misses += len(scatter)
+        self.hits += nhits
+        alloc = np.nonzero(~hit)[0]
+        if len(alloc):
+            claimed = {
+                keys[i] for i in np.nonzero(hit)[0] if keys[i] is not None
+            }
+            cap_s = self.cap_s
+            order_s: list = [None] * shards
+            oi_s = [0] * shards
+            for i in alloc.tolist():
+                k = keys[i]
+                s = int(shard_of[i])
+                base = s * cap_s
+                transient = k is None
+                if k is not None:
+                    g = getrow(k, -1)
+                    if g >= 0:
+                        if g // cap_s == s:
+                            # duplicate key later in the batch: reuse the
+                            # row its first occurrence just claimed
+                            rows[i] = g
+                            continue
+                        if k in claimed:
+                            # the key's row legitimately belongs to
+                            # another position this call — score this
+                            # position from a transient copy
+                            transient = True
+                        else:
+                            # block membership changed since last tick:
+                            # migrate the row to this position's shard
+                            self.row_key[g] = None
+                            self.stamp[g] = -1
+                            self._free_s[g // cap_s].append(g % cap_s)
+                            del self.rows[k]
+                            self.row_entry.pop(k, None)
+                            self.shard_moves += 1
+                            if _is_pad_key(k):
+                                self.pad_live -= 1
+                freel = self._free_s[s]
+                if freel:
+                    r = base + freel.pop()
+                else:
+                    if order_s[s] is None:
+                        order_s[s] = np.argsort(
+                            self.stamp[base : base + cap_s], kind="stable"
+                        )
+                    order = order_s[s]
+                    oi = oi_s[s]
+                    while True:
+                        if oi >= len(order):
+                            # mirror of the replicated invariant guard:
+                            # cap_s >= per and at most `per` rows of a
+                            # shard carry this call's stamp, so an
+                            # evictable row always exists — fail loudly
+                            # rather than gather garbage later
+                            raise RuntimeError(
+                                "sharded arena assign invariant "
+                                f"violated: no evictable row in shard "
+                                f"{s} (per={per}, cap_s={cap_s})"
+                            )
+                        r = base + int(order[oi])
+                        oi += 1
+                        if self.stamp[r] != self.tick:
+                            break
+                    oi_s[s] = oi
+                    old = self.row_key[r]
+                    if old is not None:
+                        del self.rows[old]
+                        self.row_entry.pop(old, None)
+                        self.evictions += 1
+                        if _is_pad_key(old):
+                            self.pad_live -= 1
+                if transient:
+                    self.row_key[r] = None
+                    self._transients.append(r)
+                else:
+                    self.rows[k] = r
+                    self.row_key[r] = k
+                    claimed.add(k)
+                    if i >= nr:
+                        self.pad_live += 1
+                self.stamp[r] = self.tick
+                rows[i] = r
+                scatter.append(i)
+                if i < nr:
+                    self.misses += 1
         return rows, scatter
 
     def device_bytes(self) -> int:
-        """HBM footprint of ONE replica of this arena's device buffers.
-        Under a replicated mesh placement the total cost is this times
-        the device count (the worker's device_mesh varz does that
-        multiplication — ISSUE 13 HBM accounting)."""
-        return self.cap * self.row_bytes
+        """HBM footprint of this arena's buffers on ONE device: the full
+        capacity when replicated (total cost = this x device count — the
+        worker's device_mesh varz does that multiplication), one shard's
+        block when data-axis sharded (so the same multiplication yields
+        the SHARD-SUM — ISSUE 19 HBM accounting: adding chips adds
+        capacity, not copies)."""
+        return (self.cap // self.shards) * self.row_bytes
 
     def counters(self) -> dict:
         return {
             "hits": self.hits,
             "misses": self.misses,
             "evictions": self.evictions,
-            "rows_live": len(self.rows),
+            "rows_live": len(self.rows) - self.pad_live,
             "capacity_rows": self.cap,
+            "shard_moves": self.shard_moves,
         }
 
 
@@ -434,10 +730,14 @@ class StateArena(RowArena):
         season_len: int,
         max_bytes: int | None = None,
         sharding=None,
+        shards: int = 1,
     ):
         self.m = max(int(season_len), 1)
         super().__init__(
-            _row_bytes(self.m), max_bytes=max_bytes, sharding=sharding
+            _row_bytes(self.m),
+            max_bytes=max_bytes,
+            sharding=sharding,
+            shards=shards,
         )
 
     def _alloc(self, cap: int):
@@ -509,6 +809,13 @@ class StateArena(RowArena):
                 sc[k:] = sc[0]
                 nh[k:] = nh[0]
             self.state = _scatter(*self.state, idx, lvl, tr, se, ph, sc, nh)
+            if self.shards > 1:
+                # re-pin the block layout: GSPMD is free to solve the
+                # global-index scatter by resharding, and the warm
+                # gather's shard_map REQUIRES the data-axis blocks.
+                # device_put is the identity when the layout survived;
+                # scatter is the rare (miss/churn) path either way.
+                self.state = jax.device_put(self.state, self.sharding)
 
     def counters(self) -> dict:
         out = super().counters()
@@ -538,6 +845,7 @@ class TreeArena(RowArena):
         template,
         max_bytes: int | None = None,
         sharding=None,
+        shards: int = 1,
     ):
         """`template`: pytree of `jax.ShapeDtypeStruct` (or anything with
         .shape/.dtype) describing ONE row, without the capacity axis."""
@@ -548,7 +856,12 @@ class TreeArena(RowArena):
             * np.dtype(leaf.dtype).itemsize
             for leaf in leaves
         ) or 1
-        super().__init__(row_bytes, max_bytes=max_bytes, sharding=sharding)
+        super().__init__(
+            row_bytes,
+            max_bytes=max_bytes,
+            sharding=sharding,
+            shards=shards,
+        )
 
     def _min_rows(self) -> int:
         # joint rows are fat (an f=4 LSTM-AE row is ~60 KB vs the
@@ -595,3 +908,6 @@ class TreeArena(RowArena):
                 lambda *leaves: np.stack(leaves), *picked
             )
             self.state = _scatter_tree(self.state, idx, updates)
+            if self.shards > 1:
+                # same block-layout re-pin as StateArena.scatter
+                self.state = jax.device_put(self.state, self.sharding)

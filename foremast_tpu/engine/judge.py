@@ -366,6 +366,7 @@ class HealthJudge:
             "misses": 0,
             "evictions": 0,
             "fallbacks": 0,
+            "shard_moves": 0,
         }
         # Columnar batch-padding accounting (ISSUE 13): rows dispatched
         # vs rows that were padding (bucket rounding + data-axis
@@ -445,15 +446,28 @@ class HealthJudge:
         if arena is None or arena.m < m_need:
             if arena is not None:
                 self._retire_counters(arena)
-            arena = StateArena(m_need, sharding=self._arena_sharding())
+            arena = StateArena(
+                m_need,
+                sharding=self._arena_sharding(),
+                shards=self._arena_shards(),
+            )
             self._arenas[key] = arena
         return arena
 
     def _arena_sharding(self):
         """Placement for arena device buffers — None (default device)
-        here; ShardedJudge replicates over its mesh so the warm gather
-        never crosses devices (deliberate choice, VERDICT r4 weak #4)."""
+        here; ShardedJudge places over its mesh: data-axis block-sharded
+        by default (ISSUE 19 — capacity scales with the mesh), or fully
+        replicated when FOREMAST_ARENA_SHARDED is off / in pod mode."""
         return None
+
+    def _arena_shards(self) -> int:
+        """Number of data-axis blocks the arena row space splits into —
+        1 here (single device: the whole arena is one block);
+        ShardedJudge returns its data-axis size so each device hosts
+        exactly its batch block's rows and the warm gather is
+        device-local by construction (ISSUE 19)."""
+        return 1
 
     def _fetch(self, tree):
         """Device->host fetch for result decode — one overlapped
@@ -466,8 +480,8 @@ class HealthJudge:
         """Fold a dying arena's event counters into the monotone base so
         device_state_counters() never moves backwards across rebuilds."""
         c = arena.counters()
-        for k in ("hits", "misses", "evictions"):
-            self._counters_base[k] += c[k]
+        for k in ("hits", "misses", "evictions", "shard_moves"):
+            self._counters_base[k] += c.get(k, 0)
 
     def clear_device_state(self) -> None:
         """Release every arena's device buffers (e.g. after warmup: the
@@ -488,8 +502,9 @@ class HealthJudge:
         agg = dict(self._counters_base, rows_live=0)
         for arena in self._arenas.values():
             c = arena.counters()
-            for k in ("hits", "misses", "evictions", "rows_live"):
-                agg[k] += c[k]
+            for k in ("hits", "misses", "evictions", "rows_live",
+                      "shard_moves"):
+                agg[k] += c.get(k, 0)
         return agg
 
     def _score_with_fit_cache(
@@ -640,7 +655,9 @@ class HealthJudge:
             if puts:
                 self.fit_cache.put_many(puts)
 
-    def _arena_score(self, batch, keys, entries, force, gap, pw):
+    def _arena_score(
+        self, batch, keys, entries, force, gap, pw, n_real=None
+    ):
         """Arena-gathered judgment shared by the object and columnar
         paths: assign rows, widen-rebuild if a scattered row carries a
         longer season buffer than the arena was built for, scatter the
@@ -665,7 +682,7 @@ class HealthJudge:
                 rows=len(keys),
                 device=True,
             ):
-                assigned = arena.assign(keys, force)
+                assigned = arena.assign(keys, force, n_real)
                 if assigned is not None and assigned[1]:
                     m_scat = max(len(entries[i][2]) for i in assigned[1])
                     if m_scat > arena.m:
@@ -673,13 +690,30 @@ class HealthJudge:
                         # rebuild (empty) at the new width and re-assign
                         # everything
                         arena = self._arena_for(m_scat)
-                        assigned = arena.assign(keys, force)
+                        assigned = arena.assign(keys, force, n_real)
                     if assigned is not None and assigned[1]:
                         arena.scatter(assigned[0], assigned[1], entries)
             if assigned is not None:
                 with span(
                     "judge.score", stage="score", rows=len(keys), device=True
                 ):
+                    if arena.shards > 1:
+                        # data-axis-sharded arena (ISSUE 19): hand the
+                        # program LOCAL row indices, placed over the
+                        # mesh like every other [B] operand, and gather
+                        # via the device-local shard_map program
+                        (rows_dev,) = self._place_cols(
+                            (np.asarray(assigned[0]) % arena.cap_s)
+                            .astype(np.int32)
+                        )
+                        return scoring.score_from_arena_sharded(
+                            batch,
+                            *arena.state,
+                            rows_dev,
+                            mesh=arena.sharding.mesh,
+                            gap_steps=gap,
+                            **pw,
+                        )
                     return scoring.score_from_arena(
                         batch,
                         *arena.state,
@@ -839,7 +873,20 @@ class HealthJudge:
             thr = np.concatenate([thr, np.ones(pad, np.float32)])
             bound = np.concatenate([bound, np.ones(pad, np.int32)])
             mlb = np.concatenate([mlb, np.zeros(pad, np.float32)])
-            keys = list(keys) + [_PAD_COL_KEY] * pad
+            shards = self._arena_shards()
+            if shards > 1:
+                # shard-qualified pad keys (ISSUE 19): pad positions land
+                # in whatever data-axis block the tail falls in, which
+                # varies with b0 — one stable pad row PER SHARD keeps the
+                # warm path scatter-free where a single shared key would
+                # migrate between blocks every tick
+                per = rows_b // shards
+                keys = list(keys) + [
+                    _PAD_COL_KEY + "@" + str((b0 + j) // per)
+                    for j in range(pad)
+                ]
+            else:
+                keys = list(keys) + [_PAD_COL_KEY] * pad
             entries = list(entries) + [_PAD_ENTRY] * pad
             if gap_steps is not None:
                 gap_steps = np.concatenate(
@@ -911,7 +958,7 @@ class HealthJudge:
             min_friedman=cfg.pairwise.min_friedman_points,
         )
         gap = None if gap_steps is None else jnp.asarray(gap_steps)
-        res = self._arena_score(batch, keys, entries, (), gap, pw)
+        res = self._arena_score(batch, keys, entries, (), gap, pw, b0)
         # dispatch the compact program too (still async): the pending
         # handle holds only the small result-shaped device arrays, so a
         # pipelined caller queues O(depth) compact outputs, never whole

@@ -36,6 +36,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -53,6 +54,7 @@ from foremast_tpu.engine.judge import (
 from foremast_tpu.models.bivariate import (
     detect_bivariate,
     detect_bivariate_from_rows,
+    detect_bivariate_from_rows_sharded,
     fit_bivariate,
     fit_bivariate_bf16_delta,
 )
@@ -293,11 +295,42 @@ def lstm_joint_score_from_rows(state, rows, x, mask, cut, cutoff, hi_cutoff, gap
     ae_flags, _err = score_rows_cutoff(
         state["ae"], rows, x, mask[:, None, :], cut
     )
-    ae_flags = ae_flags[:, 0, :]
     st = jax.tree.map(
         lambda leaf: jnp.take(leaf, rows, axis=0),
         {k: v for k, v in state.items() if k != "ae"},
     )
+    return _lstm_joint_judgment(
+        ae_flags[:, 0, :], st, x, mask, cutoff, hi_cutoff, gaps
+    )
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def lstm_joint_score_from_rows_sharded(
+    state, rows, x, mask, cut, cutoff, hi_cutoff, gaps, mesh=None
+):
+    """`lstm_joint_score_from_rows` against a DATA-AXIS-SHARDED
+    TreeArena (ISSUE 19): every leaf (the stacked AEParams included)
+    block-shards its [capacity] leading axis over `mesh`'s data axis
+    and `rows` [S] carries LOCAL (per-shard) indices, so the whole-tree
+    gather runs as one shard_map against each device's own block —
+    zero cross-chip transfer — before the identical judgment tail."""
+    from foremast_tpu.parallel import mesh as meshlib
+
+    gathered = meshlib.shard_rows_take(state, rows, mesh)
+    ae_flags, _err = score_many_cutoff(
+        gathered["ae"], x, mask[:, None, :], cut
+    )
+    st = {k: v for k, v in gathered.items() if k != "ae"}
+    return _lstm_joint_judgment(
+        ae_flags[:, 0, :], st, x, mask, cutoff, hi_cutoff, gaps
+    )
+
+
+def _lstm_joint_judgment(ae_flags, st, x, mask, cutoff, hi_cutoff, gaps):
+    """Shared scoring tail of the two from-rows LSTM programs: HW gap
+    advance, echo-robust residual-MVN distance, confirmation-band
+    corroboration. `ae_flags` [S, tc]; `st` the gathered per-batch (not
+    per-capacity) non-AE state dict."""
     s, f = x.shape[0], x.shape[-1]
     m = st["season"].shape[-1]
     gap = gaps.astype(jnp.int32)
@@ -378,6 +411,7 @@ class MultivariateJudge:
             "hits": 0,
             "misses": 0,
             "evictions": 0,
+            "shard_moves": 0,
             "fallbacks": 0,
         }
         # joint columnar batch-padding accounting (ISSUE 13) — the
@@ -1138,6 +1172,14 @@ class MultivariateJudge:
         uni = self.univariate
         return uni._arena_sharding() if isinstance(uni, HealthJudge) else None
 
+    def _joint_shards(self) -> int:
+        """Row-space shard count for joint arenas — the univariate
+        judge's (ISSUE 19): joint TreeArenas block-partition their row
+        space over the same data axis as the batch buffers, so warm
+        joint gathers are device-local like the univariate path."""
+        uni = self.univariate
+        return uni._arena_shards() if isinstance(uni, HealthJudge) else 1
+
     def _joint_multiple(self) -> int:
         """Joint batch leading-axis multiple — the univariate judge's
         (a ShardedJudge's data-axis size), so the joint from-rows
@@ -1175,15 +1217,19 @@ class MultivariateJudge:
                 if mode == "bivariate"
                 else self._lstm_template(f, m_need)
             )
-            arena = TreeArena(template, sharding=self._joint_sharding())
+            arena = TreeArena(
+                template,
+                sharding=self._joint_sharding(),
+                shards=self._joint_shards(),
+            )
             arena.season_m = m_need
             self._joint_arenas[key] = arena
         return arena
 
     def _retire_joint(self, arena) -> None:
         c = arena.counters()
-        for k in ("hits", "misses", "evictions"):
-            self._joint_counters_base[k] += c[k]
+        for k in ("hits", "misses", "evictions", "shard_moves"):
+            self._joint_counters_base[k] += c.get(k, 0)
 
     def joint_state_counters(self) -> dict:
         """Aggregated joint-arena counters, monotone across rebuilds
@@ -1191,8 +1237,15 @@ class MultivariateJudge:
         agg = dict(self._joint_counters_base, rows_live=0, capacity_rows=0)
         for arena in self._joint_arenas.values():
             c = arena.counters()
-            for k in ("hits", "misses", "evictions", "rows_live", "capacity_rows"):
-                agg[k] += c[k]
+            for k in (
+                "hits",
+                "misses",
+                "evictions",
+                "shard_moves",
+                "rows_live",
+                "capacity_rows",
+            ):
+                agg[k] += c.get(k, 0)
         return agg
 
     def _row_tree(self, mode: str, entry, m: int):
@@ -1242,6 +1295,14 @@ class MultivariateJudge:
             else max(e[3][2].shape[-1] for e in entries)
         )
         arena = self._joint_arena_for(mode, f, m_need)
+        # batch target shape FIRST (pow2 bucket + data-axis rounding,
+        # same rule as judge_columnar) — a sharded arena's assign must
+        # see the PADDED position list, because row placement is a
+        # function of position // (B / shards)
+        sb = bucket_length(s0)
+        mult = self._joint_multiple()
+        if mult > 1 and sb % mult:
+            sb += mult - sb % mult
         rows = None
         state = None
         if arena is not None:
@@ -1251,22 +1312,35 @@ class MultivariateJudge:
                 for i, (k, e) in enumerate(zip(keys, entries))
                 if re_.get(k) is not None and re_.get(k) is not e
             ]
+            keys_a, entries_a = keys, entries
+            if arena.shards > 1 and sb != s0:
+                # shard-qualified pad keys (ISSUE 19): one stable pad
+                # row per data-axis block (same contract as the
+                # univariate "__pad__col__@N" family — a single shared
+                # key would migrate between blocks as s0 jitters);
+                # mask all-False keeps the pad rows' flags inert
+                per = sb // arena.shards
+                keys_a = list(keys) + [
+                    f"__pad__joint__@{(s0 + j) // per}"
+                    for j in range(sb - s0)
+                ]
+                entries_a = list(entries) + [entries[0]] * (sb - s0)
             with span(
                 "judge.arena_assemble",
                 stage="arena_assemble",
                 rows=s0,
                 device=True,
             ):
-                assigned = arena.assign(keys, force)
+                assigned = arena.assign(keys_a, force, s0)
                 if assigned is not None:
                     rows_idx, scat = assigned
                     if scat:
-                        trees = [None] * len(entries)
+                        trees = [None] * len(entries_a)
                         for i in scat:
                             trees[i] = self._row_tree(
-                                mode, entries[i], arena.season_m
+                                mode, entries_a[i], arena.season_m
                             )
-                            re_[keys[i]] = entries[i]
+                            re_[keys_a[i]] = entries_a[i]
                         arena.scatter(rows_idx, scat, trees)
                     state = arena.state
                     rows = rows_idx
@@ -1290,15 +1364,13 @@ class MultivariateJudge:
                 lambda *ls: jnp.asarray(np.stack(ls)), *trees
             )
             rows = np.arange(s0, dtype=np.int64)
-        sb = bucket_length(s0)
         # data-axis rounding (ISSUE 13): same rule as judge_columnar —
         # a sharded univariate judge means the joint programs partition
-        # over the same mesh, so S must divide by its data axis (pad
-        # rows duplicate row 0 with an all-False mask: flags all-False,
-        # dropped on the [:s0] decode)
-        mult = self._joint_multiple()
-        if mult > 1 and sb % mult:
-            sb += mult - sb % mult
+        # over the same mesh, so S must divide by its data axis. A
+        # sharded arena assigned real pad rows above (rows is already
+        # sb-long); the replicated/stacked layouts pad by duplicating
+        # row 0 with an all-False mask: flags all-False, dropped on the
+        # [:s0] decode.
         self.batch_rows_total += sb
         self.pad_rows_total += sb - s0
         if sb != s0:
@@ -1307,10 +1379,32 @@ class MultivariateJudge:
                 [cur, np.zeros((pad, f, tcb), np.float32)]
             )
             mask = np.concatenate([mask, np.zeros((pad, tcb), bool)])
-            rows = np.concatenate([rows, np.full(pad, rows[0], rows.dtype)])
+            if len(rows) != sb:
+                rows = np.concatenate(
+                    [rows, np.full(pad, rows[0], rows.dtype)]
+                )
             if gaps is not None:
                 gaps = np.concatenate([gaps, np.zeros(pad, np.int32)])
-        rows_j = jnp.asarray(rows)
+        # sharded-arena dispatch (ISSUE 19): when the joint arena row
+        # space is block-partitioned over the data axis, ship LOCAL
+        # (per-shard) indices through the same placement hook as the
+        # batch buffers and run the shard_map from-rows programs —
+        # device-local gather, zero cross-chip transfer. The stacked
+        # fallback (state is not arena.state) keeps global rows + the
+        # replicated programs.
+        sharded = (
+            arena is not None
+            and arena.shards > 1
+            and state is arena.state
+        )
+        if sharded:
+            (rows_j,) = self._place_joint(
+                (rows % arena.cap_s).astype(np.int32)
+            )
+            rows_j = jnp.asarray(rows_j)
+            mesh = self.univariate.mesh
+        else:
+            rows_j = jnp.asarray(rows)
         with span(
             "judge.score", stage="score", rows=sb, device=True
         ):
@@ -1318,15 +1412,27 @@ class MultivariateJudge:
                 bx, by, bm = self._place_joint(
                     cur[:, 0], cur[:, 1], mask
                 )
-                flags = detect_bivariate_from_rows(
-                    state["mean"],
-                    state["cov"],
-                    rows_j,
-                    jnp.asarray(bx),
-                    jnp.asarray(by),
-                    jnp.asarray(bm),
-                    jnp.full((sb,), thr, jnp.float32),
-                )
+                if sharded:
+                    flags = detect_bivariate_from_rows_sharded(
+                        state["mean"],
+                        state["cov"],
+                        rows_j,
+                        jnp.asarray(bx),
+                        jnp.asarray(by),
+                        jnp.asarray(bm),
+                        jnp.full((sb,), thr, jnp.float32),
+                        mesh=mesh,
+                    )
+                else:
+                    flags = detect_bivariate_from_rows(
+                        state["mean"],
+                        state["cov"],
+                        rows_j,
+                        jnp.asarray(bx),
+                        jnp.asarray(by),
+                        jnp.asarray(bm),
+                        jnp.full((sb,), thr, jnp.float32),
+                    )
             else:
                 thr_arr = np.full(sb, thr, np.float32)
                 cut = ae_cutoff(
@@ -1344,19 +1450,31 @@ class MultivariateJudge:
                     np.ascontiguousarray(cur.transpose(0, 2, 1))[:, None],
                     mask,
                 )
-                flags = lstm_joint_score_from_rows(
-                    state,
-                    rows_j,
-                    jnp.asarray(xh),
-                    jnp.asarray(mh),
-                    jnp.asarray(cut),
-                    jnp.asarray(cutoff),
-                    jnp.asarray(hi),
-                    jnp.asarray(
-                        gaps
-                        if gaps is not None
-                        else np.zeros(sb, np.int32)
-                    ),
+                gaps_j = jnp.asarray(
+                    gaps if gaps is not None else np.zeros(sb, np.int32)
                 )
+                if sharded:
+                    flags = lstm_joint_score_from_rows_sharded(
+                        state,
+                        rows_j,
+                        jnp.asarray(xh),
+                        jnp.asarray(mh),
+                        jnp.asarray(cut),
+                        jnp.asarray(cutoff),
+                        jnp.asarray(hi),
+                        gaps_j,
+                        mesh=mesh,
+                    )
+                else:
+                    flags = lstm_joint_score_from_rows(
+                        state,
+                        rows_j,
+                        jnp.asarray(xh),
+                        jnp.asarray(mh),
+                        jnp.asarray(cut),
+                        jnp.asarray(cutoff),
+                        jnp.asarray(hi),
+                        gaps_j,
+                    )
         with span("judge.decode", stage="decode", rows=sb, device=True):
             return np.asarray(flags)[:s0]

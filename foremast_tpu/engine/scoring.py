@@ -633,6 +633,64 @@ def score_from_arena(
     )
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "mesh",
+        "pairwise_algorithm",
+        "p_threshold",
+        "min_mw",
+        "min_wilcoxon",
+        "min_kruskal",
+        "min_friedman",
+    ),
+)
+def score_from_arena_sharded(
+    batch: ScoreBatch,
+    level: jax.Array,
+    trend: jax.Array,
+    season: jax.Array,
+    season_phase: jax.Array,
+    scale: jax.Array,
+    n_hist: jax.Array,
+    rows: jax.Array,
+    mesh=None,
+    gap_steps: jax.Array | None = None,
+    pairwise_algorithm: str = PAIRWISE_ALL,
+    p_threshold: float = 0.05,
+    min_mw: int = 20,
+    min_wilcoxon: int = 20,
+    min_kruskal: int = 5,
+    min_friedman: int = 20,
+) -> ScoreResult:
+    """`score_from_arena` against a DATA-AXIS-SHARDED arena (ISSUE 19).
+
+    The arena's [capacity] leading axis is block-sharded over `mesh`'s
+    data axis and the judge's block placement rule guarantees every
+    batch position's row lives on the device holding that position, so
+    `rows` [B] carries LOCAL (per-shard) indices and the gather runs as
+    a shard_map — device-local by construction, zero cross-chip
+    transfer on a warm tick (the replicated variant achieved the same
+    by paying capacity_bytes of HBM on every device). Semantics are
+    exactly `score_from_state` of the gathered rows."""
+    from foremast_tpu.parallel import mesh as meshlib
+
+    gathered = meshlib.shard_rows_take(
+        (level, trend, season, season_phase, scale, n_hist), rows, mesh
+    )
+    return score_from_state(
+        batch,
+        *gathered,
+        gap_steps=gap_steps,
+        pairwise_algorithm=pairwise_algorithm,
+        p_threshold=p_threshold,
+        min_mw=min_mw,
+        min_wilcoxon=min_wilcoxon,
+        min_kruskal=min_kruskal,
+        min_friedman=min_friedman,
+    )
+
+
 # -- anchor-shifted bf16-delta history storage (FOREMAST_BF16_DELTA) ---------
 #
 # The headline kernel is HBM-bound on the [B, 10080] f32 history read

@@ -3325,13 +3325,21 @@ class BrainWorker:
         }
 
     def _device_mesh_state(self) -> dict | None:
-        """The /debug/state `device_mesh` section (ISSUE 13): mesh
-        shape, padded-row fraction across the univariate AND joint
-        columnar dispatches, replicated-arena HBM accounting (one
-        replica's bytes x device count — replication is the deliberate
-        trade from batch.py:_arena_sharding, so its cost must be
-        readable, not implied), and the H2D/gather roofline counters.
-        None when the judge is single-device."""
+        """The /debug/state `device_mesh` section (ISSUE 13, arena
+        accounting resharded by ISSUE 19): mesh shape, padded-row
+        fraction across the univariate AND joint columnar dispatches,
+        arena HBM accounting, and the H2D/gather roofline counters.
+        None when the judge is single-device.
+
+        `arena_replica_bytes` is PER-DEVICE arena bytes in either
+        layout (one replica when replicated; one row-space block when
+        sharded — RowArena.device_bytes divides by the shard count), so
+        `arena_total_device_bytes` = per-device x device count is the
+        fleet-wide HBM bill in both: the replication tax when
+        FOREMAST_ARENA_SHARDED=0, the SHARD-SUM (= one logical copy,
+        the capacity win) by default. `arena_layout` says which is in
+        force; `arena_capacity_rows` is the aggregate row capacity
+        across all arenas."""
         uni = self._uni
         if uni is None or not hasattr(uni, "mesh_debug"):
             return None
@@ -3344,14 +3352,13 @@ class BrainWorker:
             out["padded_row_fraction"] = (
                 round(pads / rows, 4) if rows else None
             )
-        replica = sum(
-            a.device_bytes() for a in uni._arenas.values()
-        )
+        arenas = list(uni._arenas.values())
         if self._mvj is not None:
-            replica += sum(
-                a.device_bytes()
-                for a in self._mvj._joint_arenas.values()
-            )
+            arenas += list(self._mvj._joint_arenas.values())
+        replica = sum(a.device_bytes() for a in arenas)
+        shards = getattr(uni, "_arena_shards", lambda: 1)()
+        out["arena_layout"] = "sharded" if shards > 1 else "replicated"
+        out["arena_capacity_rows"] = sum(a.cap for a in arenas)
         out["arena_replica_bytes"] = replica
         out["arena_total_device_bytes"] = replica * out["devices"]
         return out
@@ -3428,10 +3435,11 @@ class BrainWorker:
             # LSTM-AE params + residual-MVN state); None when the judge
             # has no joint dispatch
             "joint_arena": joint_arena,
-            # device mesh (ISSUE 13, FOREMAST_DEVICE_MESH): mesh shape,
-            # padded-row fraction, replicated-arena HBM (one replica x
-            # device count), H2D/gather roofline counters; None when
-            # the judge runs single-device
+            # device mesh (ISSUE 13/19, FOREMAST_DEVICE_MESH): mesh
+            # shape, padded-row fraction, arena layout + HBM accounting
+            # (per-device bytes x device count = shard-sum when sharded,
+            # replication tax when FOREMAST_ARENA_SHARDED=0), H2D/gather
+            # roofline counters; None when the judge runs single-device
             "device_mesh": self._device_mesh_state(),
             # push-based ingest plane (FOREMAST_INGEST=1): series
             # resident, bytes, evictions, hit ratio, receiver lag,

@@ -152,3 +152,32 @@ def detect_bivariate_from_rows(
         valid=jnp.ones(rows.shape, bool),
     )
     return detect_bivariate(fit, x, y, mask, threshold)
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def detect_bivariate_from_rows_sharded(
+    mean: jax.Array,
+    cov: jax.Array,
+    rows: jax.Array,
+    x: jax.Array,
+    y: jax.Array,
+    mask: jax.Array,
+    threshold: jax.Array,
+    mesh=None,
+) -> jax.Array:
+    """`detect_bivariate_from_rows` against a DATA-AXIS-SHARDED arena
+    (ISSUE 19): `mean`/`cov` block-shard their [capacity] leading axis
+    over `mesh`'s data axis and `rows` [B] carries LOCAL (per-shard)
+    indices — the judge's block placement rule guarantees each batch
+    position's fit lives on the device holding that position, so the
+    gather runs as a shard_map against each device's OWN block: zero
+    cross-chip transfer, without replication's per-device HBM copy."""
+    from foremast_tpu.parallel import mesh as meshlib
+
+    g = meshlib.shard_rows_take({"mean": mean, "cov": cov}, rows, mesh)
+    fit = BivariateFit(
+        mean=g["mean"],
+        cov=g["cov"],
+        valid=jnp.ones(rows.shape, bool),
+    )
+    return detect_bivariate(fit, x, y, mask, threshold)

@@ -315,15 +315,17 @@ class ModelCache:
 # provisional-fit record for one would chase a document that does not
 # exist. Every sink that records fits filters through this predicate.
 PAD_FIT_MARKERS = frozenset({"__pad__", "__pad__col__"})
+# the whole family is prefix-matched: sharded arenas (ISSUE 19) qualify
+# pad keys per data-axis block ("__pad__@3", "__pad__col__@3") so each
+# shard keeps one stable pad row — still dispatch artifacts, never state
+_PAD_FIT_PREFIX = "__pad__"
 
 
 def is_pad_fit_key(key) -> bool:
     """True when `key` is (or wraps) a judge batch-padding fit key."""
     if isinstance(key, tuple):
-        return bool(key) and (
-            key[-1] in PAD_FIT_MARKERS or is_pad_fit_key(key[-1])
-        )
-    return key in PAD_FIT_MARKERS
+        return bool(key) and is_pad_fit_key(key[-1])
+    return isinstance(key, str) and key.startswith(_PAD_FIT_PREFIX)
 
 
 class FitJournal:

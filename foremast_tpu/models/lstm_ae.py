@@ -295,12 +295,23 @@ def score_rows_cutoff(params, rows, x, mask, cutoff):
     return score_many_cutoff(gathered, x, mask, cutoff)
 
 
-# Mesh-placement contract for the from-rows entry points (ISSUE 13):
-# every computation above is per-row independent along the leading [S]
-# axis (vmapped scoring, axis-0 gathers), so callers may pass `x`/`mask`
-# with their leading axis sharded over a mesh's data axis and `params`
-# replicated (the TreeArena's placement) — XLA partitions the program
-# with zero collectives; the per-row gather runs against each device's
-# local replica. S must be a multiple of the data axis (the judge's
-# batch rounding guarantees it). Nothing here may ever reduce ACROSS
-# the [S] axis, or the contract breaks.
+# Mesh-placement contract for the from-rows entry points (ISSUE 13,
+# arena layout updated by ISSUE 19): every computation above is per-row
+# independent along the leading [S] axis (vmapped scoring, axis-0
+# gathers), so callers may pass `x`/`mask` with their leading axis
+# sharded over a mesh's data axis and XLA partitions the program with
+# zero collectives. The arena `params` stack arrives in one of two
+# layouts:
+#   - sharded (default): each leaf's [capacity] axis block-shards over
+#     the SAME data axis and `rows` carries LOCAL (per-shard) indices —
+#     the judge's block placement rule puts every batch position's row
+#     on the device holding that position, so the gather runs inside
+#     shard_map against each device's own block (see
+#     multivariate.lstm_joint_score_from_rows_sharded).
+#   - replicated (FOREMAST_ARENA_SHARDED=0, pod mode): `params` fully
+#     replicated, `rows` global — the gather reads each device's local
+#     replica (the ISSUE 13 layout).
+# Either way: zero cross-chip transfer per warm tick. S must be a
+# multiple of the data axis (the judge's batch rounding guarantees it).
+# Nothing here may ever reduce ACROSS the [S] axis, or the contract
+# breaks.

@@ -171,10 +171,15 @@ def _d2(state: MVNState, cur: jax.Array, upd: jax.Array) -> jax.Array:
     — but cannot contaminate later predictions); the phase advances
     either way (hw_continue mask semantics).
 
-    Mesh contract (ISSUE 13): per-row independent along [B] — the
-    [B*F] reshape below multiplies the leading axis, which a data-axis
-    sharding of `cur` follows cleanly (B a multiple of the axis), and
-    the per-job `linalg.solve` batches row-locally. Nothing here may
+    Mesh contract (ISSUE 13; gathered-state layouts in ISSUE 19):
+    per-row independent along [B] — the [B*F] reshape below multiplies
+    the leading axis, which a data-axis sharding of `cur` follows
+    cleanly (B a multiple of the axis), and the per-job `linalg.solve`
+    batches row-locally. The MVNState rows arrive already gathered per
+    batch position — from a replicated arena via a global take, or from
+    a data-axis-SHARDED arena via the shard_map local gather in
+    `multivariate.lstm_joint_score_from_rows_sharded` — either way the
+    state leading axis shards exactly like `cur`. Nothing here may
     reduce across [B]."""
     b, f, tc = cur.shape
     a, bt, g = HW_PARAMS

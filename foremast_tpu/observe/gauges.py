@@ -287,7 +287,8 @@ class WorkerMetrics:
         self.arena = Counter(
             "foremast_worker_arena_events_total",
             "device state-arena row events (hit=gathered warm, "
-            "miss=scattered, eviction=row recycled under pressure)",
+            "miss=scattered, eviction=row recycled under pressure, "
+            "shard_move=row re-homed when its batch shard changed)",
             ["event"],
             registry=reg,
         )
@@ -305,6 +306,7 @@ class WorkerMetrics:
             "hits": 0,
             "misses": 0,
             "evictions": 0,
+            "shard_moves": 0,
             "fallbacks": 0,
         }
         # chunk-pipeline occupancy (jobs/pipeline.py), by path: the
@@ -400,9 +402,10 @@ class WorkerMetrics:
         # device mesh (ISSUE 13, FOREMAST_DEVICE_MESH): the Prometheus
         # twins of the /debug/state `device_mesh` section — mesh width,
         # batch rows split real/pad (pad fraction = pad / (real+pad);
-        # the <2% overhead bar at fleet shapes), replicated-arena HBM
-        # (one replica x device count), and the H2D-place / host-gather
-        # roofline legs
+        # the <2% overhead bar at fleet shapes), arena HBM (per-device
+        # bytes x device count — shard-sum under the default sharded
+        # layout, ISSUE 19), and the H2D-place / host-gather roofline
+        # legs
         self.mesh_devices = Gauge(
             "foremast_device_mesh_devices",
             "devices in the judge's (data x model) mesh (1 family "
@@ -513,7 +516,13 @@ class WorkerMetrics:
         HealthJudge._counters_base), so no re-baseline heuristic is
         needed — a negative delta can only mean a new judge instance and
         is clamped to zero rather than guessed at."""
-        for event in ("hits", "misses", "evictions", "fallbacks"):
+        for event in (
+            "hits",
+            "misses",
+            "evictions",
+            "shard_moves",
+            "fallbacks",
+        ):
             cur = counters.get(event, 0)
             delta = cur - self._arena_last[event]
             if delta > 0:

@@ -117,7 +117,8 @@ FAMILY_DOCS: dict[str, str] = {
         "histogram of the whole claim-fetch-judge-write cycle"
     ),
     "foremast_worker_arena_events": (
-        "device state-arena traffic (hits/misses/evictions/fallbacks)"
+        "device state-arena traffic (hits/misses/evictions/"
+        "shard_moves/fallbacks)"
     ),
     "foremast_worker_fast_docs": (
         "documents scored on the columnar fast path, by model kind "
@@ -172,7 +173,9 @@ FAMILY_DOCS: dict[str, str] = {
         "(bucket + data-axis rounding)"
     ),
     "foremast_device_mesh_arena_bytes": (
-        "replicated state-arena HBM: one replica's bytes x device count"
+        "state-arena HBM: per-device bytes x device count (shard-sum "
+        "under the default sharded layout; the replication tax with "
+        "FOREMAST_ARENA_SHARDED=0)"
     ),
     "foremast_device_mesh_transfer_seconds": (
         "sharded-judge host<->device wall-clock by leg (h2d placement "

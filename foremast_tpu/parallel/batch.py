@@ -82,9 +82,11 @@ class ShardedJudge(HealthJudge):
     the ScoreBatch pytree through `_place`, partitioning like every
     other [B, tc] operand) plus, through `_place_cols`, the joint
     from-rows programs — rides the mesh. Placement only:
-    batches shard their leading axis over `data`, arenas replicate
-    (`_arena_sharding`), so admission, fit-cache identity and every
-    degradation contract are untouched. A 1-device mesh is the identity
+    batches shard their leading axis over `data` and arenas shard their
+    ROW space over the same axis (`_arena_sharding` / `_arena_shards`,
+    ISSUE 19 — FOREMAST_ARENA_SHARDED=0 restores the replicated
+    layout), so admission, fit-cache identity and every degradation
+    contract are untouched. A 1-device mesh is the identity
     (the worker skips this wrapper then — parallel.mesh.
     worker_device_mesh).
     """
@@ -93,6 +95,7 @@ class ShardedJudge(HealthJudge):
         super().__init__(config)
         self.mesh = mesh if mesh is not None else meshlib.make_mesh()
         self.n_data = int(self.mesh.shape[meshlib.DATA_AXIS])
+        self._arena_shards_n = self._resolve_arena_shards()  # foremast: sharded-arena
         # roofline accounting (benchmarks/scaleout_bench.py sharded
         # variant): wall-clock + bytes of the two host<->device hops the
         # mesh changes — H2D placement and the sharded-result gather.
@@ -168,18 +171,51 @@ class ShardedJudge(HealthJudge):
                for k, v in self.mesh_stats.items()},
         }
 
+    # foremast: sharded-arena
+    def _resolve_arena_shards(self) -> int:
+        """How many data-axis blocks the arena row space splits into,
+        decided ONCE at construction (env mutation mid-process must not
+        flip a live judge's layout). n_data by default; 1 (replicated)
+        when FOREMAST_ARENA_SHARDED is off, or under multi-controller —
+        a pod judge's sharded arena would leave most blocks on
+        non-addressable devices, and pod row assignment relies on every
+        process deriving identical maps (parallel/distributed.py), so
+        pods keep the PR 13 replicated layout."""
+        import os
+
+        raw = (
+            (os.environ.get("FOREMAST_ARENA_SHARDED") or "1")
+            .strip()
+            .lower()
+        )
+        if raw in ("0", "off", "false", "no", "none", "disabled"):
+            return 1
+        if jax.process_count() > 1:
+            return 1
+        return self.n_data
+
+    # foremast: sharded-arena
+    def _arena_shards(self) -> int:
+        return self._arena_shards_n
+
+    # foremast: sharded-arena
     def _arena_sharding(self):
-        # Deliberate arena placement (VERDICT r4 weak #4): REPLICATE the
-        # state rows over the mesh. The batch is sharded over `data`, so
-        # each device gathers its rows from its local replica — zero
-        # cross-device traffic on the warm path; the cost is one
-        # broadcast per scattered row (rare: misses/churn only) and
-        # capacity_bytes of HBM per device. Sharding rows over the mesh
-        # instead would save that HBM but turn EVERY warm gather into an
-        # all-to-all across ICI/DCN — the wrong trade for a structure
-        # whose whole point is making warm ticks free.
+        # Arena placement (ISSUE 19, superseding the VERDICT r4 weak #4
+        # replication): SHARD the state rows over the mesh's data axis,
+        # in the same contiguous blocks as the batch. The judge's block
+        # placement rule (engine.arena._assign_sharded) puts position
+        # i's row on the device that holds batch position i, so the
+        # warm gather stays device-local — the property replication
+        # bought — while aggregate capacity scales linearly with the
+        # mesh instead of being bounded by ONE chip's HBM (the exact
+        # inverse the million-service north star needs, ROADMAP item
+        # 2). The replicated layout survives behind
+        # FOREMAST_ARENA_SHARDED=0 and remains the pod-mode layout
+        # (_resolve_arena_shards).
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        if self._arena_shards_n > 1:
+            return NamedSharding(self.mesh, P(meshlib.DATA_AXIS))
         return NamedSharding(self.mesh, P())
 
     def _fetch(self, tree):
@@ -220,20 +256,34 @@ class ShardedJudge(HealthJudge):
         if target != b:
             empty = np.zeros(0, np.float32)
             et = np.zeros(0, np.int64)
-            pad_task = MetricTask(
-                job_id="__pad__",
-                alias="__pad__",
-                metric_type=None,
-                hist_times=et,
-                hist_values=empty,
-                cur_times=et,
-                cur_values=empty,
-                # constant fit-cache key: the empty-history "fit" (n=0 ->
-                # UNKNOWN, dropped below) caches once, so warm re-check
-                # ticks stay fit-free even when the batch needs padding
-                fit_key="__pad__",
-            )
-            tasks = list(tasks) + [pad_task] * (target - b)
+            # constant fit-cache keys: the empty-history "fit" (n=0 ->
+            # UNKNOWN, dropped below) caches once, so warm re-check
+            # ticks stay fit-free even when the batch needs padding.
+            # Sharded arenas get one pad key PER data-axis block (the
+            # tail positions' blocks move with b, and a single key would
+            # migrate between shards every call); models.cache
+            # .is_pad_fit_key matches the whole "__pad__*" family, so
+            # none of them ever journals or chases a document.
+            # foremast: sharded-arena
+            shards = self._arena_shards()
+            per = target // shards
+
+            def pad_task(pos: int) -> MetricTask:
+                fk = "__pad__" if shards == 1 else f"__pad__@{pos // per}"
+                return MetricTask(
+                    job_id="__pad__",
+                    alias="__pad__",
+                    metric_type=None,
+                    hist_times=et,
+                    hist_values=empty,
+                    cur_times=et,
+                    cur_values=empty,
+                    fit_key=fk,
+                )
+
+            tasks = list(tasks) + [
+                pad_task(pos) for pos in range(b, target)
+            ]
         out = super()._judge_bucket(tasks, th, tc)
         return out[:b]
 

@@ -318,9 +318,11 @@ class PodWorker(BrainWorker):
     """
 
     # Knob-level arena interaction only (budget read on the leader,
-    # identical set on every host) — honors the replicated placement,
-    # no row access involved.
-    # foremast: replicated-arena
+    # identical set on every host) — no row access involved; pod mode
+    # always runs replicated arenas (batch.py:_resolve_arena_shards
+    # forces shards=1 when process_count > 1), which trivially honors
+    # the row-placement contract.
+    # foremast: sharded-arena
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         from foremast_tpu.engine.arena import (

@@ -68,6 +68,33 @@ def pad_to_multiple(n: int, k: int) -> int:
     return ((n + k - 1) // k) * k
 
 
+def shard_rows_take(tree, rows, mesh: Mesh):
+    """Device-local row gather from data-axis-sharded arena state
+    (ISSUE 19). `tree` leaves are [capacity, ...] arrays block-sharded
+    over DATA_AXIS (capacity = n_data * cap_s); `rows` [B] holds LOCAL
+    (per-shard) row indices with its leading axis sharded over the same
+    data blocks — the arena's block placement rule guarantees position
+    i's row lives in the device holding batch position i. Expressed as a
+    shard_map (composes inside jit) so each device takes rows from its
+    OWN capacity block and XLA can never insert a collective for the
+    gather: a plain global `jnp.take` on a sharded operand is free to
+    all-gather it, which is exactly the cross-chip leg the sharded
+    arena exists to delete."""
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+
+    spec = jax.tree.map(
+        lambda a: P(DATA_AXIS, *([None] * (np.ndim(a) - 1))), tree
+    )
+    return shard_map(
+        lambda rs, t: jax.tree.map(lambda a: jnp.take(a, rs, axis=0), t),
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), spec),
+        out_specs=spec,
+        check_rep=False,
+    )(rows, tree)
+
+
 # ---------------------------------------------------------------------------
 # Worker device mesh (ISSUE 13): every BrainWorker's judge runs over a
 # local device mesh by default — FOREMAST_DEVICE_MESH selects the shape.

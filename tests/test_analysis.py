@@ -1646,7 +1646,7 @@ def test_sharding_contract_placement_outside_hooks():
     assert "`jnp.asarray` in warm-path code (`build`)" in findings[0].message
 
 
-def test_sharding_contract_arena_needs_replicated_annotation():
+def test_sharding_contract_arena_needs_sharded_annotation():
     findings = _sharding_findings(
         {
             "foremast_tpu/parallel/arenafix.py": """
@@ -1654,8 +1654,8 @@ def test_sharding_contract_arena_needs_replicated_annotation():
                     def spread(self):
                         return self._arena_budget + 1
 
-                    # Reads the replicated budget only (fixture).
-                    # foremast: replicated-arena
+                    # Reads the shard-agnostic budget only (fixture).
+                    # foremast: sharded-arena
                     def budget(self):
                         return self._arena_budget
             """

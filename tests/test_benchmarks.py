@@ -252,7 +252,18 @@ def test_scaleout_bench_sharded_judge_small_smoke(capsys):
     assert rl["devices_per_worker"] == 2
     assert rl["h2d_seconds"] >= 0 and rl["gather_seconds"] > 0
     assert rl["padded_row_fraction"] is not None
+    # per-device bytes x devices: the SHARD-SUM under the default
+    # sharded layout (ISSUE 19) — same arithmetic the replicated
+    # layout used for its replication tax
+    assert rl["arena_layout"] == "sharded"
+    assert rl["arena_capacity_rows"] > 0
     assert rl["arena_total_device_bytes"] == 2 * rl["arena_replica_bytes"]
+    # the ISSUE 19 capacity claims ran in-run (run_arena_check asserts
+    # them before the fleet starts; the summary echoes the verdict)
+    cap = line["arena_capacity"]
+    assert cap["oom_replicated"] and cap["fits_sharded"], cap
+    assert cap["linear_scaling"], cap
+    assert cap["warm_gather_collectives"] == [], cap
     assert line["no_double_judgment"] is True
     assert all(
         v > 0 for v in line["fleet_warm_windows_per_sec"].values()
@@ -309,8 +320,13 @@ def test_chaos_bench_small_smoke(capsys):
     assert summary["lock_witness_clean"] is True
     assert summary["memory_bounded"] is True
     by_phase = {ln["phase"]: ln for ln in lines}
-    assert by_phase["brownout"]["buffered"] > 0
-    assert by_phase["brownout"]["replayed"] > 0
+    # mid-write asserts gated on observed overlap: on a loaded 1-CPU
+    # host the judge pass can outlast even the bench's extended
+    # brownout window, in which case no write could have buffered —
+    # the bench records that honestly instead of flaking
+    if by_phase["brownout"]["overlap_observed"]:
+        assert by_phase["brownout"]["buffered"] > 0
+        assert by_phase["brownout"]["replayed"] > 0
     assert by_phase["blackhole"]["released"] > 0
     assert by_phase["flood"]["sheds"] > 0
     assert by_phase["crash"]["parked_at_wedge"] > 0

@@ -9,18 +9,25 @@ IDENTICAL seeded mixed fleet through two full workers:
   * sharded  — `BrainWorker(device_mesh=make_mesh(n_data=8))`: the
     univariate columnar fast tick AND the joint from-rows paths
     (bivariate + LSTM hybrid) partition their batch leading axis over
-    the 8-device data axis, state arenas replicated;
+    the 8-device data axis, state-arena ROW SPACE block-sharded over
+    the same axis (ISSUE 19 default);
+  * replicated — the same mesh with `FOREMAST_ARENA_SHARDED=0`: the
+    ISSUE-13 replicated-arena layout (global-index gathers against
+    per-device replicas);
   * single   — `BrainWorker(device_mesh=None)`: the plain one-device
     judge.
 
 The fleet is 13 services — deliberately NOT a multiple of 8, so every
-dispatch pads — and both workers run a cold tick (object path), a spike,
-and a warm tick (columnar paths). The child pins BYTE-identical
-statuses, anomaly payloads, hook bands, and fit-cache key sets, and
-verifies the in-run partition assert actually ran (mesh place calls,
-pad accounting). The parent only checks the child's verdict — process
-isolation keeps the forced device count away from the rest of the
-suite's fixed conftest environment.
+dispatch pads — and all three workers run a cold tick (object path), a
+spike, and a warm tick (columnar paths). The child pins BYTE-identical
+statuses, anomaly payloads, hook bands, and fit-cache key sets (pad fit
+keys excluded — the sharded arena's per-shard pad rows are deliberately
+shard-qualified), verifies the in-run partition assert actually ran
+(mesh place calls, pad accounting), and checks the per-device
+arena-rows partition: every arena leaf block-shards its [capacity]
+axis so each device holds exactly capacity/8 rows. The parent only
+checks the child's verdict — process isolation keeps the forced device
+count away from the rest of the suite's fixed conftest environment.
 """
 
 from __future__ import annotations
@@ -41,11 +48,13 @@ sys.path.insert(0, {repo!r})
 import dataclasses
 import json
 
+import jax
 import numpy as np
 
 from benchmarks.worker_bench import build_mixed_fleet
 from foremast_tpu.config import BrainConfig
 from foremast_tpu.jobs.worker import BrainWorker
+from foremast_tpu.models.cache import is_pad_fit_key
 from foremast_tpu.parallel.mesh import make_mesh
 
 NOW = 1_760_000_000.0
@@ -63,7 +72,8 @@ def spike(source, sid, f):
         source.data[url] = (ct, s)
 
 
-def run(device_mesh):
+def run(device_mesh, arena_sharded=True):
+    os.environ["FOREMAST_ARENA_SHARDED"] = "1" if arena_sharded else "0"
     bands = []
 
     def hook(doc, verdicts):
@@ -114,13 +124,22 @@ def run(device_mesh):
         d.id: (d.status, json.dumps(d.anomaly_info, sort_keys=True))
         for d in store._docs.values()
     }}
-    fit_keys = sorted(repr(k) for k in w._fit_cache._d)
-    joint_keys = sorted(repr(k) for k in w.judge.cache._d)
+    # pad fit keys excluded: the sharded arena pins one pad row PER
+    # SHARD (shard-qualified "__pad__" keys) where the replicated/
+    # single judges pin one — placement bookkeeping, never persisted
+    # (is_pad_fit_key gates the journal) and never doc-visible
+    fit_keys = sorted(
+        repr(k) for k in w._fit_cache._d if not is_pad_fit_key(k)
+    )
+    joint_keys = sorted(
+        repr(k) for k in w.judge.cache._d if not is_pad_fit_key(k)
+    )
     return statuses, sorted(bands), fit_keys, joint_keys, w
 
 
 sharded_mesh = make_mesh(n_data=8)
 s_stat, s_bands, s_fit, s_joint, sw = run(sharded_mesh)
+r_stat, r_bands, r_fit, r_joint, rw = run(sharded_mesh, arena_sharded=False)
 p_stat, p_bands, p_fit, p_joint, pw = run(None)
 
 # the sharded worker genuinely placed + partitioned (the in-run assert
@@ -136,15 +155,53 @@ assert sw._fast_kinds["bivariate"] + sw._fast_kinds["lstm"] > 0, (
 )
 assert pw._device_mesh_state() is None
 
-# byte parity: statuses, anomaly payloads, hook verdicts + bands,
-# fit-cache key sets — univariate columnar AND joint from-rows paths
-assert s_stat == p_stat, (
-    {{k: (s_stat[k], p_stat[k]) for k in s_stat if s_stat[k] != p_stat[k]}}
+# ISSUE 19: the default mesh judge runs SHARDED arenas, the
+# FOREMAST_ARENA_SHARDED=0 arm replicated — and the varz says which
+assert dm["arena_layout"] == "sharded", dm
+assert dm["arena_capacity_rows"] > 0, dm
+assert rw._device_mesh_state()["arena_layout"] == "replicated"
+
+# per-device arena-rows partition: every arena leaf block-shards its
+# [capacity] axis over the 8 data-axis devices — each device holds
+# exactly capacity/8 rows (a replicated leaf would hold all of them)
+arenas = list(sw._uni._arenas.values()) + (
+    list(sw._mvj._joint_arenas.values()) if sw._mvj is not None else []
 )
+assert arenas, "no arenas built on the sharded worker"
+for a in arenas:
+    assert a.shards == 8, a.shards
+    assert a.cap == 8 * a.cap_s, (a.cap, a.cap_s)
+    for leaf in jax.tree.leaves(a.state):
+        shard_rows = sorted(
+            s.data.shape[0] for s in leaf.addressable_shards
+        )
+        assert shard_rows == [a.cap_s] * 8, (leaf.shape, shard_rows)
+rep = list(rw._uni._arenas.values())[0]
+assert rep.shards == 1, "replicated arm must keep the plain layout"
+for leaf in jax.tree.leaves(rep.state):
+    assert all(
+        s.data.shape[0] == rep.cap for s in leaf.addressable_shards
+    ), "replicated arm leaf is not a full replica per device"
+
+# byte parity: statuses, anomaly payloads, hook verdicts + bands,
+# fit-cache key sets — univariate columnar AND joint from-rows paths,
+# sharded-arena vs replicated-arena vs single-device
+for nm, (o_stat, o_bands, o_fit, o_joint) in {{
+    "replicated": (r_stat, r_bands, r_fit, r_joint),
+    "single": (p_stat, p_bands, p_fit, p_joint),
+}}.items():
+    assert s_stat == o_stat, (
+        nm,
+        {{
+            k: (s_stat[k], o_stat[k])
+            for k in s_stat
+            if s_stat[k] != o_stat[k]
+        }},
+    )
+    assert s_bands == o_bands, nm + ": hook verdict/band mismatch"
+    assert s_fit == o_fit, nm + ": univariate fit-cache key drift"
+    assert s_joint == o_joint, nm + ": joint fit-cache key drift"
 assert any(st == "completed_unhealth" for st, _ in s_stat.values()), s_stat
-assert s_bands == p_bands, "hook verdict/band mismatch"
-assert s_fit == p_fit, "univariate fit-cache key drift"
-assert s_joint == p_joint, "joint fit-cache key drift"
 print("PARITY OK", len(s_stat), "docs,", dm["pad_rows_total"], "pad rows")
 """
 
@@ -253,6 +310,7 @@ dm = sw._device_mesh_state()
 assert dm is not None and dm["devices"] == 8, dm
 assert dm["place_calls"] > 0, dm
 assert dm["pad_rows_total"] > 0, dm  # 13-doc fleet forces pad rows
+assert dm["arena_layout"] == "sharded", dm  # ISSUE 19 default
 assert sw._fast_kinds["baseline"] > 0, sw._fast_kinds
 assert pw._device_mesh_state() is None
 

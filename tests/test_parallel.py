@@ -572,7 +572,11 @@ def test_sharded_judge_columnar_pads_to_data_axis(mesh8):
     assert sp is None and pp is None  # baseline-less: constants host-side
     assert sharded.batch_rows_total % 8 == 0
     assert sharded.pad_rows_total == sharded.batch_rows_total - b0
-    assert sharded.mesh_stats["place_calls"] == 1
+    # exactly 2 placements: the batch buffers (ONE fused host->sharded
+    # device_put — the round-15 double-place regression pin) plus the
+    # sharded arena's local-row index vector (ISSUE 19; rides the same
+    # hook so the roofline H2D leg counts its bytes)
+    assert sharded.mesh_stats["place_calls"] == 2
     np.testing.assert_array_equal(sv, pv)
     np.testing.assert_array_equal(sa, pa)
     assert su.tobytes() == pu.tobytes() and sl.tobytes() == pl.tobytes()
