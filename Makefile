@@ -86,6 +86,15 @@ bench-latency:
 bench-elastic:
 	$(PY) -m benchmarks.elastic_bench
 
+# multi-tenant QoS plane (ISSUE 20): noisy-neighbor fleet (one whale
+# tenant at 10x share flooding the real receiver) vs a solo-tenant
+# control, with in-run asserts: quiet tenants' p99 verdict latency and
+# F1 unchanged, every 429 + Retry-After lands on the whale, evictions
+# charged to their causer, zero-vs-one-tenant byte parity on the
+# sliced warm path, per-tenant ledger visible in /debug/state
+bench-noisy:
+	$(PY) -m benchmarks.noisy_bench
+
 native:
 	$(MAKE) -C native
 
@@ -134,4 +143,4 @@ clean:
 	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 
-.PHONY: test test-fast ci bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest bench-scaleout bench-cold bench-restart bench-chaos bench-elastic native deploy-render check metrics-lint env-docs metrics-docs lockgraph statusgraph docker-build clean
+.PHONY: test test-fast ci bench bench-suite bench-pipeline bench-mixed bench-plane bench-ingest bench-scaleout bench-cold bench-restart bench-chaos bench-elastic bench-noisy native deploy-render check metrics-lint env-docs metrics-docs lockgraph statusgraph docker-build clean

@@ -71,6 +71,7 @@ def write_summary(
     asserts_passed: bool = True,
     path: str | None = None,
     recompiles: dict | None = None,
+    tenants: dict | None = None,
 ) -> str | None:
     """Fold one bench's headline result into the round's JSON artifact.
 
@@ -81,8 +82,13 @@ def write_summary(
     ran one — benches assert zero WARM-phase backend compiles in-run
     (docs/static-analysis.md, rule recompile-hazard); the artifact pins
     the counts so a cache-key leak shows up as a diff even where no
-    phase asserts. Failures to write are raised: a CI lane asking for
-    the artifact must not silently get prose only."""
+    phase asserts. `tenants` is the run's end-state per-tenant
+    accounting snapshot ({tenant: {shed, evictions, claims,
+    ring_bytes}}, ISSUE 20) when the bench ran tenanted — pinned so a
+    QoS regression (sheds landing on quiet tenants, evictions charged
+    to the wrong tenant) is a JSON diff, not just an in-run assert.
+    Failures to write are raised: a CI lane asking for the artifact
+    must not silently get prose only."""
     if small:
         return None
     if path is None:
@@ -121,6 +127,8 @@ def write_summary(
     entry = dict(result, asserts_passed=asserts_passed)
     if recompiles is not None:
         entry["recompiles"] = recompiles
+    if tenants is not None:
+        entry["tenants"] = tenants
     doc["results"][bench] = entry
     tmp = f"{path}.tmp"
     with open(tmp, "w") as f:

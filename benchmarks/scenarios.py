@@ -54,6 +54,16 @@ FAN_IN_SHAPES = (1, 8)
 # pushed series co-locate on one worker no matter how many extra
 # labels the selector carries (`label_shape_routing_cell` proves it).
 LABEL_SHAPES = ("single", "multi_cluster", "multi_tenant")
+# tenant-share REGIMES (ISSUE 20): how a multi-tenant fleet's series
+# divide over tenants. `uniform` is the PR-15 shape (round-robin,
+# every tenant equal); `noisy_neighbor` gives ONE tenant (`t0`, the
+# whale) NOISY_FACTOR x every other tenant's share — the QoS plane's
+# adversarial workload: without envelopes + weighted-fair draining the
+# whale's backlog starves the quiet tenants' micro-ticks and its pushes
+# evict their ring series
+TENANT_REGIMES = ("uniform", "noisy_neighbor")
+NOISY_FACTOR = 10
+WHALE_TENANT = "t0"
 
 PERIOD = 24
 NOISE = 0.05
@@ -249,6 +259,58 @@ def scenario_selector(
         f'{k}="{v}"' for k, v in reversed(sorted(labels.items()))
     )
     return f"{metric}{{{body}}}"
+
+
+def tenant_fleet(
+    regime: str,
+    services: int,
+    tenants: int = 4,
+    factor: int = NOISY_FACTOR,
+) -> list[str]:
+    """Tenant name per service index under a tenant-share regime.
+
+    `uniform` round-robins the fleet over `tenants` equal tenants;
+    `noisy_neighbor` interleaves a weighted pattern in which the whale
+    (WHALE_TENANT) owns `factor` slots per cycle and every other tenant
+    one — so the whale's share of services (and of every per-series
+    resource: pushes, ring bytes, dirty marks, claims) is `factor` x
+    each neighbor's. Deterministic: the same index always maps to the
+    same tenant, so control and treatment runs judge identical fleets.
+    """
+    if regime == "uniform":
+        return [f"t{s % tenants}" for s in range(services)]
+    if regime != "noisy_neighbor":
+        raise ValueError(regime)
+    pattern = [WHALE_TENANT] * factor + [
+        f"t{i}" for i in range(1, tenants)
+    ]
+    return [pattern[s % len(pattern)] for s in range(services)]
+
+
+def tenant_weighted_specs(
+    tenants: int = 4,
+    weight: float = 1.0,
+    ring_bytes: int = 0,
+    arena_rows: int = 0,
+    ingest_bytes_per_s: int = 0,
+) -> dict[str, dict]:
+    """A FOREMAST_TENANTS-shaped spec map for a `tenants`-tenant fleet:
+    EQUAL weights (the fairness claim under test is that weighted-fair
+    draining protects quiet tenants from a whale's backlog, not that
+    operators hand-tune the whale down) with optional uniform budget
+    envelopes. json.dumps of the result is a valid FOREMAST_TENANTS
+    value; benches feed it to TenantRegistry directly."""
+    spec: dict[str, dict] = {}
+    for i in range(tenants):
+        s: dict = {"weight": weight}
+        if ring_bytes:
+            s["ring_bytes"] = int(ring_bytes)
+        if arena_rows:
+            s["arena_rows"] = int(arena_rows)
+        if ingest_bytes_per_s:
+            s["ingest_bytes_per_s"] = int(ingest_bytes_per_s)
+        spec[f"t{i}"] = s
+    return spec
 
 
 def label_shape_routing_cell(

@@ -1081,6 +1081,38 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
         "already-pinned BENCHMARKS.md round; unset, the round is the "
         "highest pinned round + 1",
     ),
+    # -- multi-tenant QoS plane (ISSUE 20)
+    EnvKnob(
+        "FOREMAST_TENANTS",
+        None,
+        "str",
+        "tenant spec map as inline JSON or `@/path/to/file.json` "
+        "(FOREMAST_CHAOS_PLAN-style): `{name: {weight, ring_bytes, "
+        "arena_rows, ingest_bytes_per_s, burst_bytes}}` (or wrapped "
+        "under a top-level `tenants` key); 0/omitted fields mean no "
+        "envelope. Unset or a single tenant keeps every scheduling "
+        "and eviction path byte-identical to the untenanted worker; "
+        ">=2 tenants turns on weighted-fair claim ordering, "
+        "per-tenant ingest admission and budget-envelope eviction. "
+        "Malformed JSON raises at startup",
+    ),
+    EnvKnob(
+        "FOREMAST_TENANT_LABEL",
+        "tenant",
+        "str",
+        "series/doc label the tenant is resolved from (canonical "
+        "selector label on pushed series, URL-encoded matcher in doc "
+        "query configs); series without it belong to `default`",
+    ),
+    EnvKnob(
+        "FOREMAST_TENANT_LABEL_MAX",
+        "64",
+        "int",
+        "cardinality cap for the `tenant` metric label: configured "
+        "tenants always export under their own name; at most this "
+        "many UNCONFIGURED observed values get label slots, the rest "
+        "fold into `other` (BrainGauges-style, warned once)",
+    ),
     # -- deployment / platform integration
     EnvKnob(
         "NAMESPACE",

@@ -109,6 +109,87 @@ def _pow2(n: int) -> int:
     return b
 
 
+class _ArenaTenancy:
+    """Arena-rows budget envelopes + eviction attribution (ISSUE 20).
+
+    Tracks which tenant owns each KEYED resident row (resolved once at
+    assignment from the fit key's URL-encoded tenant label, cached by
+    the registry) so `assign` can (a) recycle an over-envelope tenant's
+    OWN least-recent row instead of evicting a neighbor's, and (b)
+    charge every eviction to the tenant whose allocation forced it.
+    Pads and transients stay untenanted. Single-threaded like the arena
+    itself; the accounting ledger flush at the end of each assign call
+    is the only lock it ever touches (tenant.accounting, a leaf)."""
+
+    __slots__ = ("registry", "acct", "envelopes", "rows", "of", "_pending")
+
+    def __init__(self, registry, acct):
+        self.registry = registry
+        self.acct = acct
+        self.envelopes = {
+            name: s.arena_rows
+            for name, s in registry.specs.items()
+            if s.arena_rows > 0
+        }
+        self.rows: dict = {}  # tenant -> keyed resident row count
+        self.of: dict = {}  # fit key -> tenant
+        self._pending: dict = {}  # tenant -> evictions this assign call
+
+    @staticmethod
+    def build(tenancy=None):
+        """A tracker when the process is tenanted and tenancy could
+        matter here (>=2 tenants, or any arena_rows envelope), else
+        None — the parity pin: an untenanted or single-tenant arena
+        keeps today's row placement byte-for-byte."""
+        from foremast_tpu.tenant import accounting_for, get_tenancy
+
+        if tenancy is None:
+            tenancy = get_tenancy()
+        if tenancy is None:
+            return None
+        if not (
+            tenancy.fair
+            or any(s.arena_rows > 0 for s in tenancy.specs.values())
+        ):
+            return None
+        return _ArenaTenancy(tenancy, accounting_for(tenancy))
+
+    def tenant_of(self, key):
+        """Owning tenant for a REAL fit key (callers skip pads/None)."""
+        return self.registry.tenant_of_key(key)
+
+    def note_assign(self, key, tenant) -> None:
+        self.of[key] = tenant
+        self.rows[tenant] = self.rows.get(tenant, 0) + 1
+
+    def note_drop(self, key) -> None:
+        t = self.of.pop(key, None)
+        if t is not None:
+            left = self.rows.get(t, 0) - 1
+            if left > 0:
+                self.rows[t] = left
+            else:
+                self.rows.pop(t, None)
+
+    def over(self, tenant) -> bool:
+        env = self.envelopes.get(tenant, 0)
+        return env > 0 and self.rows.get(tenant, 0) >= env
+
+    def charge(self, tenant) -> None:
+        self._pending[tenant] = self._pending.get(tenant, 0) + 1
+
+    def flush(self) -> None:
+        if self._pending:
+            for t, n in self._pending.items():
+                self.acct.count_eviction(t, n)
+            self._pending.clear()
+
+    def clear(self) -> None:
+        self.rows.clear()
+        self.of.clear()
+        self._pending.clear()
+
+
 @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
 def _scatter(level, trend, season, phase, scale, nh, idx, l_n, t_n, s_n, p_n, sc_n, n_n):
     """Functional in-place row update (donated buffers: the arena is the
@@ -208,6 +289,10 @@ class RowArena:
         # operator counters report documents, and their hits/misses are
         # never counted (positions >= assign()'s n_real are pads)
         self.pad_live = 0
+        # multi-tenant QoS (ISSUE 20): None unless the process is
+        # tenanted with >=2 tenants or an arena_rows envelope — the
+        # untenanted arena keeps today's placement byte-for-byte
+        self._qos = _ArenaTenancy.build()
 
     # -- layout hooks (subclass-owned) ------------------------------------
 
@@ -364,8 +449,27 @@ class RowArena:
         self._free_s = [[] for _ in range(self.shards)]
         self._transients = []
         self.pad_live = 0
+        if self._qos is not None:
+            self._qos.clear()
 
     # -- assignment ------------------------------------------------------
+
+    def _own_victim(self, order, tenant, base: int = 0) -> int:
+        """First evictable row (stamp != this call's tick) OWNED by
+        `tenant`, walking `order` (a stamp argsort — LRU first; local
+        indices offset by `base` in sharded mode). -1 when every row of
+        the tenant is protected this call — the envelope then falls
+        through to normal placement, because a budget may reorder row
+        recycling but must never block a verdict (ISSUE 20 parity)."""
+        qos = self._qos
+        for lr in order.tolist():
+            r = base + lr
+            if self.stamp[r] == self.tick:
+                continue
+            k = self.row_key[r]
+            if k is not None and qos.of.get(k) == tenant:
+                return r
+        return -1
 
     def assign(
         self, keys, force, n_real: int | None = None
@@ -456,6 +560,7 @@ class RowArena:
             shortfall = len(alloc) - available
             if shortfall > 0 and self.cap + shortfall <= self.hard_rows:
                 self._ensure_capacity(self.cap + shortfall)
+            qos = self._qos
             order = None
             oi = 0
             for ai, i in enumerate(alloc.tolist()):
@@ -467,6 +572,33 @@ class RowArena:
                         # duplicate key later in the same batch: reuse
                         # the row its first occurrence just claimed
                         rows[i] = r
+                        continue
+                tenant = None
+                if qos is not None and k is not None and not _is_pad_key(k):
+                    tenant = qos.tenant_of(k)
+                if tenant is not None and qos.over(tenant):
+                    # arena_rows envelope: an over-budget tenant
+                    # recycles its OWN least-recent row — never a
+                    # neighbor's, never the free pool, never capacity
+                    # growth — and the eviction is charged to it
+                    if order is None:
+                        order = np.argsort(self.stamp, kind="stable")
+                    rv = self._own_victim(order, tenant)
+                    if rv >= 0:
+                        old = self.row_key[rv]
+                        del self.rows[old]
+                        self.row_entry.pop(old, None)
+                        self.evictions += 1
+                        qos.note_drop(old)
+                        qos.charge(tenant)
+                        self.rows[k] = rv
+                        self.row_key[rv] = k
+                        qos.note_assign(k, tenant)
+                        self.stamp[rv] = self.tick
+                        rows[i] = rv
+                        scatter.append(i)
+                        if i < nr:
+                            self.misses += 1
                         continue
                 if not self.free:
                     if order is None:
@@ -522,11 +654,17 @@ class RowArena:
                         self.evictions += 1
                         if _is_pad_key(old):
                             self.pad_live -= 1
+                        if qos is not None:
+                            qos.note_drop(old)
+                            if tenant is not None:
+                                qos.charge(tenant)
                 if k is not None:
                     self.rows[k] = r
                     self.row_key[r] = k
                     if i >= nr:
                         self.pad_live += 1
+                    if tenant is not None:
+                        qos.note_assign(k, tenant)
                 else:
                     # transient: recyclable at the next assign
                     self.row_key[r] = None
@@ -536,6 +674,8 @@ class RowArena:
                 scatter.append(i)
                 if i < nr:
                     self.misses += 1
+        if self._qos is not None:
+            self._qos.flush()
         return rows, scatter
 
     def _assign_sharded(
@@ -618,6 +758,7 @@ class RowArena:
             claimed = {
                 keys[i] for i in np.nonzero(hit)[0] if keys[i] is not None
             }
+            qos = self._qos
             cap_s = self.cap_s
             order_s: list = [None] * shards
             oi_s = [0] * shards
@@ -650,6 +791,42 @@ class RowArena:
                             self.shard_moves += 1
                             if _is_pad_key(k):
                                 self.pad_live -= 1
+                            if qos is not None:
+                                # migration, not pressure: residency
+                                # moves shards, nobody is charged (the
+                                # note_assign below re-registers it)
+                                qos.note_drop(k)
+                tenant = None
+                if qos is not None and not transient and not _is_pad_key(k):
+                    tenant = qos.tenant_of(k)
+                if tenant is not None and qos.over(tenant):
+                    # arena_rows envelope, block-local: recycle the
+                    # over-budget tenant's own least-recent row in THIS
+                    # position's shard (placement stays device-local),
+                    # charged to it; no candidate in the block → fall
+                    # through to normal placement
+                    if order_s[s] is None:
+                        order_s[s] = np.argsort(
+                            self.stamp[base : base + cap_s], kind="stable"
+                        )
+                    rv = self._own_victim(order_s[s], tenant, base)
+                    if rv >= 0:
+                        old = self.row_key[rv]
+                        del self.rows[old]
+                        self.row_entry.pop(old, None)
+                        self.evictions += 1
+                        qos.note_drop(old)
+                        qos.charge(tenant)
+                        self.rows[k] = rv
+                        self.row_key[rv] = k
+                        claimed.add(k)
+                        qos.note_assign(k, tenant)
+                        self.stamp[rv] = self.tick
+                        rows[i] = rv
+                        scatter.append(i)
+                        if i < nr:
+                            self.misses += 1
+                        continue
                 freel = self._free_s[s]
                 if freel:
                     r = base + freel.pop()
@@ -684,6 +861,10 @@ class RowArena:
                         self.evictions += 1
                         if _is_pad_key(old):
                             self.pad_live -= 1
+                        if qos is not None:
+                            qos.note_drop(old)
+                            if tenant is not None:
+                                qos.charge(tenant)
                 if transient:
                     self.row_key[r] = None
                     self._transients.append(r)
@@ -693,11 +874,15 @@ class RowArena:
                     claimed.add(k)
                     if i >= nr:
                         self.pad_live += 1
+                    if tenant is not None:
+                        qos.note_assign(k, tenant)
                 self.stamp[r] = self.tick
                 rows[i] = r
                 scatter.append(i)
                 if i < nr:
                     self.misses += 1
+        if self._qos is not None:
+            self._qos.flush()
         return rows, scatter
 
     def device_bytes(self) -> int:

@@ -404,6 +404,7 @@ def start_ingest_server(
     dirty=None,
     decode_workers: int | None = None,
     max_decoded_bytes: int | None = None,
+    tenancy=None,
 ):
     """Serve the push plane; returns (server, thread). Port 0 binds an
     ephemeral port (tests) — read it back from server.server_address.
@@ -445,7 +446,19 @@ def start_ingest_server(
     `decode_workers` / `max_decoded_bytes` (ISSUE 18): pooled decode
     width (None reads ``FOREMAST_INGEST_DECODE_WORKERS``, default 4;
     0 decodes inline) and the declared-decoded-size 413 ceiling (None
-    reads ``FOREMAST_INGEST_MAX_DECODED_BYTES``, default 32 MiB)."""
+    reads ``FOREMAST_INGEST_MAX_DECODED_BYTES``, default 32 MiB).
+
+    `tenancy` (tenant.TenantRegistry, ISSUE 20; None reads the
+    process-global ``FOREMAST_TENANTS`` registry): per-tenant ingest
+    byte-rate envelopes enforced AFTER decode (tenant identity lives in
+    the series labels) and BEFORE apply, on both codecs by construction
+    — a batch whose dominant-by-bytes tenant is over its envelope is
+    shed whole with 429 + a computed Retry-After charged to THAT
+    tenant, while every other tenant's pushes sail through. The global
+    inflight cap and decode-pool depth remain tenant-blind backstops;
+    decode-pool sheds are blamed on the deepest-over-envelope tenant
+    (`IngestGovernor.blame`). Unconfigured fleets shed exactly as
+    before."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     if max_body_bytes is None:
@@ -471,6 +484,18 @@ def start_ingest_server(
             or DEFAULT_MAX_DECODED_BYTES
         )
     decoded_cap = int(max_decoded_bytes)
+    if tenancy is None:
+        from foremast_tpu.tenant.registry import get_tenancy
+
+        tenancy = get_tenancy()
+    governor = None
+    accounting = None
+    if tenancy is not None:
+        from foremast_tpu.tenant.accounting import accounting_for
+        from foremast_tpu.tenant.envelopes import IngestGovernor
+
+        accounting = accounting_for(tenancy)
+        governor = IngestGovernor(tenancy)
     inflight = _Inflight()
     pool = _DecodePool(decode_workers)
     wire_stats = WireStats()
@@ -483,7 +508,9 @@ def start_ingest_server(
         """The pooled stage pipeline: decompress → decode → apply, one
         codec switch and ONE shared apply path (push_batch + redirects
         + dirty marks + response shape), so the two codecs cannot
-        drift apart in observable behavior. Returns (status, body)."""
+        drift apart in observable behavior. Returns (status, body) or
+        (status, body, headers) — the tenant-shed 429 carries its
+        computed Retry-After."""
         stages = {"read": read_s, "decompress": 0.0, "decode": 0.0,
                   "apply": 0.0}
         try:
@@ -506,6 +533,40 @@ def start_ingest_server(
                 AttributeError) as e:
             wire_stats.record(codec, stages, samples=0, ok=False)
             return 400, json.dumps({"reason": str(e)}).encode()
+        if governor is not None and entries:
+            # tenant admission (ISSUE 20): post-decode because tenant
+            # identity lives in the series labels, pre-apply so a shed
+            # batch lands NOTHING. The whole batch is charged to its
+            # dominant-by-bytes tenant and shed atomically — re-pushes
+            # are idempotent at the ring, and a batching agent that
+            # mixes tenants shares the dominant tenant's fate
+            # (docs/operations.md "Multi-tenant QoS").
+            by_tenant: dict[str, int] = {}
+            total = 0
+            for key, ts, vs, _start in entries:
+                nb = int(getattr(ts, "nbytes", 0)) + int(
+                    getattr(vs, "nbytes", 0)
+                )
+                t = tenancy.tenant_of_series(key)
+                by_tenant[t] = by_tenant.get(t, 0) + nb
+                total += nb
+            dominant = max(by_tenant, key=by_tenant.get)
+            retry = governor.admit(dominant, total, time.monotonic())
+            if retry > 0:
+                accounting.count_shed(dominant)
+                if degrade_stats is not None:
+                    degrade_stats.count_event("receiver", "tenant_shed")
+                wire_stats.record(codec, stages, samples=0, ok=False)
+                return (
+                    429,
+                    json.dumps(
+                        {
+                            "reason": "tenant over ingest envelope",
+                            "tenant": dominant,
+                        }
+                    ).encode(),
+                    {"Retry-After": str(int(retry))},
+                )
         t0 = time.perf_counter()
         redirects: dict[str, str] = {}
         if router is not None:
@@ -682,7 +743,7 @@ def start_ingest_server(
                 self._send(code, json.dumps(body).encode())
                 return
             try:
-                code, out = pool.run(
+                res = pool.run(
                     lambda: decode_apply(
                         raw, codec, snappy_enc, arrived_at, read_s
                     )
@@ -700,13 +761,24 @@ def start_ingest_server(
             except _PoolBusy:
                 if degrade_stats is not None:
                     degrade_stats.count_event("receiver", "decode_shed")
+                if governor is not None:
+                    # pre-decode shed: no tenant can be KNOWN yet, but
+                    # the deepest-over-envelope tenant is the queue
+                    # pressure's overwhelmingly likely source — charge
+                    # it so decode sheds stay attributed (ISSUE 20)
+                    blamed = governor.blame(time.monotonic())
+                    if blamed is not None:
+                        accounting.count_shed(blamed)
                 self._send(
                     429,
                     b'{"reason": "decode queue full"}',
                     headers={"Retry-After": "1"},
                 )
                 return
-            self._send(code, out)
+            code, out = res[0], res[1]
+            self._send(
+                code, out, headers=res[2] if len(res) > 2 else None
+            )
 
         def do_GET(self):
             with inflight:
@@ -728,6 +800,12 @@ def start_ingest_server(
                 state["wire"] = wire_stats.snapshot()
                 if book is not None:
                     state["subscriptions"] = book.snapshot()
+                if tenancy is not None:
+                    from foremast_tpu.tenant.collector import debug_tenants
+
+                    state["tenants"] = debug_tenants(
+                        tenancy, accounting, governor
+                    )
                 self._send(
                     200, json.dumps(state, default=str, indent=2).encode()
                 )

@@ -49,6 +49,38 @@ _COUNT_KEYS = (
 )
 
 
+class _RingTenancy:
+    """One store's tenant view (ISSUE 20): the registry resolves a
+    pushed key's tenant (OUTSIDE any shard lock — the registry's cache
+    lock is a peer leaf), per-tenant ring-bytes envelopes divide evenly
+    across shards exactly like the global budget, and residency deltas
+    + eviction charges flush into the shared `TenantAccounting` ledger
+    AFTER the shard lock releases."""
+
+    def __init__(self, registry, shards: int):
+        from foremast_tpu.tenant.accounting import accounting_for
+
+        self.registry = registry
+        self.accounting = accounting_for(registry)
+        self.shards = max(1, int(shards))
+
+    def tenant_of(self, key: str) -> str:
+        return self.registry.tenant_of_series(key)
+
+    def shard_envelope(self, tenant: str) -> int:
+        """The tenant's per-shard byte envelope slice; 0 = no envelope.
+        Lock-free (spec lookup reads an immutable dict), so it is safe
+        under a shard lock."""
+        rb = self.registry.spec(tenant).ring_bytes
+        return max(rb // self.shards, 1) if rb > 0 else 0
+
+    def flush(self, byte_deltas: dict, evictions: dict) -> None:
+        for tenant, delta in byte_deltas.items():
+            self.accounting.add_ring_bytes(tenant, delta)
+        for tenant, n in evictions.items():
+            self.accounting.count_eviction(tenant, n)
+
+
 def _serving_span(ring, t0, t1, now, step, stale_seconds):
     """THE serve rule, shared by query/hist_query/coverage (one
     definition or the refinement planner's view of servability drifts
@@ -75,13 +107,18 @@ class RingShard:
     """One lock's worth of series. All state behind `_lock`; the
     SeriesRing objects inside are only touched while holding it."""
 
-    def __init__(self, budget_bytes: int, max_points: int):
+    def __init__(self, budget_bytes: int, max_points: int, tenancy=None):
         self.budget_bytes = int(budget_bytes)
         self.max_points = int(max_points)
         self._lock = threading.Lock()
         self._series: OrderedDict[str, SeriesRing] = OrderedDict()
         self._bytes = 0
         self._counts = dict.fromkeys(_COUNT_KEYS, 0)
+        # tenant QoS view (ISSUE 20); None = untenanted, every path
+        # below keeps its zero-cost check
+        self._tenancy = tenancy
+        self._t_bytes: dict[str, int] = {}  # tenant -> resident bytes
+        self._t_of: dict[str, str] = {}  # resident key -> tenant
 
     def push(
         self,
@@ -120,8 +157,20 @@ class RingShard:
         IN APPLY ORDER, still under the lock (the PR-7 replay-order
         contract is per-apply, not per-acquisition)."""
         out = []
+        ten = self._tenancy
+        # tenant resolution OUTSIDE the shard lock (the registry's
+        # cache lock is a peer leaf, never nested under a shard's);
+        # residency deltas + eviction charges accumulate here and
+        # flush into the shared ledger after the lock releases
+        tenants = (
+            [ten.tenant_of(key) for key, _, _, _, _ in items]
+            if ten is not None
+            else None
+        )
+        byte_deltas: dict[str, int] = {}
+        evict_charges: dict[str, int] = {}
         with self._lock:
-            for key, times, values, start, end in items:
+            for j, (key, times, values, start, end) in enumerate(items):
                 ring = self._series.get(key)
                 prev = 0
                 if ring is None:
@@ -135,12 +184,38 @@ class RingShard:
                 self._bytes += ring.nbytes - prev
                 self._series.move_to_end(key)
                 self._counts["samples"] += n
+                if ten is not None:
+                    t = tenants[j]
+                    self._t_of[key] = t
+                    delta = ring.nbytes - prev
+                    if delta:
+                        self._t_bytes[t] = self._t_bytes.get(t, 0) + delta
+                        byte_deltas[t] = byte_deltas.get(t, 0) + delta
+                    # per-tenant envelope (ISSUE 20): a tenant past its
+                    # ring-bytes slice loses its OWN least-recently-used
+                    # series first, charged to itself — never another
+                    # tenant's residency, and never the series just
+                    # pushed (one series larger than the envelope must
+                    # not thrash, same rule as the global budget)
+                    env = ten.shard_envelope(t)
+                    if env:
+                        self._evict_tenant(
+                            t, env, key, byte_deltas, evict_charges
+                        )
                 while (
                     self._bytes > self.budget_bytes and len(self._series) > 1
                 ):
-                    _, old = self._series.popitem(last=False)
-                    self._bytes -= old.nbytes
-                    self._counts["evictions"] += 1
+                    if ten is not None:
+                        # global overflow: prefer an over-envelope
+                        # tenant's series, charge the pusher causing
+                        # the pressure
+                        self._evict_global(
+                            tenants[j], byte_deltas, evict_charges
+                        )
+                    else:
+                        _, old = self._series.popitem(last=False)
+                        self._bytes -= old.nbytes
+                        self._counts["evictions"] += 1
                 if journal is not None and (
                     n or start is not None or end is not None
                 ):
@@ -152,7 +227,85 @@ class RingShard:
                     # same-timestamp revisions restore stale.
                     journal(key, times, values, start, end)  # foremast: ignore[blocking-under-lock]
                 out.append(n)
+        if ten is not None and (byte_deltas or evict_charges):
+            ten.flush(byte_deltas, evict_charges)
         return out
+
+    # -- tenant-aware eviction (ISSUE 20) -------------------------------
+    # These helpers run ONLY from put()'s `with self._lock:` block —
+    # the lock is not reentrant, so they cannot retake it. Each guarded
+    # access carries the lock-discipline suppression; the contract is
+    # the single call site, not a lock-free fast path.
+
+    def _pop_series(self, key: str, byte_deltas: dict) -> None:
+        # foremast: ignore[lock-discipline] — caller (put) holds _lock
+        old = self._series.pop(key)
+        # foremast: ignore[lock-discipline] — caller (put) holds _lock
+        self._bytes -= old.nbytes
+        # foremast: ignore[lock-discipline] — caller (put) holds _lock
+        self._counts["evictions"] += 1
+        # foremast: ignore[lock-discipline] — caller (put) holds _lock
+        t = self._t_of.pop(key, None)
+        if t is not None:
+            # foremast: ignore[lock-discipline] — caller holds _lock
+            left = self._t_bytes.get(t, 0) - old.nbytes
+            if left > 0:
+                # foremast: ignore[lock-discipline] — caller holds _lock
+                self._t_bytes[t] = left
+            else:
+                # foremast: ignore[lock-discipline] — caller holds _lock
+                self._t_bytes.pop(t, None)
+            byte_deltas[t] = byte_deltas.get(t, 0) - old.nbytes
+
+    def _evict_tenant(
+        self,
+        tenant: str,
+        envelope: int,
+        pushed_key: str,
+        byte_deltas: dict,
+        evict_charges: dict,
+    ) -> None:
+        while (
+            # foremast: ignore[lock-discipline] — caller holds _lock
+            self._t_bytes.get(tenant, 0) > envelope
+            # foremast: ignore[lock-discipline] — caller holds _lock
+            and len(self._series) > 1
+        ):
+            victim = next(
+                (
+                    k
+                    # foremast: ignore[lock-discipline] — caller holds _lock
+                    for k in self._series
+                    # foremast: ignore[lock-discipline] — caller holds _lock
+                    if k != pushed_key and self._t_of.get(k) == tenant
+                ),
+                None,
+            )
+            if victim is None:
+                break
+            self._pop_series(victim, byte_deltas)
+            evict_charges[tenant] = evict_charges.get(tenant, 0) + 1
+
+    def _evict_global(
+        self, causer: str, byte_deltas: dict, evict_charges: dict
+    ) -> None:
+        victim = None
+        # foremast: ignore[lock-discipline] — caller (put) holds _lock
+        for k in self._series:
+            # foremast: ignore[lock-discipline] — caller holds _lock
+            t = self._t_of.get(k)
+            if t is None:
+                continue
+            env = self._tenancy.shard_envelope(t)
+            # foremast: ignore[lock-discipline] — caller holds _lock
+            if env and self._t_bytes.get(t, 0) > env:
+                victim = k
+                break
+        if victim is None:
+            # foremast: ignore[lock-discipline] — caller holds _lock
+            victim = next(iter(self._series))
+        self._pop_series(victim, byte_deltas)
+        evict_charges[causer] = evict_charges.get(causer, 0) + 1
 
     def query(
         self,
@@ -275,13 +428,21 @@ class RingShard:
         rebalance hook (a healed ring moved these keys to another
         member; keeping their columns would spend this worker's budget
         on series it will never be asked for again)."""
+        byte_deltas: dict[str, int] = {}
         with self._lock:
             doomed = [k for k in self._series if not owns(k)]
             for k in doomed:
-                old = self._series.pop(k)
-                self._bytes -= old.nbytes
-                self._counts["evictions"] += 1
-            return len(doomed)
+                if self._tenancy is not None:
+                    # residency moves, but rebalance is nobody's QoS
+                    # pressure: no tenant eviction charge
+                    self._pop_series(k, byte_deltas)
+                else:
+                    old = self._series.pop(k)
+                    self._bytes -= old.nbytes
+                    self._counts["evictions"] += 1
+        if self._tenancy is not None and byte_deltas:
+            self._tenancy.flush(byte_deltas, {})
+        return len(doomed)
 
     def snapshot_state(self) -> list[tuple]:
         """Consistent copy of every resident series for the snapshot
@@ -328,13 +489,24 @@ class RingStore:
         shards: int = DEFAULT_SHARDS,
         stale_seconds: float = DEFAULT_STALE_SECONDS,
         max_points: int = DEFAULT_MAX_POINTS,
+        tenancy=None,
     ):
         shards = max(1, int(shards))
         self.budget_bytes = int(budget_bytes)
         self.stale_seconds = float(stale_seconds)
         self.max_points = int(max_points)
+        # tenant QoS plane (ISSUE 20): a TenantRegistry activates
+        # per-tenant ring-bytes envelopes + eviction attribution; None
+        # keeps the untenanted eviction loop byte-identical
+        self.tenancy = (
+            _RingTenancy(tenancy, shards) if tenancy is not None else None
+        )
         self._shards = tuple(
-            RingShard(max(self.budget_bytes // shards, 1), self.max_points)
+            RingShard(
+                max(self.budget_bytes // shards, 1),
+                self.max_points,
+                tenancy=self.tenancy,
+            )
             for _ in range(shards)
         )
         self._lock = threading.Lock()
@@ -348,6 +520,8 @@ class RingStore:
 
     @staticmethod
     def from_env(env=None) -> "RingStore":
+        from foremast_tpu.tenant.registry import get_tenancy
+
         e = os.environ if env is None else env
         return RingStore(
             budget_bytes=int(
@@ -362,6 +536,7 @@ class RingStore:
             max_points=int(
                 e.get("FOREMAST_INGEST_MAX_POINTS", "") or DEFAULT_MAX_POINTS
             ),
+            tenancy=get_tenancy(),
         )
 
     def _shard_index(self, key: str) -> int:

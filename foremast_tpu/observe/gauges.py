@@ -268,6 +268,9 @@ class WorkerMetrics:
         from prometheus_client import REGISTRY, Counter, Gauge, Histogram
 
         reg = registry if registry is not None else REGISTRY
+        # exposed so late-bound custom collectors (tenant attribution)
+        # can join this worker's exposition registry
+        self.registry = reg
         self.jobs = Counter(
             "foremast_worker_jobs_total",
             "documents processed, by resulting status",
@@ -383,12 +386,19 @@ class WorkerMetrics:
         # receiver arrival stamp (the RECEIVER's clock, immune to
         # pusher clock skew) to verdict write, labeled by the tick
         # path that wrote it (micro = ingest-triggered micro-tick,
-        # sweep = full tick catch-all) — plus the micro-tick doc count
+        # sweep = full tick catch-all) — plus the micro-tick doc count.
+        # `tenant` (ISSUE 20) is bounded-cardinality: configured
+        # tenants + up to FOREMAST_TENANT_LABEL_MAX observed label
+        # values, everything past the cap folded into `other`;
+        # untenanted workers export one constant `default` series per
+        # path (worker._observe_verdicts owns the folding)
         self.verdict_latency = Histogram(
             "foremast_verdict_latency_seconds",
             "push receive-instant to verdict write, by judging path "
-            "(micro = ingest-triggered micro-tick, sweep = full tick)",
-            ["path"],
+            "(micro = ingest-triggered micro-tick, sweep = full tick) "
+            "and tenant (bounded by FOREMAST_TENANT_LABEL_MAX + the "
+            "`other` overflow bucket)",
+            ["path", "tenant"],
             buckets=(
                 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 15.0, 60.0, 300.0,
             ),

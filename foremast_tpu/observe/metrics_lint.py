@@ -52,7 +52,7 @@ ALLOWED_LABELS: dict[str, frozenset[str]] = {
     # reactive plane (ISSUE 12): push→verdict SLO + micro-tick traffic
     # (worker: observe/gauges.py; dirty set: reactive/dirty.py
     # ReactiveCollector; watch stream: reactive/watchstream.py)
-    "foremast_verdict_latency_seconds": frozenset({"path"}),
+    "foremast_verdict_latency_seconds": frozenset({"path", "tenant"}),
     # device mesh (ISSUE 13, observe/gauges.py WorkerMetrics)
     "foremast_device_mesh_devices": frozenset(),
     "foremast_device_mesh_rows": frozenset({"kind"}),
@@ -91,6 +91,14 @@ ALLOWED_LABELS: dict[str, frozenset[str]] = {
     "foremast_breaker_short_circuits": frozenset({"edge"}),
     "foremast_degraded_docs": frozenset({"reason"}),
     "foremast_degraded_events": frozenset({"edge", "action"}),
+    # multi-tenant QoS plane (ISSUE 20, foremast_tpu/tenant/collector.py
+    # TenantCollector) — `tenant` is bounded-cardinality: configured
+    # tenants + up to FOREMAST_TENANT_LABEL_MAX observed values, the
+    # rest folded into `other`
+    "foremast_tenant_shed": frozenset({"tenant"}),
+    "foremast_tenant_evictions": frozenset({"tenant"}),
+    "foremast_tenant_claims": frozenset({"tenant"}),
+    "foremast_tenant_ring_bytes": frozenset({"tenant"}),
     # durable data plane (foremast_tpu/ingest/snapshot.py SnapshotCollector)
     "foremast_snapshot_discards": frozenset({"reason"}),
     "foremast_snapshot_restored_series": frozenset(),
@@ -160,7 +168,9 @@ FAMILY_DOCS: dict[str, str] = {
     ),
     "foremast_verdict_latency_seconds": (
         "push receive-instant (receiver clock) to verdict write, by "
-        "judging path (micro/sweep) — the reactive plane's SLO"
+        "judging path (micro/sweep) and tenant (bounded by "
+        "FOREMAST_TENANT_LABEL_MAX + the `other` overflow bucket) — "
+        "the reactive plane's SLO"
     ),
     "foremast_microtick_docs": (
         "documents judged by ingest-triggered micro-ticks"
@@ -275,6 +285,24 @@ FAMILY_DOCS: dict[str, str] = {
         "non-per-document degradation events (claim errors survived, "
         "receiver sheds, replay flushes), by edge and action"
     ),
+    "foremast_tenant_shed": (
+        "pushes shed by per-tenant ingest admission (429 + "
+        "Retry-After), charged to the flooding tenant; label bounded "
+        "by FOREMAST_TENANT_LABEL_MAX + the `other` overflow bucket"
+    ),
+    "foremast_tenant_evictions": (
+        "ring series / arena rows evicted under a tenant's budget "
+        "envelope, charged to the tenant causing the pressure; label "
+        "bounded by FOREMAST_TENANT_LABEL_MAX + `other`"
+    ),
+    "foremast_tenant_claims": (
+        "documents claimed for judgment, by owning tenant; label "
+        "bounded by FOREMAST_TENANT_LABEL_MAX + `other`"
+    ),
+    "foremast_tenant_ring_bytes": (
+        "ring TSDB column bytes currently resident, by owning tenant; "
+        "label bounded by FOREMAST_TENANT_LABEL_MAX + `other`"
+    ),
     "foremast_snapshot_discards": (
         "state discarded during snapshot restore, by reason"
     ),
@@ -345,7 +373,9 @@ def default_registry_families():
     for kind in ("univariate", "bivariate", "lstm"):
         metrics.fast_docs.labels(kind=kind).inc()
     for path in ("micro", "sweep"):
-        metrics.verdict_latency.labels(path=path).observe(0.1)
+        metrics.verdict_latency.labels(path=path, tenant="default").observe(
+            0.1
+        )
     metrics.microtick_docs.inc()
     for path in ("slow", "warm"):
         metrics.pipeline_idle.labels(path=path).inc(0.0)
@@ -432,6 +462,27 @@ def default_registry_families():
     dirty.mark("lint-extra")  # overflows max_keys=2: dropped
     dirty.count("unattributed")
     registry.register(ReactiveCollector(dirty))
+    # multi-tenant QoS plane: a two-tenant registry with one nonzero
+    # sample per family so every foremast_tenant_* series is exported
+    from foremast_tpu.tenant import (
+        TenantAccounting,
+        TenantCollector,
+        TenantRegistry,
+        TenantSpec,
+    )
+
+    tenancy = TenantRegistry(
+        {
+            "default": TenantSpec(name="default"),
+            "lint": TenantSpec(name="lint", weight=2.0),
+        }
+    )
+    acct = TenantAccounting(tenancy)
+    acct.count_shed("lint")
+    acct.count_eviction("lint")
+    acct.count_claims("default")
+    acct.add_ring_bytes("lint", 1024)
+    registry.register(TenantCollector(acct))
     ws = WatchStreamMetrics(registry=registry)
     for etype in ("added", "modified", "deleted", "error"):
         ws.events.labels(type=etype).inc()

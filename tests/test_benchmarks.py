@@ -358,6 +358,29 @@ def test_latency_bench_small_smoke(capsys):
     assert out["warm_throughput"]["warm_windows_per_sec"] > 0
 
 
+def test_noisy_bench_small_smoke(capsys):
+    """`make bench-noisy --small` smoke (ISSUE 20): the noisy-neighbor
+    fleet at CI shapes — a whale tenant at 10x share floods the real
+    receiver while quiet tenants' anomaly injections are measured
+    against a solo-tenant control. The bench FAILS in-run on a shed
+    landing anywhere but the whale, a quiet-tenant F1 change, an
+    evicted quiet series, a missing /debug/state tenants section, or a
+    zero-vs-one-tenant parity break; the p99-vs-control bar asserts at
+    the full shape only."""
+    import benchmarks.noisy_bench as noisy_bench
+
+    noisy_bench.main(["--small"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["bench"] == "noisy"
+    assert out["quiet_push_codes"] == {"200": out["inject"]}
+    assert out["whale_flood_codes"].get("429", 0) > 0
+    assert out["treatment"]["f1"] == out["control"]["f1"]
+    assert out["treatment"]["timeouts"] == 0
+    assert out["accounting"]["t0"]["shed"] > 0
+    assert out["debug_state_tenants"] is True
+    assert out["parity"].startswith("zero-vs-one-tenant byte-identical")
+
+
 def test_elastic_bench_small_smoke(capsys):
     """`make bench-elastic --small` smoke (ISSUE 11): 2 -> 4 -> 2
     workers under continuous load with every acceptance assert in-run

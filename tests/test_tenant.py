@@ -1,0 +1,486 @@
+"""Multi-tenant QoS plane (ISSUE 20): resolution, envelopes,
+weighted-fair scheduling, targeted backpressure, bounded-cardinality
+attribution — and the parity pin that with zero or one tenant every
+seam is byte-identical to the untenanted build."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from foremast_tpu.ingest import RingStore, canonical_series
+from foremast_tpu.jobs.models import Document
+from foremast_tpu.metrics.promql import prometheus_url
+from foremast_tpu.reactive import DirtySet
+from foremast_tpu.tenant import (
+    DEFAULT_TENANT,
+    OTHER_TENANT,
+    DeficitRoundRobin,
+    TenantAccounting,
+    TenantCollector,
+    TenantRegistry,
+    TenantSpec,
+    accounting_for,
+    set_tenancy,
+    tenancy_from_env,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_global_tenancy():
+    """Every test starts and ends untenanted — the process-global
+    registry must never leak between tests (workers, rings and dirty
+    sets read it at construction)."""
+    set_tenancy(None)
+    yield
+    set_tenancy(None)
+
+
+def _reg(**spec_fields) -> TenantRegistry:
+    return TenantRegistry(
+        {
+            "whale": TenantSpec(name="whale", **spec_fields),
+            "quiet": TenantSpec(name="quiet"),
+        }
+    )
+
+
+def _series(tenant: str, i: int) -> str:
+    return canonical_series(
+        f'up{{app="app{i}",namespace="t",tenant="{tenant}"}}'
+    )
+
+
+def _doc(s: int, tenant: str) -> Document:
+    expr = f'latency{{app="app{s}",namespace="t",tenant="{tenant}"}}'
+    url = prometheus_url(
+        {"endpoint": "http://p/api/v1/", "query": expr,
+         "start": 0, "end": 600, "step": 60}
+    )
+    return Document(
+        id=f"job-{s}",
+        app_name=f"app{s}",
+        historical_config=f"latency== {url}",
+        current_config=f"latency== {url}",
+    )
+
+
+# ---------------------------------------------------------------------------
+# resolution + envelope config
+# ---------------------------------------------------------------------------
+
+
+def test_resolution_series_doc_and_key():
+    reg = _reg()
+    assert reg.tenant_of_series(_series("whale", 1)) == "whale"
+    assert reg.tenant_of_series('up{app="a"}') == DEFAULT_TENANT
+    assert reg.tenant_of_doc(_doc(3, "quiet")) == "quiet"
+    assert reg.tenant_of_doc(Document(id="d", app_name="a")) == (
+        DEFAULT_TENANT
+    )
+    # arena fit keys embed the URL-ENCODED selector
+    url = prometheus_url(
+        {"endpoint": "http://p/api/v1/",
+         "query": 'up{app="a",tenant="whale"}',
+         "start": 0, "end": 600, "step": 60}
+    )
+    assert reg.tenant_of_key(f"app|up|{url}") == "whale"
+
+
+def test_custom_label_env():
+    reg = TenantRegistry(
+        {"a": TenantSpec(name="a"), "b": TenantSpec(name="b")},
+        label="team",
+    )
+    assert reg.tenant_of_series('up{app="x",team="a"}') == "a"
+    assert reg.tenant_of_series('up{app="x",tenant="a"}') == (
+        DEFAULT_TENANT
+    )
+
+
+def test_tenancy_from_env_inline_path_and_errors(tmp_path):
+    assert tenancy_from_env({}) is None
+    spec = {"acme": {"weight": 4, "ring_bytes": 1024}, "default": {}}
+    reg = tenancy_from_env({"FOREMAST_TENANTS": json.dumps(spec)})
+    assert reg.weight("acme") == 4.0
+    assert reg.spec("acme").ring_bytes == 1024
+    assert reg.fair  # two tenants
+    p = tmp_path / "tenants.json"
+    p.write_text(json.dumps({"tenants": spec}))
+    reg2 = tenancy_from_env({"FOREMAST_TENANTS": f"@{p}"})
+    assert reg2.weight("acme") == 4.0
+    single = tenancy_from_env(
+        {"FOREMAST_TENANTS": json.dumps({"only": {"weight": 2}})}
+    )
+    assert single is not None and not single.fair
+    with pytest.raises(ValueError):
+        tenancy_from_env({"FOREMAST_TENANTS": "{not json"})
+    with pytest.raises(ValueError):
+        tenancy_from_env(
+            {"FOREMAST_TENANTS": json.dumps({"x": {"bogus_field": 1}})}
+        )
+
+
+# ---------------------------------------------------------------------------
+# bounded-cardinality attribution (the BrainGauges-style cap)
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_label_cardinality_cap_and_lint_clean():
+    reg = TenantRegistry(
+        {"a": TenantSpec(name="a"), "b": TenantSpec(name="b")},
+        label_max=3,
+    )
+    # configured tenants + default always keep their own label value
+    assert reg.metric_tenant("a") == "a"
+    assert reg.metric_tenant(DEFAULT_TENANT) == DEFAULT_TENANT
+    # unconfigured values claim slots up to the cap...
+    for i in range(3):
+        assert reg.metric_tenant(f"u{i}") == f"u{i}"
+    # ...then fold into `other`, counted once per dropped name
+    assert reg.metric_tenant("u3") == OTHER_TENANT
+    assert reg.metric_tenant("u4") == OTHER_TENANT
+    assert reg.metric_tenant("u3") == OTHER_TENANT  # counted ONCE
+    assert reg.dropped_label_values == 2
+    # a slot claimed before the cap stays claimed
+    assert reg.metric_tenant("u1") == "u1"
+    # the capped exposition is lint-clean: every foremast_tenant_*
+    # family carries exactly the documented {tenant} label set
+    from prometheus_client import CollectorRegistry
+
+    from foremast_tpu.observe.metrics_lint import lint_registry
+
+    acct = TenantAccounting(reg)
+    for t in ("a", "u0", "u9", "u10"):
+        acct.count_shed(reg.metric_tenant(t))
+        acct.add_ring_bytes(reg.metric_tenant(t), 64)
+    registry = CollectorRegistry()
+    registry.register(TenantCollector(acct))
+    assert lint_registry(registry) == []
+    snap = acct.snapshot()
+    assert OTHER_TENANT in snap
+    assert snap[OTHER_TENANT]["shed"] == 2  # u9 + u10 folded
+
+
+def test_accounting_ring_bytes_clamped():
+    acct = TenantAccounting(_reg())
+    acct.add_ring_bytes("whale", 100)
+    acct.add_ring_bytes("whale", -500)
+    assert acct.snapshot()["whale"]["ring_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair scheduling: DRR, dirty-set drain, sweep pool
+# ---------------------------------------------------------------------------
+
+
+def test_drr_weighted_split():
+    drr = DeficitRoundRobin({"a": 4.0, "b": 1.0})
+    order = drr.pick({"a": 100, "b": 100}, 10)
+    assert order.count("a") == 8 and order.count("b") == 2
+
+
+def test_drr_empty_tenant_forfeits():
+    drr = DeficitRoundRobin({"a": 1.0, "b": 1.0})
+    order = drr.pick({"a": 10}, 4)
+    assert order == ["a"] * 4
+    # b arriving later starts fresh — no hoarded credit from rounds it
+    # had nothing queued
+    order = drr.pick({"a": 10, "b": 10}, 4)
+    assert order.count("b") == 2
+
+
+def test_fair_drain_no_starvation_past_one_slice():
+    """The starvation pin: a whale marking 100 series BEFORE a quiet
+    tenant's single arrival cannot push that arrival past one drain
+    boundary — the first take() already serves the quiet tenant."""
+    reg = _reg()
+    dirty = DirtySet(max_keys=1024, tenancy=reg)
+    now = time.time()
+    for i in range(100):
+        dirty.mark_series(_series("whale", i), now=now)
+    dirty.mark_series(_series("quiet", 0), now=now + 0.001)
+    first = [rk for rk, _ in dirty.take(8)]
+    assert "app0" in first, first  # the quiet arrival made slice one
+    # within a tenant the order stays oldest-first
+    whale_part = [rk for rk in first if rk != "app0"]
+    assert whale_part == sorted(
+        whale_part, key=lambda rk: int(rk[3:])
+    ), first
+
+
+def test_fifo_drain_untenanted_and_single_tenant():
+    """<=1 tenant: take() is the exact pre-ISSUE-20 FIFO pop."""
+    for tenancy in (
+        None,
+        TenantRegistry({"only": TenantSpec(name="only")}),
+    ):
+        dirty = DirtySet(max_keys=64, tenancy=tenancy)
+        now = time.time()
+        for i in range(10):
+            dirty.mark(f"rk{i}", now + i)
+        assert [rk for rk, _ in dirty.take(4)] == [
+            "rk0", "rk1", "rk2", "rk3",
+        ]
+        assert dirty.debug_state()["tenant_fair"] is False
+
+
+def test_sweep_pool_fair_slice_order():
+    """PR-15 slice boundaries are the preemption points: the sweep
+    pool's take() interleaves tenants by deficit-weighted order, so a
+    whale's 40 queued docs cannot fill slice one while a quiet
+    tenant's docs wait."""
+    from foremast_tpu.jobs.worker import _SweepPool
+
+    reg = _reg()
+    docs = [_doc(s, "whale") for s in range(40)]
+    docs += [_doc(100 + s, "quiet") for s in range(4)]
+    pool = _SweepPool(docs, tenancy=reg)
+    first = [d.id for d in pool.take(8)]
+    assert any(d.startswith("job-10") for d in first), first
+    # untenanted pool keeps strict FIFO
+    pool2 = _SweepPool(docs, tenancy=None)
+    assert [d.id for d in pool2.take(8)] == [
+        f"job-{s}" for s in range(8)
+    ]
+    # drain() leaves no queue residue
+    pool.drain()
+    assert pool.take(4) == []
+
+
+# ---------------------------------------------------------------------------
+# resource isolation: ring envelopes + arena envelopes
+# ---------------------------------------------------------------------------
+
+
+def test_ring_envelope_evicts_whale_not_quiet():
+    reg = _reg(ring_bytes=8192)
+    ring = RingStore(budget_bytes=1 << 20, shards=2, tenancy=reg)
+    now = 1_000_000.0
+    ts = np.arange(0, 600, 60, dtype=np.int64)
+    vs = np.ones(len(ts), np.float32)
+    for i in range(4):
+        ring.push(_series("quiet", i), ts, vs, now=now)
+    for i in range(200):
+        ring.push(_series("whale", i), ts, vs, now=now)
+    acct = accounting_for(reg).snapshot()
+    assert acct["whale"]["evictions"] > 0
+    assert acct.get("quiet", {}).get("evictions", 0) == 0
+    # the whale stayed inside its envelope; the quiet series survived
+    assert acct["whale"]["ring_bytes"] <= 8192
+    for i in range(4):
+        assert (
+            ring.query(_series("quiet", i), 0.0, 600.0, now=now)
+            is not None
+        )
+
+
+def test_ring_untenanted_parity():
+    """Same pushes, no registry: byte-identical residency + stats to a
+    single-tenant registry (the parity pin at the ring seam)."""
+    def build(tenancy):
+        ring = RingStore(budget_bytes=4096, shards=2, tenancy=tenancy)
+        ts = np.arange(0, 600, 60, dtype=np.int64)
+        vs = np.ones(len(ts), np.float32)
+        for i in range(40):
+            ring.push(_series("x", i), ts, vs, now=1e6)
+        return ring.stats()
+
+    single = TenantRegistry({"only": TenantSpec(name="only")})
+    assert build(None) == build(single)
+
+
+def test_arena_envelope_same_tenant_recycle():
+    """An over-envelope tenant recycles its OWN least-recent rows; the
+    quiet tenant's rows never move and every eviction is charged to
+    the whale."""
+    from foremast_tpu.engine.arena import StateArena
+
+    def key(t, i):
+        url = prometheus_url(
+            {"endpoint": "http://p/api/v1/",
+             "query": f'up{{app="a{i}",tenant="{t}"}}',
+             "start": 0, "end": 600, "step": 60}
+        )
+        return f"a{i}|up|{url}"
+
+    reg = _reg(arena_rows=4)
+    set_tenancy(reg)
+    arena = StateArena(4, max_bytes=1 << 16)
+    assert arena._qos is not None
+    arena.assign([key("quiet", i) for i in range(6)], [])
+    for rnd in range(4):
+        arena.assign(
+            [key("whale", rnd * 8 + i) for i in range(8)], []
+        )
+        arena.assign([key("quiet", i) for i in range(6)], [])
+    assert arena._qos.rows["quiet"] == 6
+    for i in range(6):
+        assert key("quiet", i) in arena.rows
+    acct = accounting_for(reg).snapshot()
+    assert acct["whale"]["evictions"] > 0
+    assert acct.get("quiet", {}).get("evictions", 0) == 0
+
+
+def test_arena_untenanted_and_single_tenant_parity():
+    from foremast_tpu.engine.arena import StateArena
+
+    seq = [
+        [f"k{j}-{i}" for i in range(8)] for j in range(3)
+    ]
+
+    def rows(tenancy):
+        set_tenancy(tenancy)
+        arena = StateArena(4, max_bytes=1 << 14)
+        out = [arena.assign(ks, [])[0].tolist() for ks in seq]
+        assert arena._qos is None
+        return out
+
+    single = TenantRegistry({"only": TenantSpec(name="only")})
+    assert rows(None) == rows(single)
+
+
+# ---------------------------------------------------------------------------
+# receiver fairness: 429 + Retry-After target the flooding tenant
+# ---------------------------------------------------------------------------
+
+
+def _post(port, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/v1/write",
+        data=json.dumps(payload).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            resp.read()
+            return resp.status, dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code, dict(e.headers)
+
+
+def test_receiver_sheds_flooding_tenant_only():
+    from foremast_tpu.ingest import start_ingest_server, stop_ingest_server
+
+    reg = _reg(ingest_bytes_per_s=1024)  # whale burst = 2 KiB
+    ring = RingStore(budget_bytes=1 << 20, shards=2, tenancy=reg)
+    srv, _ = start_ingest_server(
+        0, ring, host="127.0.0.1", tenancy=reg
+    )
+    port = srv.server_address[1]
+    try:
+        ts = list(range(0, 60 * 40, 60))
+
+        def payload(tenant, i):
+            return {
+                "timeseries": [
+                    {
+                        "alias": _series(tenant, i),
+                        "times": ts,
+                        "values": [1.0] * len(ts),
+                    }
+                ]
+            }
+
+        whale_codes = []
+        retry_after = None
+        for i in range(8):  # ~25 KB total vs a 2 KiB burst
+            code, hdrs = _post(port, payload("whale", i))
+            whale_codes.append(code)
+            if code == 429:
+                retry_after = hdrs.get("Retry-After")
+        assert 429 in whale_codes, whale_codes
+        assert retry_after is not None and 1 <= int(retry_after) <= 60
+        # the quiet tenant pushes through the SAME socket, unshed
+        code, _ = _post(port, payload("quiet", 0))
+        assert code == 200
+        acct = accounting_for(reg).snapshot()
+        assert acct["whale"]["shed"] == whale_codes.count(429)
+        assert acct.get("quiet", {}).get("shed", 0) == 0
+        # attribution is visible on the wire: /debug/state tenants
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/state", timeout=10
+        ) as resp:
+            state = json.load(resp)
+        assert state["tenants"]["accounting"]["whale"]["shed"] > 0
+        assert "ingest_buckets" in state["tenants"]
+    finally:
+        stop_ingest_server(srv)
+
+
+# ---------------------------------------------------------------------------
+# verdict-latency attribution: the bounded tenant label on the SLO family
+# ---------------------------------------------------------------------------
+
+
+def test_verdict_latency_carries_tenant_label():
+    from prometheus_client import CollectorRegistry
+
+    from foremast_tpu.observe.gauges import WorkerMetrics
+
+    registry = CollectorRegistry()
+    metrics = WorkerMetrics(registry=registry)
+    metrics.verdict_latency.labels(path="micro", tenant="acme").observe(
+        0.2
+    )
+    sample_labels = [
+        s.labels
+        for m in registry.collect()
+        if m.name == "foremast_verdict_latency_seconds"
+        for s in m.samples
+    ]
+    assert all("tenant" in lb for lb in sample_labels)
+    assert any(lb.get("tenant") == "acme" for lb in sample_labels)
+
+
+def test_worker_registers_tenant_collector_on_metrics_registry():
+    """A tenanted worker's scrape registry exports the four
+    foremast_tenant_* families (the ledger the receiver shares), and a
+    second worker on the same registry is a no-op, not a crash."""
+    from prometheus_client import CollectorRegistry
+
+    from foremast_tpu.config import BrainConfig
+    from foremast_tpu.jobs.store import InMemoryStore
+    from foremast_tpu.jobs.worker import BrainWorker
+    from foremast_tpu.metrics.source import MetricSource
+    from foremast_tpu.observe.gauges import WorkerMetrics
+
+    set_tenancy(_reg())
+    registry = CollectorRegistry()
+    metrics = WorkerMetrics(registry=registry)
+    src = MetricSource()
+    w = BrainWorker(InMemoryStore(), src, BrainConfig(), metrics=metrics)
+    BrainWorker(InMemoryStore(), src, BrainConfig(), metrics=metrics)
+    w._tenant_acct.count_shed("whale")
+    names = {m.name for m in registry.collect()}
+    assert {
+        "foremast_tenant_shed",
+        "foremast_tenant_evictions",
+        "foremast_tenant_claims",
+        "foremast_tenant_ring_bytes",
+    } <= names
+    shed = [
+        s
+        for m in registry.collect()
+        if m.name == "foremast_tenant_shed"
+        for s in m.samples
+        if s.labels.get("tenant") == "whale"
+    ]
+    assert shed and shed[0].value == 1
+
+    # untenanted worker: no tenant families on a fresh registry
+    set_tenancy(None)
+    bare = CollectorRegistry()
+    BrainWorker(
+        InMemoryStore(), src, BrainConfig(),
+        metrics=WorkerMetrics(registry=bare),
+    )
+    assert not any(
+        m.name.startswith("foremast_tenant_") for m in bare.collect()
+    )
